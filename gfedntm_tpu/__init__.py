@@ -1,0 +1,25 @@
+"""gfedntm_tpu — a TPU-native federated neural topic modeling framework.
+
+A from-scratch rebuild of the capabilities of gFedNTM (federated neural topic
+models: ProdLDA / NeuralLDA / CTM with per-minibatch FedAvg), designed for TPU:
+
+- Models are pure-functional Flax modules compiled by XLA (reference:
+  PyTorch nn.Modules under ``src/models/base``).
+- The federation is ONE SPMD program over a ``jax.sharding.Mesh``: each mesh
+  position hosts one client, and the per-minibatch sample-weighted parameter
+  average is a ``lax.psum`` over ICI (reference: gRPC hub-and-spoke,
+  ``src/federation/server.py``).
+- Vocabulary consensus is a one-shot host-side union + broadcast (reference:
+  ``src/federation/server.py:270-288``).
+"""
+
+__version__ = "0.1.0"
+
+from gfedntm_tpu import config as config
+from gfedntm_tpu import data as data
+from gfedntm_tpu import eval as eval  # noqa: A004
+from gfedntm_tpu import federated as federated
+from gfedntm_tpu import models as models
+from gfedntm_tpu import parallel as parallel
+from gfedntm_tpu import train as train
+from gfedntm_tpu import utils as utils

@@ -1,0 +1,25 @@
+from gfedntm_tpu.models import activations as activations
+from gfedntm_tpu.models import initializers as initializers
+from gfedntm_tpu.models import layers as layers
+from gfedntm_tpu.models import losses as losses
+from gfedntm_tpu.models import networks as networks
+from gfedntm_tpu.models.networks import (
+    CombinedInferenceNetwork,
+    ContextualInferenceNetwork,
+    DecoderNetwork,
+    InferenceNetwork,
+    TopicModelOutput,
+)
+
+__all__ = [
+    "CombinedInferenceNetwork",
+    "ContextualInferenceNetwork",
+    "DecoderNetwork",
+    "InferenceNetwork",
+    "TopicModelOutput",
+    "activations",
+    "initializers",
+    "layers",
+    "losses",
+    "networks",
+]

@@ -1,0 +1,57 @@
+"""Activation registry matching the reference's supported set.
+
+Reference: ``src/models/base/pytorchavitm/avitm_network/inference_network.py:45-60``
+maps the string names {softplus, relu, sigmoid, tanh, leakyrelu, rrelu, elu,
+selu} to torch modules; the AVITM trainer additionally allows ``swish``
+(``avitm.py:79``) which the reference's mapping silently drops (a latent bug —
+we implement it as SiLU, the intended semantics).
+
+RReLU note: torch's RReLU samples a negative-side slope uniformly from
+[1/8, 1/3] per element in training mode and uses the mean slope in eval.
+Sampling is supported here when an ``rrelu`` PRNG key is provided to the
+module; otherwise the deterministic mean slope is used in both modes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_RRELU_LOWER = 1.0 / 8.0
+_RRELU_UPPER = 1.0 / 3.0
+
+
+def rrelu(x: jax.Array, key: jax.Array | None = None) -> jax.Array:
+    """Randomized leaky ReLU (torch ``nn.RReLU`` semantics)."""
+    if key is None:
+        slope = (_RRELU_LOWER + _RRELU_UPPER) / 2.0
+        return jnp.where(x >= 0, x, x * slope)
+    slope = jax.random.uniform(
+        key, x.shape, dtype=x.dtype, minval=_RRELU_LOWER, maxval=_RRELU_UPPER
+    )
+    return jnp.where(x >= 0, x, x * slope)
+
+
+ACTIVATIONS: dict[str, Callable[[jax.Array], jax.Array]] = {
+    "softplus": jax.nn.softplus,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "swish": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "rrelu": rrelu,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+}
+
+
+def get_activation(name: str) -> Callable[[jax.Array], jax.Array]:
+    """Look up an activation by its reference-compatible string name."""
+    try:
+        return ACTIVATIONS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"activation must be one of {sorted(ACTIVATIONS)}, got {name!r}"
+        ) from None

@@ -1,0 +1,40 @@
+"""Parameter initializers replicating torch layer-init distributions.
+
+The reference relies on torch defaults: ``nn.Linear`` uses kaiming-uniform
+with a=sqrt(5) on the weight — equivalent to U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+— and the same bound for the bias; ``beta`` uses ``nn.init.xavier_uniform_``
+(``decoder_network.py:91-95``). Matching the init *distribution* (not the
+draws) keeps training dynamics comparable for parity experiments.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def torch_linear_kernel_init(key, shape, dtype=jnp.float32):
+    """Flax kernel shape is [fan_in, fan_out]; bound = 1/sqrt(fan_in)."""
+    fan_in = shape[0]
+    bound = 1.0 / jnp.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def torch_linear_bias_init(fan_in: int):
+    """Torch bias init depends on the layer's fan_in, which flax's bias init
+    signature does not expose — so it is bound at layer-construction time."""
+    bound = 1.0 / jnp.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+def xavier_uniform_2d(key, shape, dtype=jnp.float32):
+    """``nn.init.xavier_uniform_`` on a [rows, cols] matrix (gain=1):
+    bound = sqrt(6 / (fan_in + fan_out)) where torch treats dim 1 as fan_in
+    and dim 0 as fan_out for a 2-D tensor."""
+    fan_out, fan_in = shape[0], shape[1]
+    bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)

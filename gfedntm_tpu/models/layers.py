@@ -1,0 +1,116 @@
+"""Core layers: torch-parity Dense and mask-aware affine-free BatchNorm.
+
+The reference's networks are stacks of ``nn.Linear`` + activation with
+affine-free ``nn.BatchNorm1d`` heads (``inference_network.py:62-74``,
+``decoder_network.py:97``). Two TPU-specific concerns shape this module:
+
+1. **SPMD padded batches.** Under the single-program federation, every client
+   must process an identically-shaped batch each step even though client
+   datasets differ in size; short final batches are padded and masked.
+   BatchNorm's batch statistics must then be computed over *real* rows only to
+   match the reference (which simply gets a shorter last batch), hence
+   ``MaskedBatchNorm``'s optional row mask.
+2. **Torch-parity statistics.** torch BatchNorm normalizes with the *biased*
+   batch variance but updates the running variance with the *unbiased* one,
+   and blends with momentum 0.1 (torch convention: new = (1-m)*old + m*batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from gfedntm_tpu.models.initializers import (
+    torch_linear_bias_init,
+    torch_linear_kernel_init,
+)
+
+
+class TorchDense(nn.Module):
+    """``nn.Linear`` equivalent: torch default init, [fan_in, fan_out] kernel."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", torch_linear_kernel_init, (fan_in, self.features)
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias", torch_linear_bias_init(fan_in), (self.features,)
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class MaskedBatchNorm(nn.Module):
+    """Affine-free BatchNorm1d with optional row mask (torch semantics).
+
+    Replicates ``nn.BatchNorm1d(features, affine=False)`` as used at
+    ``inference_network.py:69,72`` and ``decoder_network.py:97``:
+    - train: normalize with biased batch variance; running stats updated as
+      ``running = 0.9*running + 0.1*batch`` (unbiased variance for the var).
+    - eval: normalize with running stats.
+    - ``num_batches_tracked`` is kept for state-dict parity with the
+      reference's ``grads_to_share`` lists (``config/dft_params.cf:50``); it
+      does not affect math when momentum is fixed (as it is in torch's
+      default and here).
+
+    ``mask`` is a [batch] float/bool array; masked-out (padding) rows are
+    excluded from the batch statistics but still produce (normalized) outputs.
+    """
+
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool, mask=None):
+        features = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "running_mean", lambda: jnp.zeros(features, jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "running_var", lambda: jnp.ones(features, jnp.float32)
+        )
+        n_tracked = self.variable(
+            "batch_stats", "num_batches_tracked", lambda: jnp.zeros((), jnp.int32)
+        )
+
+        xf = x.astype(jnp.float32)
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            reduce_axes = tuple(range(x.ndim - 1))
+            if mask is None:
+                n = jnp.asarray(
+                    float(max(1, int(jnp.prod(jnp.array(x.shape[:-1]))))),
+                    jnp.float32,
+                )
+                mean = jnp.mean(xf, axis=reduce_axes)
+                var_biased = jnp.mean(jnp.square(xf - mean), axis=reduce_axes)
+            else:
+                m = mask.astype(jnp.float32)
+                m_exp = m.reshape(m.shape + (1,) * (x.ndim - m.ndim))
+                n = jnp.maximum(jnp.sum(m), 1.0)
+                mean = jnp.sum(xf * m_exp, axis=reduce_axes) / n
+                var_biased = (
+                    jnp.sum(jnp.square(xf - mean) * m_exp, axis=reduce_axes) / n
+                )
+            var = var_biased
+            if not self.is_initializing():
+                var_unbiased = var_biased * (n / jnp.maximum(n - 1.0, 1.0))
+                m_ = self.momentum
+                ra_mean.value = (1.0 - m_) * ra_mean.value + m_ * mean
+                ra_var.value = (1.0 - m_) * ra_var.value + m_ * var_unbiased
+                n_tracked.value = n_tracked.value + 1
+
+        y = (xf - mean) / jnp.sqrt(var + self.epsilon)
+        return y.astype(self.dtype)

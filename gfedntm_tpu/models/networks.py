@@ -1,0 +1,322 @@
+"""Flax networks for AVITM (ProdLDA / NeuralLDA) and CTM topic models.
+
+TPU-native re-design of the reference's torch modules:
+- ``InferenceNetwork``      <- ``pytorchavitm/avitm_network/inference_network.py:7-85``
+- ``ContextualInferenceNetwork`` / ``CombinedInferenceNetwork``
+                            <- ``contextualized_topic_models/ctm_network/inference_network.py:6-193``
+- ``DecoderNetwork``        <- ``pytorchavitm/avitm_network/decoder_network.py:10-147``
+                               and ``ctm_network/decoding_network.py`` (unified here:
+                               the CTM decoder is the AVITM decoder plus an input
+                               selector and an optional label head)
+
+Design notes (TPU-first):
+- Pure functions of (params, batch_stats, rngs) — no hidden device state; the
+  whole forward fuses into a handful of XLA ops dominated by the
+  [B,K]x[K,V] decoder matmul, which lands on the MXU.
+- The reparameterization sample rides an explicit ``reparam`` PRNG collection
+  (the reference samples implicitly via ``torch.randn_like``,
+  ``decoder_network.py:102-107`` — including at inference time, which is why
+  ``get_theta`` here also draws from ``reparam``).
+- ``mask`` rows (SPMD padding) are excluded from BatchNorm statistics; see
+  ``layers.MaskedBatchNorm``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gfedntm_tpu.models.activations import get_activation
+from gfedntm_tpu.models.initializers import xavier_uniform_2d
+from gfedntm_tpu.models.layers import MaskedBatchNorm, TorchDense
+
+
+class TopicModelOutput(NamedTuple):
+    """Forward outputs; mirrors the reference forward's return tuple
+    (``decoder_network.py:134-135``) plus ``theta`` for inference reuse."""
+
+    prior_mean: jax.Array
+    prior_variance: jax.Array
+    posterior_mean: jax.Array
+    posterior_variance: jax.Array
+    posterior_log_variance: jax.Array
+    word_dist: jax.Array
+    estimated_labels: jax.Array | None
+    theta: jax.Array
+
+
+class InferenceNetwork(nn.Module):
+    """BoW encoder MLP with affine-free BatchNorm mu/log-var heads."""
+
+    output_size: int
+    hidden_sizes: tuple[int, ...]
+    activation: str = "softplus"
+    dropout: float = 0.2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool, mask=None):
+        act = get_activation(self.activation)
+        x = TorchDense(self.hidden_sizes[0], name="input_layer", dtype=self.dtype)(x)
+        x = act(x)
+        for i, h_out in enumerate(self.hidden_sizes[1:]):
+            x = TorchDense(h_out, name=f"hiddens_l{i}", dtype=self.dtype)(x)
+            x = act(x)
+        x = nn.Dropout(self.dropout, name="dropout_enc")(x, deterministic=not train)
+        mu = MaskedBatchNorm(name="f_mu_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_mu", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        log_sigma = MaskedBatchNorm(name="f_sigma_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_sigma", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        return mu, log_sigma
+
+
+class ContextualInferenceNetwork(nn.Module):
+    """ZeroShotTM encoder: consumes only the contextual (SBERT) embedding
+    (+ optional one-hot labels). Reference: ``ctm_network/inference_network.py:64-94``.
+    (The reference's ``if labels:`` tensor-truthiness bug is fixed to the
+    intended ``labels is not None`` concat.)"""
+
+    output_size: int
+    hidden_sizes: tuple[int, ...]
+    activation: str = "softplus"
+    dropout: float = 0.2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x_bow, x_ctx, labels=None, *, train: bool, mask=None):
+        act = get_activation(self.activation)
+        x = x_ctx
+        if labels is not None:
+            x = jnp.concatenate([x_ctx, labels], axis=1)
+        x = TorchDense(self.hidden_sizes[0], name="input_layer", dtype=self.dtype)(x)
+        x = act(x)
+        for i, h_out in enumerate(self.hidden_sizes[1:]):
+            x = TorchDense(h_out, name=f"hiddens_l{i}", dtype=self.dtype)(x)
+            x = act(x)
+        x = nn.Dropout(self.dropout, name="dropout_enc")(x, deterministic=not train)
+        mu = MaskedBatchNorm(name="f_mu_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_mu", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        log_sigma = MaskedBatchNorm(name="f_sigma_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_sigma", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        return mu, log_sigma
+
+
+class CombinedInferenceNetwork(nn.Module):
+    """CombinedTM encoder: projects SBERT down to V (``adapt_bert``), concats
+    with the BoW vector (+ labels). Reference: ``inference_network.py:160-193``."""
+
+    input_size: int  # vocabulary size V
+    output_size: int
+    hidden_sizes: tuple[int, ...]
+    activation: str = "softplus"
+    dropout: float = 0.2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x_bow, x_ctx, labels=None, *, train: bool, mask=None):
+        act = get_activation(self.activation)
+        x_ctx = TorchDense(self.input_size, name="adapt_bert", dtype=self.dtype)(x_ctx)
+        x = jnp.concatenate([x_bow, x_ctx], axis=1)
+        if labels is not None:
+            x = jnp.concatenate([x, labels], axis=1)
+        x = TorchDense(self.hidden_sizes[0], name="input_layer", dtype=self.dtype)(x)
+        x = act(x)
+        for i, h_out in enumerate(self.hidden_sizes[1:]):
+            x = TorchDense(h_out, name=f"hiddens_l{i}", dtype=self.dtype)(x)
+            x = act(x)
+        x = nn.Dropout(self.dropout, name="dropout_enc")(x, deterministic=not train)
+        mu = MaskedBatchNorm(name="f_mu_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_mu", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        log_sigma = MaskedBatchNorm(name="f_sigma_batchnorm", dtype=self.dtype)(
+            TorchDense(self.output_size, name="f_sigma", dtype=self.dtype)(x),
+            use_running_average=not train,
+            mask=mask,
+        )
+        return mu, log_sigma
+
+
+class DecoderNetwork(nn.Module):
+    """VAE topic model: encoder -> logistic-normal reparam -> theta -> decoder.
+
+    ``inference_type`` selects the encoder family:
+    - ``"bow"``      -> AVITM (``decoder_network.py``)
+    - ``"zeroshot"`` -> ZeroShotTM (``decoding_network.py`` + contextual encoder)
+    - ``"combined"`` -> CombinedTM
+
+    ``model_type``: ``"prodLDA"`` decodes ``softmax(BN(theta @ beta))`` with the
+    *unnormalized* beta as the topic-word matrix; ``"LDA"`` decodes
+    ``theta @ softmax(BN(beta))`` (``decoder_network.py:121-132``).
+
+    Priors follow the Laplace approximation of Dirichlet(alpha=1):
+    mean 0, variance 1 - 1/K, learnable when ``learn_priors``
+    (``decoder_network.py:70-89``).
+    """
+
+    input_size: int
+    n_components: int = 10
+    model_type: str = "prodLDA"
+    hidden_sizes: tuple[int, ...] = (100, 100)
+    activation: str = "softplus"
+    dropout: float = 0.2
+    learn_priors: bool = True
+    topic_prior_mean: float = 0.0
+    topic_prior_variance: float | None = None
+    inference_type: str = "bow"
+    contextual_size: int = 0
+    label_size: int = 0
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        if self.inference_type == "bow":
+            self.inf_net = InferenceNetwork(
+                self.n_components,
+                self.hidden_sizes,
+                self.activation,
+                self.dropout,
+                dtype=self.dtype,
+            )
+        elif self.inference_type == "zeroshot":
+            self.inf_net = ContextualInferenceNetwork(
+                self.n_components,
+                self.hidden_sizes,
+                self.activation,
+                self.dropout,
+                dtype=self.dtype,
+            )
+        elif self.inference_type == "combined":
+            self.inf_net = CombinedInferenceNetwork(
+                self.input_size,
+                self.n_components,
+                self.hidden_sizes,
+                self.activation,
+                self.dropout,
+                dtype=self.dtype,
+            )
+        else:
+            raise ValueError(
+                "inference_type must be 'bow', 'zeroshot' or 'combined', "
+                f"got {self.inference_type!r}"
+            )
+
+        k = self.n_components
+        prior_var_value = (
+            1.0 - (1.0 / k)
+            if self.topic_prior_variance is None
+            else float(self.topic_prior_variance)
+        )
+        if self.learn_priors:
+            self.prior_mean = self.param(
+                "prior_mean",
+                lambda _key, shape: jnp.full(shape, self.topic_prior_mean, jnp.float32),
+                (k,),
+            )
+            self.prior_variance = self.param(
+                "prior_variance",
+                lambda _key, shape: jnp.full(shape, prior_var_value, jnp.float32),
+                (k,),
+            )
+        else:
+            self.prior_mean = jnp.full((k,), self.topic_prior_mean, jnp.float32)
+            self.prior_variance = jnp.full((k,), prior_var_value, jnp.float32)
+
+        self.beta = self.param(
+            "beta", xavier_uniform_2d, (self.n_components, self.input_size)
+        )
+        self.beta_batchnorm = MaskedBatchNorm(dtype=self.dtype)
+        self.drop_theta = nn.Dropout(self.dropout)
+        if self.label_size > 0:
+            self.label_classification = TorchDense(
+                self.label_size, dtype=self.dtype
+            )
+
+    def _encode(self, x_bow, x_ctx, labels, *, train: bool, mask):
+        if self.inference_type == "bow":
+            return self.inf_net(x_bow, train=train, mask=mask)
+        return self.inf_net(x_bow, x_ctx, labels, train=train, mask=mask)
+
+    def __call__(
+        self, x_bow, x_ctx=None, labels=None, *, train: bool, mask=None, noise=None
+    ) -> TopicModelOutput:
+        prior_mean, prior_variance = self.prior_mean, self.prior_variance
+        posterior_mu, posterior_log_sigma = self._encode(
+            x_bow, x_ctx, labels, train=train, mask=mask
+        )
+        posterior_sigma = jnp.exp(posterior_log_sigma)
+
+        # Reparameterization trick (decoder_network.py:102-107); the reference
+        # samples in eval mode too, so the rng is drawn unconditionally.
+        # ``noise`` injects a fixed eps (parity tests / deterministic eval).
+        std = jnp.exp(0.5 * posterior_log_sigma)
+        eps = (
+            noise
+            if noise is not None
+            else jax.random.normal(self.make_rng("reparam"), std.shape, dtype=std.dtype)
+        )
+        theta = jax.nn.softmax(posterior_mu + eps * std, axis=1)
+        theta = self.drop_theta(theta, deterministic=not train)
+
+        if self.model_type.lower() == "prodlda":
+            word_dist = jax.nn.softmax(
+                self.beta_batchnorm(
+                    jnp.dot(theta, self.beta.astype(self.dtype)),
+                    use_running_average=not train,
+                    mask=mask,
+                ),
+                axis=1,
+            )
+        elif self.model_type.lower() == "lda":
+            # BN over beta's topic axis; no sample mask applies (decoder_network.py:129).
+            beta_sm = jax.nn.softmax(
+                self.beta_batchnorm(
+                    self.beta.astype(self.dtype), use_running_average=not train
+                ),
+                axis=1,
+            )
+            word_dist = jnp.dot(theta, beta_sm)
+        else:
+            raise ValueError("model_type must be 'prodLDA' or 'LDA'")
+
+        estimated_labels = None
+        if labels is not None and self.label_size > 0:
+            estimated_labels = self.label_classification(theta)
+
+        return TopicModelOutput(
+            prior_mean=prior_mean,
+            prior_variance=prior_variance,
+            posterior_mean=posterior_mu,
+            posterior_variance=posterior_sigma,
+            posterior_log_variance=posterior_log_sigma,
+            word_dist=word_dist,
+            estimated_labels=estimated_labels,
+            theta=theta,
+        )
+
+    def get_theta(self, x_bow, x_ctx=None, labels=None):
+        """MC-sample theta without touching BatchNorm stats or dropout
+        (``decoder_network.py:137-147``: eval forward + fresh reparam draw)."""
+        posterior_mu, posterior_log_sigma = self._encode(
+            x_bow, x_ctx, labels, train=False, mask=None
+        )
+        std = jnp.exp(0.5 * posterior_log_sigma)
+        eps = jax.random.normal(
+            self.make_rng("reparam"), std.shape, dtype=std.dtype
+        )
+        return jax.nn.softmax(posterior_mu + eps * std, axis=1)

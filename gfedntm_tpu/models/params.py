@@ -1,0 +1,126 @@
+"""Mapping between reference torch state-dict keys and Flax variable paths.
+
+The reference selects which tensors federate via a CSV of torch state-dict
+keys (``config/dft_params.cf:50``, consumed by
+``federated_model.py:98-131``). Here the same key strings select leaves of
+the Flax variable tree ``{"params": ..., "batch_stats": ...}``, yielding a
+boolean *share mask* pytree that the federated all-reduce applies
+(SURVEY.md §2.3: "the ModelUpdate-proto concept maps to a pytree mask").
+
+Key grammar translated:
+- ``inf_net.input_layer.weight``          -> params/inf_net/input_layer/kernel
+- ``inf_net.hiddens.l_0.0.weight``        -> params/inf_net/hiddens_l0/kernel
+- ``inf_net.f_mu_batchnorm.running_mean`` -> batch_stats/inf_net/f_mu_batchnorm/running_mean
+- ``beta`` / ``prior_mean`` / ``prior_variance`` -> params/<name>
+(torch ``weight`` [out,in] corresponds to flax ``kernel`` [in,out]; the mask
+operates on whole leaves so the transpose is irrelevant here.)
+
+Keys that don't exist for the current model are skipped: the reference's
+shipped default list includes ``inf_net.adapt_bert.*`` (CTM-CombinedTM-only)
+which would KeyError for AVITM in the reference (``federated_model.py:113``,
+latent bug §2.5) — intended semantics is "share what exists".
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax
+
+from gfedntm_tpu.config import SHARE_ALL
+
+_HIDDENS_RE = re.compile(r"^hiddens\.l_(\d+)\.0\.(weight|bias)$")
+
+
+def _translate_tail(tail: str) -> tuple[str, tuple[str, ...]] | None:
+    """Translate a torch key tail (after module prefixes) into
+    (collection, path-components)."""
+    m = _HIDDENS_RE.match(tail)
+    if m:
+        idx, leaf = m.groups()
+        return "params", (f"hiddens_l{idx}", "kernel" if leaf == "weight" else "bias")
+    parts = tail.split(".")
+    leaf = parts[-1]
+    if leaf in ("running_mean", "running_var", "num_batches_tracked"):
+        return "batch_stats", tuple(parts)
+    if leaf == "weight":
+        return "params", tuple(parts[:-1] + ["kernel"])
+    if leaf == "bias":
+        return "params", tuple(parts[:-1] + ["bias"])
+    # bare parameter names: beta, prior_mean, prior_variance
+    return "params", tuple(parts)
+
+
+def reference_key_to_path(key: str) -> tuple[str, tuple[str, ...]]:
+    """Map one reference state-dict key to (collection, path) in the Flax
+    variable tree. ``inf_net.`` prefixes pass through as module names."""
+    if key.startswith("inf_net."):
+        tail = key[len("inf_net."):]
+        col, path = _translate_tail(tail)
+        return col, ("inf_net",) + path
+    col, path = _translate_tail(key)
+    return col, path
+
+
+def _leaf_paths(tree: Any) -> list[tuple[tuple[str, ...], Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for keypath, leaf in flat:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        out.append((tuple(parts), leaf))
+    return out
+
+
+def build_share_mask(
+    variables: Mapping[str, Any], grads_to_share: tuple[str, ...]
+) -> Any:
+    """Build a {collection: pytree-of-bool} mask with the same structure as
+    ``variables`` (only 'params' and 'batch_stats' collections are eligible).
+
+    ``SHARE_ALL`` marks every leaf shared — the operative reference default,
+    which lists the full 22-key state (dft_params.cf:50).
+    """
+    share_all = tuple(grads_to_share) == tuple(SHARE_ALL)
+    wanted: set[tuple[str, tuple[str, ...]]] = set()
+    if not share_all:
+        for key in grads_to_share:
+            wanted.add(reference_key_to_path(key))
+
+    def mask_collection(col_name: str, tree: Any) -> Any:
+        paths = [p for p, _ in _leaf_paths(tree)]
+        flags = [share_all or ((col_name, p) in wanted) for p in paths]
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        assert len(leaves) == len(flags)
+        return jax.tree_util.tree_unflatten(treedef, flags)
+
+    return {
+        col: mask_collection(col, tree)
+        for col, tree in variables.items()
+        if col in ("params", "batch_stats")
+    }
+
+
+def unmatched_keys(
+    variables: Mapping[str, Any], grads_to_share: tuple[str, ...]
+) -> list[str]:
+    """Reference keys that matched no leaf (for logging/validation)."""
+    if tuple(grads_to_share) == tuple(SHARE_ALL):
+        return []
+    have: set[tuple[str, tuple[str, ...]]] = set()
+    for col in ("params", "batch_stats"):
+        if col in variables:
+            for p, _ in _leaf_paths(variables[col]):
+                have.add((col, p))
+    missing = []
+    for key in grads_to_share:
+        if reference_key_to_path(key) not in have:
+            missing.append(key)
+    return missing

@@ -1,0 +1,34 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; the federated SPMD path is
+exercised on 8 virtual CPU devices instead (SURVEY.md §4: the reference's
+docker-compose multi-node test becomes
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` here).
+
+Env vars must be set before the first ``jax`` import, which is why this
+happens at conftest import time.
+"""
+
+import os
+
+# The runtime image pins JAX_PLATFORMS=axon via sitecustomize, so the env var
+# alone is not enough — jax.config is the authoritative override.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

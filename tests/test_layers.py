@@ -1,0 +1,108 @@
+"""MaskedBatchNorm parity vs torch nn.BatchNorm1d(affine=False)."""
+
+import jax
+import numpy as np
+import torch
+
+from gfedntm_tpu.models.layers import MaskedBatchNorm, TorchDense
+
+
+def _run_flax_bn(x_steps, train=True, mask=None):
+    bn = MaskedBatchNorm()
+    variables = bn.init(jax.random.PRNGKey(0), x_steps[0], use_running_average=False)
+    outs = []
+    for x in x_steps:
+        y, mut = bn.apply(
+            variables,
+            x,
+            use_running_average=not train,
+            mask=mask,
+            mutable=["batch_stats"],
+        )
+        variables = {**variables, **mut}
+        outs.append(np.asarray(y))
+    return outs, variables["batch_stats"]
+
+
+def test_batchnorm_train_matches_torch(rng):
+    feats = 6
+    xs = [rng.normal(size=(12, feats)).astype(np.float32) for _ in range(4)]
+    tbn = torch.nn.BatchNorm1d(feats, affine=False)
+    tbn.train()
+    t_outs = [tbn(torch.from_numpy(x)).detach().numpy() for x in xs]
+
+    f_outs, stats = _run_flax_bn(xs, train=True)
+    for f, t in zip(f_outs, t_outs):
+        np.testing.assert_allclose(f, t, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats["running_mean"]), tbn.running_mean.numpy(), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats["running_var"]), tbn.running_var.numpy(), rtol=1e-4, atol=1e-6
+    )
+    assert int(stats["num_batches_tracked"]) == int(tbn.num_batches_tracked)
+
+
+def test_batchnorm_eval_matches_torch(rng):
+    feats = 5
+    warm = [rng.normal(size=(8, feats)).astype(np.float32) for _ in range(3)]
+    x_eval = rng.normal(size=(8, feats)).astype(np.float32)
+
+    tbn = torch.nn.BatchNorm1d(feats, affine=False)
+    tbn.train()
+    for x in warm:
+        tbn(torch.from_numpy(x))
+    tbn.eval()
+    t_out = tbn(torch.from_numpy(x_eval)).detach().numpy()
+
+    bn = MaskedBatchNorm()
+    variables = bn.init(jax.random.PRNGKey(0), warm[0], use_running_average=False)
+    for x in warm:
+        _, mut = bn.apply(
+            variables, x, use_running_average=False, mutable=["batch_stats"]
+        )
+        variables = {**variables, **mut}
+    y = bn.apply(variables, x_eval, use_running_average=True)
+    np.testing.assert_allclose(np.asarray(y), t_out, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_batchnorm_equals_short_batch(rng):
+    """Padded+masked batch stats must equal torch on the unpadded batch."""
+    feats = 4
+    real, pad = 9, 16
+    x_real = rng.normal(size=(real, feats)).astype(np.float32)
+    x_pad = np.zeros((pad, feats), np.float32)
+    x_pad[:real] = x_real
+    mask = np.zeros(pad, np.float32)
+    mask[:real] = 1.0
+
+    tbn = torch.nn.BatchNorm1d(feats, affine=False)
+    tbn.train()
+    t_out = tbn(torch.from_numpy(x_real)).detach().numpy()
+
+    outs, stats = _run_flax_bn([x_pad], train=True, mask=mask)
+    np.testing.assert_allclose(outs[0][:real], t_out, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats["running_mean"]), tbn.running_mean.numpy(), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats["running_var"]), tbn.running_var.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_torch_dense_matches_torch_linear(rng):
+    """Same weights -> same outputs (kernel is torch weight transposed)."""
+    lin = torch.nn.Linear(7, 3)
+    x = rng.normal(size=(5, 7)).astype(np.float32)
+    t_out = lin(torch.from_numpy(x)).detach().numpy()
+
+    dense = TorchDense(3)
+    variables = dense.init(jax.random.PRNGKey(0), x)
+    variables = {
+        "params": {
+            "kernel": lin.weight.detach().numpy().T,
+            "bias": lin.bias.detach().numpy(),
+        }
+    }
+    y = dense.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), t_out, rtol=1e-5, atol=1e-6)
