@@ -1,0 +1,80 @@
+"""Benchmark: 5-client federated ProdLDA throughput on one TPU chip.
+
+Regime: the reference's federated defaults — 5 clients, K=50 topics,
+V=5000 synthetic vocabulary, hidden (50,50), batch 64, Adam(lr 2e-3,
+betas=(0.99, 0.99)) — i.e. BASELINE.md's simulation/federation config.
+
+Baseline: the reference's hot loop has a hard orchestration floor of
+>= 3 s sleep per client per global step plus 2N fresh-channel gRPC
+round-trips (``src/federation/server.py:417-420,449,472,515``), so with 5
+clients one global step (5 x 64 = 320 documents) takes >= 15 s:
+**<= 21.33 docs/s** before any model math. This framework runs the whole
+federation as one compiled SPMD program, so its throughput is model-math
+bound instead.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+    from gfedntm_tpu.models.avitm import AVITM
+
+    n_clients, vocab, k, batch = 5, 5000, 50, 64
+    docs_per_node = 2000
+    corpus = generate_synthetic_corpus(
+        vocab_size=vocab, n_topics=k, n_docs=docs_per_node, nwords=(150, 250),
+        n_nodes=n_clients, frozen_topics=5, seed=0, materialize_docs=False,
+    )
+    idx2token = {i: f"wd{i}" for i in range(vocab)}
+    datasets = [
+        BowDataset(X=node.bow, idx2token=idx2token) for node in corpus.nodes
+    ]
+
+    epochs = 4
+    template = AVITM(
+        input_size=vocab, n_components=k, hidden_sizes=(50, 50),
+        batch_size=batch, num_epochs=epochs, lr=2e-3, momentum=0.99,
+        seed=0,
+    )
+    trainer = FederatedTrainer(template, n_clients=n_clients)
+
+    # Warmup fit: compiles the whole-run program.
+    warm = trainer.fit(datasets)
+    assert np.isfinite(warm.losses).all()
+
+    # Timed fit: same shapes -> jit cache hit; measures steady-state.
+    t0 = time.perf_counter()
+    result = trainer.fit(datasets)
+    jax.block_until_ready(result.client_params)
+    elapsed = time.perf_counter() - t0
+
+    global_steps = result.losses.shape[0]
+    docs_processed = float(global_steps) * n_clients * batch
+    docs_per_sec = docs_processed / elapsed
+
+    # Reference orchestration floor: >=3 s sleep x 5 clients per global step
+    # (server.py:417-420,472) -> <= 320 docs / 15 s.
+    baseline_docs_per_sec = n_clients * batch / (3.0 * n_clients)
+
+    print(json.dumps({
+        "metric": "federated_prodlda_5client_throughput",
+        "value": round(docs_per_sec, 1),
+        "unit": "docs/s",
+        "vs_baseline": round(docs_per_sec / baseline_docs_per_sec, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,32 @@
-
+from gfedntm_tpu.data import datasets as datasets
+from gfedntm_tpu.data import loaders as loaders
+from gfedntm_tpu.data import synthetic as synthetic
+from gfedntm_tpu.data import vocab as vocab
+from gfedntm_tpu.data.datasets import (
+    BowDataset,
+    CTMDataset,
+    EpochSchedule,
+    make_epoch_schedule,
+    make_run_schedule,
+    train_val_split,
+)
+from gfedntm_tpu.data.loaders import (
+    RawCorpus,
+    load_20newsgroups,
+    load_parquet_corpus,
+    partition_corpus,
+)
+from gfedntm_tpu.data.synthetic import (
+    SyntheticCorpus,
+    SyntheticNode,
+    generate_synthetic_corpus,
+    load_reference_npz,
+    save_reference_npz,
+)
+from gfedntm_tpu.data.vocab import (
+    Vocabulary,
+    build_vocabulary,
+    tokenize,
+    union_vocabularies,
+    vectorize,
+)

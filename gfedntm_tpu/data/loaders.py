@@ -1,0 +1,105 @@
+"""Corpus loaders: reference npz/parquet formats + 20Newsgroups.
+
+Mirrors the reference entry point's data paths (``main.py:138-152``):
+- synthetic ``.npz`` archives (see ``gfedntm_tpu.data.synthetic``),
+- real ``.parquet`` corpora with a text column, optional ``fos``
+  category filter, and optional precomputed SBERT ``embeddings`` column
+  (``client.py:321-356`` pulls the embeddings column for CTM).
+- 20Newsgroups (the BASELINE.json config-3 corpus) from a local scikit-learn
+  cache or an explicit path; this environment has no network egress, so no
+  download is attempted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class RawCorpus:
+    """Host-side corpus: raw text plus optional per-doc extras."""
+
+    documents: list[str]
+    embeddings: np.ndarray | None = None  # [n_docs, contextual_size]
+    labels: np.ndarray | None = None  # [n_docs] int or [n_docs, L] one-hot
+
+    def __len__(self) -> int:
+        return len(self.documents)
+
+
+def load_parquet_corpus(
+    path: str,
+    text_column: str = "all_rawtext",
+    fos: str | None = None,
+    fos_column: str = "fos",
+    embeddings_column: str = "embeddings",
+    max_docs: int | None = None,
+) -> RawCorpus:
+    """Read a reference-format parquet corpus, optionally filtered to one
+    ``fos`` category (``main.py:147-152``)."""
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    if fos is not None:
+        df = df[df[fos_column] == fos]
+    if max_docs is not None:
+        df = df.head(max_docs)
+    if text_column not in df.columns:
+        # fall back to the first string-typed column
+        candidates = [c for c in df.columns if df[c].dtype == object]
+        if not candidates:
+            raise ValueError(f"no text column found in {path}")
+        text_column = candidates[0]
+    docs = df[text_column].astype(str).tolist()
+    embeddings = None
+    if embeddings_column in df.columns:
+        embeddings = np.stack(
+            [np.asarray(e, dtype=np.float32) for e in df[embeddings_column]]
+        )
+    return RawCorpus(documents=docs, embeddings=embeddings)
+
+
+def load_20newsgroups(
+    data_home: str | None = None, subset: str = "train"
+) -> RawCorpus:
+    """Load 20Newsgroups from a local sklearn cache (no download)."""
+    from sklearn.datasets import fetch_20newsgroups
+
+    bunch = fetch_20newsgroups(
+        subset=subset,
+        data_home=data_home,
+        remove=("headers", "footers", "quotes"),
+        download_if_missing=False,
+    )
+    return RawCorpus(
+        documents=list(bunch.data), labels=np.asarray(bunch.target)
+    )
+
+
+def partition_corpus(
+    corpus: RawCorpus, n_clients: int, seed: int = 0, iid: bool = True
+) -> list[RawCorpus]:
+    """Split one corpus into per-client shards. ``iid=True`` shuffles then
+    chunks evenly; ``iid=False`` sorts by label first (label-skewed non-IID,
+    the collab_vs_non_collab regime of fos-partitioned corpora)."""
+    n = len(corpus)
+    rng = np.random.default_rng(seed)
+    if iid or corpus.labels is None:
+        order = rng.permutation(n)
+    else:
+        order = np.argsort(np.asarray(corpus.labels), kind="stable")
+    shards = np.array_split(order, n_clients)
+    out = []
+    for shard in shards:
+        out.append(
+            RawCorpus(
+                documents=[corpus.documents[i] for i in shard],
+                embeddings=None
+                if corpus.embeddings is None
+                else corpus.embeddings[shard],
+                labels=None if corpus.labels is None else np.asarray(corpus.labels)[shard],
+            )
+        )
+    return out

@@ -1,0 +1,161 @@
+"""Synthetic LDA corpus generator (vectorized, seedable).
+
+Rebuild of ``src/utils/generate_synthetic.py:1-96`` and the generator inside
+``experiments/dss_tss/run_simulation.py:77-181``: documents are drawn from a
+known LDA generative model so ground-truth topic-word (``topic_vectors``) and
+doc-topic (``doc_topics``) distributions are available for recovery tests
+(TSS/DSS — the reference's de-facto correctness metric, SURVEY.md §4.1).
+
+Node priors: ``frozen_topics`` shared topics get alpha each; each node
+additionally owns ``(K - frozen)/n_nodes`` topics at alpha with the rest
+suppressed at alpha/10000, rotating per node
+(``generate_synthetic.py:42-60``).
+
+The reference samples word-by-word in Python (~minutes); here each document's
+BoW is drawn as topic-count multinomial then per-topic word multinomials —
+identical distribution, vectorized over documents."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class SyntheticNode:
+    """One client's corpus with its ground truth."""
+
+    bow: np.ndarray  # [n_docs, V] counts
+    documents: list[str]  # whitespace-joined token strings ('wd17 wd5 ...')
+    doc_topics: np.ndarray  # [n_docs, K] ground-truth theta
+
+
+@dataclass
+class SyntheticCorpus:
+    topic_vectors: np.ndarray  # [K, V] ground-truth beta
+    nodes: list[SyntheticNode]
+    vocab_tokens: tuple[str, ...] = field(default_factory=tuple)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.nodes)
+
+
+def _rotate(arr: list[float], d: int) -> list[float]:
+    """Left-rotate by d (generate_synthetic.py:3-31)."""
+    d = d % max(len(arr), 1)
+    return arr[d:] + arr[:d]
+
+
+def generate_synthetic_corpus(
+    vocab_size: int = 5000,
+    n_topics: int = 50,
+    beta: float = 1e-2,
+    alpha: float | None = None,
+    n_docs: int = 1000,
+    nwords: tuple[int, int] = (150, 250),
+    n_nodes: int = 5,
+    frozen_topics: int = 5,
+    seed: int = 0,
+    materialize_docs: bool = True,
+) -> SyntheticCorpus:
+    """Generate per-node corpora from the LDA generative model.
+
+    Defaults mirror ``generate_synthetic.py:33-46``. ``alpha`` defaults to
+    1/n_topics. ``materialize_docs=False`` skips building the token-string
+    documents (BoW only — much faster for large benchmark corpora).
+    """
+    rng = np.random.default_rng(seed)
+    alpha = 1.0 / n_topics if alpha is None else alpha
+
+    # Step 1: topic-word distributions ~ Dirichlet(beta) (line 50).
+    topic_vectors = rng.dirichlet(np.full(vocab_size, beta), n_topics)
+
+    prior_frozen = [alpha] * frozen_topics
+    own = (n_topics - frozen_topics) // max(n_nodes, 1)
+    prior_nofrozen = [alpha] * own + [alpha / 10000.0] * (
+        n_topics - frozen_topics - own
+    )
+
+    nodes = []
+    for _node in range(n_nodes):
+        # Step 2: per-node doc-topic proportions (lines 56-60).
+        doc_topics = rng.dirichlet(np.array(prior_frozen + prior_nofrozen), n_docs)
+        prior_nofrozen = _rotate(prior_nofrozen, own)
+
+        # Step 3: documents — vectorized equivalent of lines 62-79.
+        doc_lens = rng.integers(nwords[0], nwords[1], size=n_docs)
+        bow = np.zeros((n_docs, vocab_size), dtype=np.float32)
+        docs = []
+        for d in range(n_docs):
+            topic_counts = rng.multinomial(doc_lens[d], doc_topics[d])
+            for k in np.nonzero(topic_counts)[0]:
+                bow[d] += rng.multinomial(topic_counts[k], topic_vectors[k])
+            if materialize_docs:
+                word_ids = np.repeat(
+                    np.arange(vocab_size), bow[d].astype(np.int64)
+                )
+                docs.append(" ".join(f"wd{w}" for w in word_ids))
+        nodes.append(SyntheticNode(bow=bow, documents=docs, doc_topics=doc_topics))
+
+    vocab_tokens = tuple(f"wd{i}" for i in range(vocab_size))
+    return SyntheticCorpus(
+        topic_vectors=topic_vectors, nodes=nodes, vocab_tokens=vocab_tokens
+    )
+
+
+def save_reference_npz(corpus: SyntheticCorpus, path: str, **meta) -> None:
+    """Write the combined-archive format of ``synthetic_all_nodes.npz``
+    (generate_synthetic.py:95-96) so reference tooling can read it."""
+    np.savez(
+        path,
+        n_nodes=corpus.n_nodes,
+        vocab_size=corpus.topic_vectors.shape[1],
+        n_topics=corpus.topic_vectors.shape[0],
+        topic_vectors=corpus.topic_vectors,
+        doc_topics=np.array([n.doc_topics for n in corpus.nodes]),
+        documents=np.array(
+            [n.documents for n in corpus.nodes], dtype=object
+        ),
+        **meta,
+    )
+
+
+def load_reference_npz(path: str) -> SyntheticCorpus:
+    """Load a reference-format synthetic archive (single- or multi-node):
+    keys ``topic_vectors``, ``doc_topics``, ``documents``
+    (``main.py:138-146`` reads the same keys)."""
+    with np.load(path, allow_pickle=True) as z:
+        topic_vectors = z["topic_vectors"]
+        docs = z["documents"]
+        doc_topics = z["doc_topics"]
+        vocab_size = int(z["vocab_size"]) if "vocab_size" in z else topic_vectors.shape[1]
+    if docs.ndim == 1 and isinstance(docs[0], str):  # single node
+        docs = docs[None, :]
+        doc_topics = doc_topics[None, ...]
+    nodes = []
+    for i in range(len(docs)):
+        node_docs = [
+            d if isinstance(d, str) else " ".join(d) for d in list(docs[i])
+        ]
+        nodes.append(
+            SyntheticNode(
+                bow=_bow_from_wd_docs(node_docs, vocab_size),
+                documents=node_docs,
+                doc_topics=np.asarray(doc_topics[i]),
+            )
+        )
+    return SyntheticCorpus(
+        topic_vectors=topic_vectors,
+        nodes=nodes,
+        vocab_tokens=tuple(f"wd{i}" for i in range(vocab_size)),
+    )
+
+
+def _bow_from_wd_docs(docs: list[str], vocab_size: int) -> np.ndarray:
+    bow = np.zeros((len(docs), vocab_size), dtype=np.float32)
+    for i, doc in enumerate(docs):
+        for tok in doc.split():
+            bow[i, int(tok[2:])] += 1
+    return bow

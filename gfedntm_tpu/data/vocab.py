@@ -1,0 +1,120 @@
+"""Vocabulary building and BoW vectorization (CountVectorizer semantics).
+
+The reference builds client vocabularies and vectorizes corpora with sklearn's
+``CountVectorizer`` (``client.py:358-376``, ``server.py:282-288``,
+``pytorchavitm/utils/data_preparation.py:30-40``). This module reimplements
+the exact semantics needed — lowercase, ``\\b\\w\\w+\\b`` token pattern,
+optional english stop words, ``max_features`` by corpus frequency with
+alphabetical tie-ordering — so the framework has no hard sklearn dependency
+in its core path, plus an optional C++ fast path (``gfedntm_tpu.ops.native``)
+for tokenizing+counting large corpora on host.
+
+Vocabulary-consensus helpers mirror ``server.py:270-288``: the global
+vocabulary is the sorted set-union of client vocabularies.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"(?u)\b\w\w+\b")
+
+try:  # the canonical english stop-word list; vendored fallback not needed
+    from sklearn.feature_extraction.text import ENGLISH_STOP_WORDS as _SK_STOP
+except Exception:  # pragma: no cover
+    _SK_STOP = frozenset()
+
+
+def get_stop_words(name: str | None) -> frozenset[str]:
+    if name is None:
+        return frozenset()
+    if name == "english":
+        return frozenset(_SK_STOP)
+    raise ValueError(f"unknown stop_words {name!r}")
+
+
+def tokenize(doc: str, lowercase: bool = True) -> list[str]:
+    """sklearn default analyzer: lowercase + ``(?u)\\b\\w\\w+\\b``."""
+    if lowercase:
+        doc = doc.lower()
+    return _TOKEN_RE.findall(doc)
+
+
+@dataclass
+class Vocabulary:
+    """An ordered token->id map plus its inverse."""
+
+    tokens: tuple[str, ...]
+
+    def __post_init__(self):
+        self.token2id = {t: i for i, t in enumerate(self.tokens)}
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def id2token(self) -> dict[int, str]:
+        return dict(enumerate(self.tokens))
+
+    def __contains__(self, token: str) -> bool:
+        return token in self.token2id
+
+
+def build_vocabulary(
+    corpus: Iterable[str],
+    max_features: int | None = None,
+    stop_words: str | None = None,
+    lowercase: bool = True,
+) -> Vocabulary:
+    """Fit a vocabulary with CountVectorizer semantics.
+
+    With ``max_features``, keep the most frequent terms (ties broken
+    alphabetically, as sklearn's stable sort over the alphabetical vocab
+    does), then order the kept terms alphabetically.
+    """
+    stops = get_stop_words(stop_words)
+    counts: dict[str, int] = {}
+    for doc in corpus:
+        for tok in tokenize(doc, lowercase):
+            if tok not in stops:
+                counts[tok] = counts.get(tok, 0) + 1
+    terms = sorted(counts)
+    if max_features is not None and len(terms) > max_features:
+        # sklearn's _limit_features: keep argsort(-term_freqs)[:k] over the
+        # alphabetical vocabulary (numpy's default introsort — ties resolve
+        # exactly as sklearn's do), then features stay in alphabetical order.
+        tfs = np.array([counts[t] for t in terms])
+        keep = np.sort(np.argsort(-tfs, kind="quicksort")[:max_features])
+        terms = [terms[i] for i in keep]
+    return Vocabulary(tuple(terms))
+
+
+def vectorize(
+    corpus: Sequence[str],
+    vocab: Vocabulary,
+    lowercase: bool = True,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Dense document-term count matrix [n_docs, len(vocab)] against a FIXED
+    vocabulary (``client.py:460-468``: local docs x global vocab)."""
+    token2id = vocab.token2id
+    n_docs, n_terms = len(corpus), len(vocab)
+    X = np.zeros((n_docs, n_terms), dtype=dtype)
+    for i, doc in enumerate(corpus):
+        for tok in tokenize(doc, lowercase):
+            j = token2id.get(tok)
+            if j is not None:
+                X[i, j] += 1
+    return X
+
+
+def union_vocabularies(vocabs: Sequence[Vocabulary]) -> Vocabulary:
+    """Vocabulary consensus: sorted set-union (``server.py:270-279``)."""
+    merged: set[str] = set()
+    for v in vocabs:
+        merged.update(v.tokens)
+    return Vocabulary(tuple(sorted(merged)))

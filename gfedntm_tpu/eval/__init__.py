@@ -1,1 +1,11 @@
-
+from gfedntm_tpu.eval import metrics as metrics
+from gfedntm_tpu.eval.metrics import (
+    convert_topic_word_to_init_size,
+    document_similarity_score,
+    inverted_rbo,
+    npmi_coherence,
+    random_baseline_tss,
+    rbo,
+    topic_diversity,
+    topic_similarity_score,
+)

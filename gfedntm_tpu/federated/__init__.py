@@ -1,1 +1,8 @@
-
+from gfedntm_tpu.federated import consensus as consensus
+from gfedntm_tpu.federated import trainer as trainer
+from gfedntm_tpu.federated.consensus import ConsensusResult, run_vocab_consensus
+from gfedntm_tpu.federated.trainer import (
+    FederatedResult,
+    FederatedTrainer,
+    build_federated_program,
+)

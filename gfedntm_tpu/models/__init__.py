@@ -3,6 +3,9 @@ from gfedntm_tpu.models import initializers as initializers
 from gfedntm_tpu.models import layers as layers
 from gfedntm_tpu.models import losses as losses
 from gfedntm_tpu.models import networks as networks
+from gfedntm_tpu.models import params as params
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.models.ctm import CTM, CombinedTM, ZeroShotTM
 from gfedntm_tpu.models.networks import (
     CombinedInferenceNetwork,
     ContextualInferenceNetwork,
@@ -12,14 +15,19 @@ from gfedntm_tpu.models.networks import (
 )
 
 __all__ = [
+    "AVITM",
+    "CTM",
     "CombinedInferenceNetwork",
+    "CombinedTM",
     "ContextualInferenceNetwork",
     "DecoderNetwork",
     "InferenceNetwork",
     "TopicModelOutput",
+    "ZeroShotTM",
     "activations",
     "initializers",
     "layers",
     "losses",
     "networks",
+    "params",
 ]

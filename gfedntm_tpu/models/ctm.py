@@ -1,0 +1,174 @@
+"""CTM trainers: ZeroShotTM / CombinedTM (contextualized topic models).
+
+TPU-native rebuild of
+``src/models/base/contextualized_topic_models/ctm_network/ctm.py:20-807``.
+``CTM`` shares the AVITM training loop (same ELBO skeleton; loss combined as
+``weights["beta"]*KL + RL`` + optional label CE, ``ctm.py:286-296``) and adds
+the contextual-embedding data path plus CTM-specific inspection APIs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from gfedntm_tpu.data.datasets import CTMDataset
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.models.networks import DecoderNetwork
+
+
+class CTM(AVITM):
+    """Contextualized Topic Model (base; pick via ``inference_type`` or use
+    the ``ZeroShotTM`` / ``CombinedTM`` subclasses, ``ctm.py:785-807``)."""
+
+    family = "ctm"
+
+    def __init__(
+        self,
+        logger=None,
+        input_size: int = 1000,
+        contextual_size: int = 768,
+        n_components: int = 10,
+        model_type: str = "prodLDA",
+        hidden_sizes: tuple[int, ...] = (100, 100),
+        activation: str = "softplus",
+        dropout: float = 0.2,
+        learn_priors: bool = True,
+        batch_size: int = 64,
+        lr: float = 2e-3,
+        momentum: float = 0.99,
+        solver: str = "adam",
+        num_epochs: int = 100,
+        reduce_on_plateau: bool = False,
+        topic_prior_mean: float = 0.0,
+        topic_prior_variance: float | None = None,
+        num_samples: int = 10,
+        num_data_loader_workers: int = 0,
+        label_size: int = 0,
+        loss_weights: dict | None = None,
+        inference_type: str = "zeroshot",
+        verbose: bool = False,
+        seed: int = 0,
+    ):
+        assert contextual_size > 0, "contextual_size must be > 0"
+        assert inference_type in ("zeroshot", "combined")
+        self.contextual_size = contextual_size
+        self.label_size = label_size
+        self.inference_type = inference_type
+        self.weights = loss_weights if loss_weights else {"beta": 1.0}
+        super().__init__(
+            logger=logger,
+            input_size=input_size,
+            n_components=n_components,
+            model_type=model_type,
+            hidden_sizes=hidden_sizes,
+            activation=activation,
+            dropout=dropout,
+            learn_priors=learn_priors,
+            batch_size=batch_size,
+            lr=lr,
+            momentum=momentum,
+            solver=solver,
+            num_epochs=num_epochs,
+            reduce_on_plateau=reduce_on_plateau,
+            topic_prior_mean=topic_prior_mean,
+            topic_prior_variance=topic_prior_variance,
+            num_samples=num_samples,
+            num_data_loader_workers=num_data_loader_workers,
+            verbose=verbose,
+            seed=seed,
+        )
+
+    def _build_module(self) -> DecoderNetwork:
+        return DecoderNetwork(
+            input_size=self.input_size,
+            n_components=self.n_components,
+            model_type=self.model_type,
+            hidden_sizes=self.hidden_sizes,
+            activation=self.activation,
+            dropout=self.dropout,
+            learn_priors=self.learn_priors,
+            topic_prior_mean=self.topic_prior_mean,
+            topic_prior_variance=self.topic_prior_variance,
+            inference_type=self.inference_type,
+            contextual_size=self.contextual_size,
+            label_size=self.label_size,
+        )
+
+    def _contextual_size(self) -> int:
+        return self.contextual_size
+
+    def _label_size(self) -> int:
+        return self.label_size
+
+    def _beta_weight(self) -> float:
+        return float(self.weights.get("beta", 1.0))
+
+    def _device_data(self, dataset: CTMDataset) -> dict[str, Any]:
+        data = {
+            "x_bow": jnp.asarray(dataset.X),
+            "x_ctx": jnp.asarray(dataset.X_ctx),
+        }
+        if dataset.labels is not None and self.label_size > 0:
+            data["labels"] = jnp.asarray(dataset.labels)
+        return data
+
+    # ---- CTM-specific inspection APIs (ctm.py:597-775) ---------------------
+    def get_word_distribution_by_topic_id(self, topic_id: int) -> list[tuple[str, float]]:
+        """(word, probability) pairs sorted descending for one topic
+        (``ctm.py:597-618``)."""
+        if topic_id < 0 or topic_id >= self.n_components:
+            raise ValueError(f"topic_id must be in [0, {self.n_components})")
+        dist = self.get_topic_word_distribution()[topic_id]
+        idx2token = self.train_data.idx2token if self.train_data else {}
+        pairs = [
+            (idx2token.get(i, str(i)), float(p)) for i, p in enumerate(dist)
+        ]
+        return sorted(pairs, key=lambda t: -t[1])
+
+    def get_top_documents_per_topic_id(
+        self,
+        unpreprocessed_corpus: list[str],
+        document_topic_distributions: np.ndarray,
+        topic_id: int,
+        k: int = 5,
+    ) -> list[tuple[str, float]]:
+        """Top-k documents by theta mass on one topic (``ctm.py:620-646``)."""
+        probs = np.asarray(document_topic_distributions)[:, topic_id]
+        top = np.argsort(-probs)[:k]
+        return [(unpreprocessed_corpus[i], float(probs[i])) for i in top]
+
+    def get_ldavis_data_format(
+        self, vocab: list[str], dataset: CTMDataset, n_samples: int = 20
+    ) -> dict:
+        """pyLDAvis input bundle (``ctm.py:753-775``)."""
+        term_frequency = np.asarray(dataset.X).sum(axis=0)
+        doc_lengths = np.asarray(dataset.X).sum(axis=1)
+        term_topic = self.get_topic_word_distribution()
+        doc_topic = self.get_doc_topic_distribution(dataset, n_samples)
+        return {
+            "topic_term_dists": term_topic,
+            "doc_topic_dists": doc_topic,
+            "doc_lengths": doc_lengths,
+            "vocab": vocab,
+            "term_frequency": term_frequency,
+        }
+
+
+class ZeroShotTM(CTM):
+    """Contextual-only encoder: train on one language's embeddings, infer on
+    any aligned language (``ctm.py:785-799``)."""
+
+    def __init__(self, **kwargs):
+        kwargs["inference_type"] = "zeroshot"
+        super().__init__(**kwargs)
+
+
+class CombinedTM(CTM):
+    """BoW + contextual encoder (``ctm.py:801-807``)."""
+
+    def __init__(self, **kwargs):
+        kwargs["inference_type"] = "combined"
+        super().__init__(**kwargs)

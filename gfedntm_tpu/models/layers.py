@@ -90,10 +90,10 @@ class MaskedBatchNorm(nn.Module):
         else:
             reduce_axes = tuple(range(x.ndim - 1))
             if mask is None:
-                n = jnp.asarray(
-                    float(max(1, int(jnp.prod(jnp.array(x.shape[:-1]))))),
-                    jnp.float32,
-                )
+                n_static = 1
+                for dim in x.shape[:-1]:
+                    n_static *= int(dim)
+                n = jnp.asarray(float(max(1, n_static)), jnp.float32)
                 mean = jnp.mean(xf, axis=reduce_axes)
                 var_biased = jnp.mean(jnp.square(xf - mean), axis=reduce_axes)
             else:

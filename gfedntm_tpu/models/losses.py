@@ -74,11 +74,21 @@ def avitm_loss(
     return jnp.sum(loss)
 
 
-def cross_entropy_with_logits(logits: jax.Array, target_idx: jax.Array) -> jax.Array:
-    """torch ``nn.CrossEntropyLoss()`` (mean reduction) over integer targets."""
+def cross_entropy_with_logits(
+    logits: jax.Array,
+    target_idx: jax.Array,
+    sample_mask: jax.Array | None = None,
+) -> jax.Array:
+    """torch ``nn.CrossEntropyLoss()`` (mean reduction) over integer targets.
+
+    With ``sample_mask``, the mean runs over real rows only so padding rows of
+    an SPMD batch don't dilute it."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, target_idx[:, None], axis=-1)[:, 0]
-    return jnp.mean(nll)
+    if sample_mask is None:
+        return jnp.mean(nll)
+    msk = sample_mask.astype(nll.dtype)
+    return jnp.sum(nll * msk) / jnp.maximum(jnp.sum(msk), 1.0)
 
 
 def ctm_loss(
@@ -115,5 +125,7 @@ def ctm_loss(
     total = jnp.sum(loss)
     if estimated_labels is not None and labels_onehot is not None:
         targets = jnp.argmax(labels_onehot, axis=1)
-        total = total + cross_entropy_with_logits(estimated_labels, targets)
+        total = total + cross_entropy_with_logits(
+            estimated_labels, targets, sample_mask
+        )
     return total

@@ -249,8 +249,16 @@ class DecoderNetwork(nn.Module):
 
     def _encode(self, x_bow, x_ctx, labels, *, train: bool, mask):
         if self.inference_type == "bow":
-            return self.inf_net(x_bow, train=train, mask=mask)
-        return self.inf_net(x_bow, x_ctx, labels, train=train, mask=mask)
+            mu, log_sigma = self.inf_net(x_bow, train=train, mask=mask)
+        else:
+            mu, log_sigma = self.inf_net(
+                x_bow, x_ctx, labels, train=train, mask=mask
+            )
+        # Clamp keeps exp(logvar) inside float32 range for degenerate inputs
+        # (e.g. the all-masked zero batches of padding clients, whose
+        # BatchNorm rescales by 1/sqrt(eps)); |logvar| < 80 is vacuous for
+        # any real posterior, so torch parity is unaffected.
+        return mu, jnp.clip(log_sigma, -80.0, 80.0)
 
     def __call__(
         self, x_bow, x_ctx=None, labels=None, *, train: bool, mask=None, noise=None

@@ -1,1 +1,2 @@
-
+from gfedntm_tpu.parallel import mesh as mesh
+from gfedntm_tpu.parallel.mesh import make_client_mesh, stack_and_pad

@@ -1,0 +1,43 @@
+"""Client-mesh construction for the single-program federation.
+
+The reference runs one container per client plus a server (SURVEY.md §2.2);
+here the federation is one SPMD program over a ``jax.sharding.Mesh`` with a
+``clients`` axis. Clients are padded up to a multiple of the device count so
+every device owns an equal block; padding clients carry zero FedAvg weight
+and zeroed data, making them exact no-ops in the weighted all-reduce.
+
+On a single chip the mesh degenerates to size 1 and all clients run as one
+vmapped (stacked) program — the per-client MLP matmuls batch into larger MXU
+ops, which is precisely the TPU-friendly layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_client_mesh(
+    n_clients: int, devices: list | None = None, axis_name: str = "clients"
+) -> tuple[Mesh, int]:
+    """Build a 1-D mesh over min(n_devices, n_clients) devices and return it
+    with the padded client count (divisible by the mesh size)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n_used = max(1, min(len(devices), n_clients))
+    mesh = Mesh(np.array(devices[:n_used]), (axis_name,))
+    c_pad = -(-n_clients // n_used) * n_used
+    return mesh, c_pad
+
+
+def stack_and_pad(arrays: list[np.ndarray], c_pad: int) -> np.ndarray:
+    """Stack per-client arrays along a new leading axis, padding ragged doc
+    counts with zero rows and missing clients with zero blocks."""
+    n = len(arrays)
+    d_max = max(a.shape[0] for a in arrays)
+    trailing = arrays[0].shape[1:]
+    out = np.zeros((c_pad, d_max) + trailing, dtype=arrays[0].dtype)
+    for c, a in enumerate(arrays):
+        out[c, : a.shape[0]] = a
+    assert n <= c_pad
+    return out

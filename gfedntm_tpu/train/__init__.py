@@ -1,1 +1,5 @@
-
+from gfedntm_tpu.train import early_stopping as early_stopping
+from gfedntm_tpu.train import optimizers as optimizers
+from gfedntm_tpu.train import steps as steps
+from gfedntm_tpu.train.early_stopping import EarlyStopping
+from gfedntm_tpu.train.optimizers import build_optimizer

@@ -1,0 +1,35 @@
+"""Optimizer factory matching the reference's torch solvers.
+
+Reference: ``avitm.py:140-153`` / ``ctm.py:158-168`` build one of
+{adam, sgd, adagrad, adadelta, rmsprop}; Adam notably uses
+``betas=(momentum, 0.99)`` with the config default momentum=0.99
+(``dft_params.cf:15``). optax's adam matches torch's bias-corrected update
+for identical (b1, b2, eps).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def build_optimizer(
+    solver: str = "adam", lr: float = 2e-3, momentum: float = 0.99
+) -> optax.GradientTransformation:
+    solver = solver.lower()
+    if solver == "adam":
+        return optax.adam(lr, b1=momentum, b2=0.99, eps=1e-8)
+    if solver == "sgd":
+        return optax.sgd(lr, momentum=momentum)
+    if solver == "adagrad":
+        # torch Adagrad: lr_decay=0, eps=1e-10
+        return optax.adagrad(lr, eps=1e-10)
+    if solver == "adadelta":
+        # torch Adadelta defaults: rho=0.9, eps=1e-6
+        return optax.adadelta(lr, rho=0.9, eps=1e-6)
+    if solver == "rmsprop":
+        # torch RMSprop defaults: alpha=0.99, eps=1e-8
+        return optax.rmsprop(lr, decay=0.99, eps=1e-8, momentum=momentum)
+    raise ValueError(
+        "solver must be 'adam', 'adadelta', 'sgd', 'rmsprop' or 'adagrad', "
+        f"got {solver!r}"
+    )

@@ -1,0 +1,226 @@
+"""Jitted training/eval/inference programs shared by centralized and
+federated trainers.
+
+The reference's per-batch Python loop (``avitm.py:231-277``) becomes a single
+``lax.scan`` over a precomputed index schedule with the corpus resident in
+device memory — one XLA program per epoch instead of per-batch dispatch, so
+step time is dominated by the MXU matmuls, not host orchestration
+(SURVEY.md §3.3 observation (a): the reference's wall-clock is orchestration-
+bound).
+
+All functions here are *factories* closing over the model/optimizer so the
+returned callables are pure and jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from gfedntm_tpu.models.losses import avitm_loss, ctm_loss
+from gfedntm_tpu.models.networks import DecoderNetwork
+
+
+def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
+    return {k: jnp.take(v, idx, axis=0) for k, v in data.items() if v is not None}
+
+
+def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
+                rngs, train: bool):
+    """Forward + reference loss on one (padded, masked) batch."""
+    out, mutated = module.apply(
+        {"params": params, "batch_stats": batch_stats},
+        batch["x_bow"],
+        batch.get("x_ctx"),
+        batch.get("labels"),
+        train=train,
+        mask=mask if train else None,
+        mutable=["batch_stats"] if train else [],
+        rngs=rngs,
+    ) if train else (
+        module.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["x_bow"],
+            batch.get("x_ctx"),
+            batch.get("labels"),
+            train=False,
+            rngs=rngs,
+        ),
+        {"batch_stats": batch_stats},
+    )
+    # Masked (padding) rows contribute exact zeros: the network clamps
+    # posterior log-variance at the source (DecoderNetwork._encode), so every
+    # per-row loss term is finite and `loss * mask` has finite gradients even
+    # for the all-masked zero batches of padding clients.
+    m = mask.astype(jnp.float32)
+    if family == "avitm":
+        loss = avitm_loss(
+            batch["x_bow"], out.word_dist, out.prior_mean, out.prior_variance,
+            out.posterior_mean, out.posterior_variance,
+            out.posterior_log_variance, sample_mask=m,
+        )
+    else:
+        loss = ctm_loss(
+            batch["x_bow"], out.word_dist, out.prior_mean, out.prior_variance,
+            out.posterior_mean, out.posterior_variance,
+            out.posterior_log_variance, beta_weight=beta_weight,
+            estimated_labels=out.estimated_labels,
+            labels_onehot=batch.get("labels"),
+            sample_mask=m,
+        )
+    return loss, mutated["batch_stats"]
+
+
+def build_train_epoch(
+    module: DecoderNetwork,
+    tx: optax.GradientTransformation,
+    family: str = "avitm",
+    beta_weight: float = 1.0,
+):
+    """Returns jitted ``(params, batch_stats, opt_state, data, indices, masks,
+    rng) -> (params, batch_stats, opt_state, losses[S])``.
+
+    ``data`` is a dict of device arrays ({'x_bow': [N,V], optional 'x_ctx',
+    'labels'}); ``indices``/``masks`` are [S, B] (see
+    ``data.datasets.make_epoch_schedule``).
+    """
+
+    def train_epoch(params, batch_stats, opt_state, data, indices, masks, rng):
+        def body(carry, xs):
+            params, batch_stats, opt_state = carry
+            idx, mask, i = xs
+            step_rng = jax.random.fold_in(rng, i)
+            rngs = {
+                "dropout": jax.random.fold_in(step_rng, 0),
+                "reparam": jax.random.fold_in(step_rng, 1),
+            }
+            batch = _gather_batch(data, idx)
+
+            def loss_fn(p):
+                return _batch_loss(
+                    module, family, beta_weight, p, batch_stats, batch, mask,
+                    rngs, train=True,
+                )
+
+            (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return (new_params, new_bs, new_opt), loss
+
+        steps = indices.shape[0]
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body,
+            (params, batch_stats, opt_state),
+            (indices, masks, jnp.arange(steps)),
+        )
+        return params, batch_stats, opt_state, losses
+
+    return jax.jit(train_epoch)
+
+
+def build_eval_epoch(
+    module: DecoderNetwork, family: str = "avitm", beta_weight: float = 1.0
+):
+    """Jitted validation epoch: eval-mode forward (running BN stats, fresh
+    reparam draws — ``avitm.py:295-319`` semantics), per-step summed losses."""
+
+    def eval_epoch(params, batch_stats, data, indices, masks, rng):
+        def body(carry, xs):
+            idx, mask, i = xs
+            step_rng = jax.random.fold_in(rng, i)
+            rngs = {"reparam": jax.random.fold_in(step_rng, 1)}
+            batch = _gather_batch(data, idx)
+            loss, _ = _batch_loss(
+                module, family, beta_weight, params, batch_stats, batch, mask,
+                rngs, train=False,
+            )
+            return carry, loss
+
+        steps = indices.shape[0]
+        _, losses = jax.lax.scan(
+            body, None, (indices, masks, jnp.arange(steps))
+        )
+        return losses
+
+    return jax.jit(eval_epoch)
+
+
+def build_infer_theta(module: DecoderNetwork, n_samples: int = 20):
+    """Jitted MC doc-topic inference (``avitm.py:470-523``): average of
+    ``n_samples`` reparameterized theta draws per document, batched via scan,
+    samples via vmap (all MC passes share one data load — the reference
+    re-reads the corpus n_samples times)."""
+
+    def infer(params, batch_stats, data, indices, rng):
+        variables = {"params": params, "batch_stats": batch_stats}
+
+        def body(carry, xs):
+            idx, i = xs
+            batch = _gather_batch(data, idx)
+
+            def one_sample(s):
+                return module.apply(
+                    variables,
+                    batch["x_bow"],
+                    batch.get("x_ctx"),
+                    batch.get("labels"),
+                    method=DecoderNetwork.get_theta,
+                    rngs={"reparam": jax.random.fold_in(jax.random.fold_in(rng, i), s)},
+                )
+
+            thetas = jax.vmap(one_sample)(jnp.arange(n_samples))
+            return carry, jnp.mean(thetas, axis=0)
+
+        steps = indices.shape[0]
+        _, thetas = jax.lax.scan(body, None, (indices, jnp.arange(steps)))
+        return thetas.reshape(-1, thetas.shape[-1])
+
+    return jax.jit(infer)
+
+
+def init_variables(
+    module: DecoderNetwork,
+    batch_size: int,
+    vocab_size: int,
+    contextual_size: int = 0,
+    label_size: int = 0,
+    seed: int = 0,
+):
+    """Initialize {params, batch_stats} with dummy batches (shape-only)."""
+    x_bow = jnp.zeros((batch_size, vocab_size), jnp.float32)
+    x_ctx = (
+        jnp.zeros((batch_size, contextual_size), jnp.float32)
+        if contextual_size
+        else None
+    )
+    labels = (
+        jnp.zeros((batch_size, label_size), jnp.float32) if label_size else None
+    )
+    key = jax.random.PRNGKey(seed)
+    k_param, k_rep, k_drop = jax.random.split(key, 3)
+    variables = module.init(
+        {"params": k_param, "reparam": k_rep, "dropout": k_drop},
+        x_bow,
+        x_ctx,
+        labels,
+        train=True,
+    )
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def full_batch_indices(n_docs: int, batch_size: int) -> tuple:
+    """Unshuffled padded index/mask arrays covering a dataset once
+    (inference order, DataLoader(shuffle=False) — avitm.py:489-491)."""
+    import numpy as np
+
+    steps = max(1, -(-n_docs // batch_size))
+    idx = np.zeros(steps * batch_size, dtype=np.int32)
+    idx[:n_docs] = np.arange(n_docs)
+    mask = np.zeros(steps * batch_size, dtype=bool)
+    mask[:n_docs] = True
+    return idx.reshape(steps, batch_size), mask.reshape(steps, batch_size)

@@ -1,0 +1,53 @@
+"""Model artifact serialization.
+
+Two formats:
+- ``save_variables`` / ``load_variables``: a single ``.npz`` of the Flax
+  variable tree with '/'-joined path keys — the ``.pth`` equivalent of the
+  reference's ``AVITM.save`` (``avitm.py:598-617``) without pickling.
+- ``save_model_as_npz``: the reference's final-artifact bundle of
+  betas/thetas/topics (``auxiliary_functions.py:66-99``) so downstream
+  tooling (notebooks, WMD eval) reads the same schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+
+def save_variables(path: str, variables: dict) -> None:
+    flat = flatten_dict(variables, sep="/")
+    np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+
+
+def load_variables(path: str) -> dict:
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return unflatten_dict(flat, sep="/")
+
+
+def save_model_as_npz(
+    save_dir: str,
+    betas: np.ndarray,
+    thetas: np.ndarray | None,
+    topics: list[list[str]] | None,
+    n_components: int,
+    name: str = "model",
+) -> str:
+    """Reference final-artifact schema: keys ``betas``, ``thetas``,
+    ``ntopics``, ``topics`` (``auxiliary_functions.py:66-99``; the server-side
+    variant stores betas only, ``federated_model.py:183-197``)."""
+    os.makedirs(save_dir, exist_ok=True)
+    path = os.path.join(save_dir, f"{name}.npz")
+    payload = {"betas": betas, "ntopics": n_components}
+    if thetas is not None:
+        payload["thetas"] = thetas
+    if topics is not None:
+        payload["topics"] = np.array(
+            json.dumps([list(t) for t in topics])
+        )
+    np.savez(path, **payload)
+    return path

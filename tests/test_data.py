@@ -1,0 +1,131 @@
+"""Data layer: CountVectorizer-equivalence, schedules, synthetic generator."""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data import (
+    build_vocabulary,
+    generate_synthetic_corpus,
+    load_reference_npz,
+    make_epoch_schedule,
+    make_run_schedule,
+    partition_corpus,
+    save_reference_npz,
+    train_val_split,
+    union_vocabularies,
+    vectorize,
+)
+from gfedntm_tpu.data.loaders import RawCorpus
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog",
+    "A fox! A FOX!! and some dogs, dogs, dogs...",
+    "Topic models decompose word counts into topics",
+    "counts counts counts of words and words",
+    "the and of a an it is was",
+]
+
+
+def test_vocab_matches_sklearn_plain():
+    from sklearn.feature_extraction.text import CountVectorizer
+
+    cv = CountVectorizer()
+    X_sk = cv.fit_transform(CORPUS).toarray()
+    vocab = build_vocabulary(CORPUS)
+    assert list(vocab.tokens) == list(cv.get_feature_names_out())
+    X = vectorize(CORPUS, vocab)
+    np.testing.assert_array_equal(X, X_sk.astype(np.float32))
+
+
+def test_vocab_matches_sklearn_stopwords_maxfeatures():
+    from sklearn.feature_extraction.text import CountVectorizer
+
+    cv = CountVectorizer(stop_words="english", max_features=6)
+    X_sk = cv.fit_transform(CORPUS).toarray()
+    vocab = build_vocabulary(CORPUS, max_features=6, stop_words="english")
+    assert list(vocab.tokens) == list(cv.get_feature_names_out())
+    np.testing.assert_array_equal(vectorize(CORPUS, vocab), X_sk.astype(np.float32))
+
+
+def test_vocab_union_is_sorted_superset():
+    v1 = build_vocabulary(CORPUS[:2])
+    v2 = build_vocabulary(CORPUS[2:])
+    u = union_vocabularies([v1, v2])
+    assert set(u.tokens) == set(v1.tokens) | set(v2.tokens)
+    assert list(u.tokens) == sorted(u.tokens)
+    # vectorizing against the global vocab keeps per-client counts
+    X = vectorize(CORPUS[:2], u)
+    assert X.sum() == vectorize(CORPUS[:2], v1).sum()
+
+
+def test_epoch_schedule_covers_every_doc_once():
+    rng = np.random.default_rng(0)
+    sched = make_epoch_schedule(n_docs=10, batch_size=4, rng=rng)
+    assert sched.indices.shape == (3, 4)
+    real = sched.indices[sched.mask]
+    assert sorted(real.tolist()) == list(range(10))
+    assert sched.mask.sum() == 10
+
+
+def test_run_schedule_cycles_epochs():
+    sched = make_run_schedule(n_docs=6, batch_size=4, num_steps=5, seed=1)
+    assert sched.indices.shape == (5, 4)
+    # steps per epoch = 2 -> steps 0-1 epoch 0, 2-3 epoch 1, 4 epoch 2
+    ep0 = sched.indices[:2][sched.mask[:2]]
+    ep1 = sched.indices[2:4][sched.mask[2:4]]
+    assert sorted(ep0.tolist()) == list(range(6))
+    assert sorted(ep1.tolist()) == list(range(6))
+    assert not np.array_equal(ep0, ep1)  # reshuffled
+
+
+def test_train_val_split_disjoint():
+    tr, va = train_val_split(100, 0.25, seed=42)
+    assert len(tr) == 75 and len(va) == 25
+    assert not set(tr) & set(va)
+
+
+def test_synthetic_corpus_ground_truth(tmp_path):
+    corpus = generate_synthetic_corpus(
+        vocab_size=50, n_topics=8, n_docs=20, nwords=(10, 20),
+        n_nodes=2, frozen_topics=2, seed=3,
+    )
+    assert corpus.topic_vectors.shape == (8, 50)
+    np.testing.assert_allclose(corpus.topic_vectors.sum(1), np.ones(8), rtol=1e-6)
+    for node in corpus.nodes:
+        assert node.bow.shape == (20, 50)
+        lens = node.bow.sum(1)
+        assert (lens >= 10).all() and (lens < 20).all()
+        np.testing.assert_allclose(node.doc_topics.sum(1), np.ones(20), rtol=1e-6)
+        # documents round-trip to the same bow
+        for d, doc in enumerate(node.documents[:3]):
+            counts = np.zeros(50)
+            for tok in doc.split():
+                counts[int(tok[2:])] += 1
+            np.testing.assert_array_equal(counts, node.bow[d])
+
+    path = str(tmp_path / "synthetic_all_nodes.npz")
+    save_reference_npz(corpus, path)
+    loaded = load_reference_npz(path)
+    assert loaded.n_nodes == 2
+    np.testing.assert_allclose(loaded.topic_vectors, corpus.topic_vectors)
+    np.testing.assert_array_equal(loaded.nodes[0].bow, corpus.nodes[0].bow)
+
+
+def test_partition_corpus_iid_and_label_skew():
+    docs = [f"doc {i}" for i in range(10)]
+    labels = np.array([0] * 5 + [1] * 5)
+    corpus = RawCorpus(documents=docs, labels=labels)
+    iid = partition_corpus(corpus, 2, seed=0, iid=True)
+    assert sum(len(s) for s in iid) == 10
+    skew = partition_corpus(corpus, 2, seed=0, iid=False)
+    assert set(skew[0].labels) == {0} and set(skew[1].labels) == {1}
+
+
+def test_ctm_dataset_validates_lengths():
+    from gfedntm_tpu.data import CTMDataset
+
+    X = np.zeros((4, 10))
+    with pytest.raises(ValueError):
+        CTMDataset(X=X, X_ctx=np.zeros((3, 7)))
+    ds = CTMDataset(X=X, X_ctx=np.zeros((4, 7)))
+    assert ds.contextual_size == 7
