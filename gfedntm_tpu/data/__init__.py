@@ -1,5 +1,7 @@
 from gfedntm_tpu.data import datasets as datasets
 from gfedntm_tpu.data import loaders as loaders
+from gfedntm_tpu.data import preparation as preparation
+from gfedntm_tpu.data import preproc as preproc
 from gfedntm_tpu.data import synthetic as synthetic
 from gfedntm_tpu.data import vocab as vocab
 from gfedntm_tpu.data.datasets import (
@@ -15,6 +17,19 @@ from gfedntm_tpu.data.loaders import (
     load_20newsgroups,
     load_parquet_corpus,
     partition_corpus,
+)
+from gfedntm_tpu.data.preparation import (
+    TopicModelDataPreparation,
+    WhiteSpacePreprocessing,
+    prepare_ctm_dataset,
+    prepare_dataset,
+    prepare_hold_out_dataset,
+)
+from gfedntm_tpu.data.preproc import (
+    PreprocConfig,
+    PreprocResult,
+    load_wordlist,
+    preprocess_corpus,
 )
 from gfedntm_tpu.data.synthetic import (
     SyntheticCorpus,

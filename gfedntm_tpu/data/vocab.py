@@ -37,18 +37,26 @@ def get_stop_words(name: str | None) -> frozenset[str]:
     raise ValueError(f"unknown stop_words {name!r}")
 
 
-def tokenize(doc: str, lowercase: bool = True) -> list[str]:
-    """sklearn default analyzer: lowercase + ``(?u)\\b\\w\\w+\\b``."""
+def tokenize(
+    doc: str, lowercase: bool = True, token_pattern: str | None = None
+) -> list[str]:
+    """sklearn default analyzer: lowercase + ``(?u)\\b\\w\\w+\\b`` (or a
+    custom ``token_pattern``, e.g. the ``[a-zA-Z]{2,}`` of
+    ``preprocessing.py:47``)."""
     if lowercase:
         doc = doc.lower()
-    return _TOKEN_RE.findall(doc)
+    pattern = _TOKEN_RE if token_pattern is None else re.compile(token_pattern)
+    return pattern.findall(doc)
 
 
 @dataclass
 class Vocabulary:
-    """An ordered token->id map plus its inverse."""
+    """An ordered token->id map plus its inverse. ``token_pattern`` records
+    the analyzer the vocabulary was built with so ``vectorize`` tokenizes
+    consistently (None = sklearn default ``\\b\\w\\w+\\b``)."""
 
     tokens: tuple[str, ...]
+    token_pattern: str | None = None
 
     def __post_init__(self):
         self.token2id = {t: i for i, t in enumerate(self.tokens)}
@@ -69,6 +77,7 @@ def build_vocabulary(
     max_features: int | None = None,
     stop_words: str | None = None,
     lowercase: bool = True,
+    token_pattern: str | None = None,
 ) -> Vocabulary:
     """Fit a vocabulary with CountVectorizer semantics.
 
@@ -79,7 +88,7 @@ def build_vocabulary(
     stops = get_stop_words(stop_words)
     counts: dict[str, int] = {}
     for doc in corpus:
-        for tok in tokenize(doc, lowercase):
+        for tok in tokenize(doc, lowercase, token_pattern):
             if tok not in stops:
                 counts[tok] = counts.get(tok, 0) + 1
     terms = sorted(counts)
@@ -90,7 +99,7 @@ def build_vocabulary(
         tfs = np.array([counts[t] for t in terms])
         keep = np.sort(np.argsort(-tfs, kind="quicksort")[:max_features])
         terms = [terms[i] for i in keep]
-    return Vocabulary(tuple(terms))
+    return Vocabulary(tuple(terms), token_pattern=token_pattern)
 
 
 def vectorize(
@@ -105,7 +114,7 @@ def vectorize(
     n_docs, n_terms = len(corpus), len(vocab)
     X = np.zeros((n_docs, n_terms), dtype=dtype)
     for i, doc in enumerate(corpus):
-        for tok in tokenize(doc, lowercase):
+        for tok in tokenize(doc, lowercase, vocab.token_pattern):
             j = token2id.get(tok)
             if j is not None:
                 X[i, j] += 1

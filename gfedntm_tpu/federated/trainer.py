@@ -80,9 +80,12 @@ def build_federated_program(
     """Compile the whole-federation step loop.
 
     Returns ``run(params, batch_stats, opt_state, data, weights, client_ids,
-    indices, masks, rng) -> (params, batch_stats, opt_state, losses)`` where
-    every state tree has a leading [C_pad] client axis sharded over the mesh,
-    ``indices``/``masks`` are [S, C_pad, B], and ``losses`` is [S, C_pad].
+    indices, masks, step_ids, rng) -> (params, batch_stats, opt_state,
+    losses)`` where every state tree has a leading [C_pad] client axis
+    sharded over the mesh, ``indices``/``masks`` are [S, C_pad, B],
+    ``step_ids`` is the [S] vector of absolute global-step numbers (the
+    per-step RNG fold key, so checkpoint-resumed runs reproduce unresumed
+    ones), and ``losses`` is [S, C_pad].
     """
     params_mask = share_mask.get("params")
     bs_mask = share_mask.get("batch_stats")
@@ -113,7 +116,7 @@ def build_federated_program(
         return new_params, new_bs, new_opt, loss
 
     def shard_body(params, batch_stats, opt_state, data, weights, client_ids,
-                   indices, masks, rng):
+                   indices, masks, step_ids, rng):
         # Local blocks: leading axis L = C_pad / n_devices.
         w_local = weights
 
@@ -143,11 +146,10 @@ def build_federated_program(
                 new_bs = fedavg(new_bs, bs_mask, w_local)
             return (new_p, new_bs, new_o), loss
 
-        steps = indices.shape[0]
         (params, batch_stats, opt_state), losses = jax.lax.scan(
             scan_body,
             (params, batch_stats, opt_state),
-            (indices, masks, jnp.arange(steps)),
+            (indices, masks, step_ids),
         )
         return params, batch_stats, opt_state, losses
 
@@ -165,6 +167,7 @@ def build_federated_program(
                 state_spec,  # client_ids [C_pad]
                 P(None, axis_name),  # indices [S, C_pad, B]
                 P(None, axis_name),  # masks
+                P(),  # step_ids [S] (absolute step index: resume-stable RNG)
                 P(),  # rng
             ),
             out_specs=(state_spec, state_spec, state_spec, P(None, axis_name)),
@@ -202,10 +205,28 @@ class FederatedTrainer:
             {"params": template.params, "batch_stats": template.batch_stats},
             self.grads_to_share,
         )
-        self._program = None
-        self._program_total_weight = None
+        self._programs: dict[float, Any] = {}
 
-    def fit(self, datasets: list[BowDataset]) -> FederatedResult:
+    def _get_program(self, total_weight: float):
+        # Keyed by total_weight only (the one value baked into the program);
+        # jax.jit re-specializes per segment-length shape on its own.
+        if total_weight not in self._programs:
+            t = self.template
+            self._programs[total_weight] = build_federated_program(
+                t.module, t.tx, self.share_mask, self.mesh,
+                total_weight=total_weight,
+                family=t.family, beta_weight=t._beta_weight(),
+            )
+        return self._programs[total_weight]
+
+    def fit(
+        self,
+        datasets: list[BowDataset],
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int | None = None,
+        resume: bool = False,
+        metrics=None,
+    ) -> FederatedResult:
         t = self.template
         C, B = self.n_clients, t.batch_size
         if len(datasets) != C:
@@ -248,29 +269,90 @@ class FederatedTrainer:
         }
 
         # Identical init for every client (server.py:303-311 semantics).
-        params0 = _broadcast_client_axis(t.params, self.c_pad)
-        bs0 = _broadcast_client_axis(t.batch_stats, self.c_pad)
-        opt0 = _broadcast_client_axis(t.opt_state, self.c_pad)
+        params = _broadcast_client_axis(t.params, self.c_pad)
+        batch_stats = _broadcast_client_axis(t.batch_stats, self.c_pad)
+        opt_state = _broadcast_client_axis(t.opt_state, self.c_pad)
 
-        # Cache the compiled program across fits (same shapes -> jit cache hit).
-        if (
-            self._program is None
-            or self._program_total_weight != float(n_samples.sum())
-        ):
-            self._program = build_federated_program(
-                t.module, t.tx, self.share_mask, self.mesh,
-                total_weight=float(n_samples.sum()),
-                family=t.family, beta_weight=t._beta_weight(),
-            )
-            self._program_total_weight = float(n_samples.sum())
-        run = self._program
+        total_weight = float(n_samples.sum())
         rng = jax.random.PRNGKey(self.seed + 17)
-        params, batch_stats, opt_state, losses = run(
-            params0, bs0, opt0, data, jnp.asarray(weights),
-            jnp.asarray(client_ids), jnp.asarray(indices), jnp.asarray(masks),
-            rng,
-        )
-        losses = np.asarray(losses)[:, :C]
+        weights_j = jnp.asarray(weights)
+        ids_j = jnp.asarray(client_ids)
+
+        # Segmented execution: one compiled program per segment length.
+        # Without checkpointing there is exactly one segment (= the old
+        # single whole-run program); with it, the run is chopped into
+        # checkpoint_every-step programs + one remainder program, and state
+        # round-trips through host numpy between segments (cheap for these
+        # model sizes, and what makes atomic orbax snapshots trivial).
+        seg_len = checkpoint_every or total_steps
+        manager = None
+        start_step = 0
+        loss_chunks: list[np.ndarray] = []
+        if checkpoint_dir is not None:
+            from gfedntm_tpu.train.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(checkpoint_dir)
+            if resume and manager.latest_step() is not None:
+                state = manager.restore(
+                    {
+                        "params": params,
+                        "batch_stats": batch_stats,
+                        "opt_state": opt_state,
+                        "losses": np.zeros(
+                            (manager.latest_step(), self.c_pad), np.float32
+                        ),
+                    }
+                )
+                # To host numpy: restored arrays are committed to single
+                # devices; uncommitted inputs let jit reshard onto the mesh.
+                params = jax.tree.map(np.asarray, state["params"])
+                batch_stats = jax.tree.map(np.asarray, state["batch_stats"])
+                opt_state = jax.tree.map(np.asarray, state["opt_state"])
+                start_step = int(manager.latest_step())
+                loss_chunks.append(np.asarray(state["losses"]))
+                if metrics is not None:
+                    metrics.log("resume", step=start_step)
+
+        step = start_step
+        while step < total_steps:
+            n = min(seg_len, total_steps - step)
+            run = self._get_program(total_weight)
+            # RNG folding is per absolute step (scan xs carries step indices),
+            # so resumed runs reproduce the unresumed ones exactly.
+            params, batch_stats, opt_state, seg_losses = run(
+                params, batch_stats, opt_state, data, weights_j, ids_j,
+                jnp.asarray(indices[step:step + n]),
+                jnp.asarray(masks[step:step + n]),
+                jnp.arange(step, step + n),
+                rng,
+            )
+            loss_chunks.append(np.asarray(seg_losses))
+            step += n
+            if metrics is not None:
+                metrics.log(
+                    "federated_segment", step=step,
+                    mean_loss=float(np.asarray(seg_losses)[:, :C].mean()),
+                )
+            if manager is not None and step < total_steps:
+                manager.save(step, {
+                    "params": params,
+                    "batch_stats": batch_stats,
+                    "opt_state": opt_state,
+                    "losses": np.concatenate(loss_chunks, axis=0),
+                })
+        if manager is not None:
+            # A fully-resumed run (start_step == total_steps) already has
+            # its final checkpoint on disk — saving again would collide.
+            if start_step < total_steps:
+                manager.save(total_steps, {
+                    "params": params,
+                    "batch_stats": batch_stats,
+                    "opt_state": opt_state,
+                    "losses": np.concatenate(loss_chunks, axis=0),
+                }, force=True)
+            manager.close()
+
+        losses = np.concatenate(loss_chunks, axis=0)[:, :C]
 
         # Server-side global model: the last weighted average of shared
         # leaves (identical across clients post-exchange) + client 0's

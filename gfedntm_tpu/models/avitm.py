@@ -120,7 +120,9 @@ class AVITM:
         self.best_components: np.ndarray | None = None
 
         self.module = self._build_module()
-        self.tx = build_optimizer(solver, lr, momentum)
+        self.tx = build_optimizer(
+            solver, lr, momentum, inject_lr=reduce_on_plateau
+        )
         self.params, self.batch_stats = init_variables(
             self.module, batch_size, input_size,
             contextual_size=self._contextual_size(),
@@ -186,6 +188,18 @@ class AVITM:
         self.train_data = train_dataset
         self.validation_data = validation_dataset
 
+        scheduler = None
+        if self.reduce_on_plateau:
+            # Intended reference semantics: ReduceLROnPlateau(patience=10)
+            # on the monitored loss (avitm.py:155-157; the reference builds
+            # the scheduler but never steps it — SURVEY.md §2.5 policy).
+            from gfedntm_tpu.train.schedulers import (
+                ReduceLROnPlateau,
+                set_learning_rate,
+            )
+
+            scheduler = ReduceLROnPlateau(self.lr)
+
         early_stopping = None
         if validation_dataset is not None:
             early_stopping = EarlyStopping(
@@ -237,7 +251,13 @@ class AVITM:
                 if early_stopping.early_stop:
                     self.logger.info("Early stopping")
                     break
+                if scheduler is not None:
+                    set_learning_rate(self.opt_state, scheduler.step(val_loss))
             else:
+                if scheduler is not None:
+                    set_learning_rate(
+                        self.opt_state, scheduler.step(train_loss)
+                    )
                 if save_dir is not None:
                     self.save(save_dir)
                 if self.verbose:

@@ -1,5 +1,9 @@
+from gfedntm_tpu.train import checkpoint as checkpoint
 from gfedntm_tpu.train import early_stopping as early_stopping
 from gfedntm_tpu.train import optimizers as optimizers
+from gfedntm_tpu.train import schedulers as schedulers
 from gfedntm_tpu.train import steps as steps
+from gfedntm_tpu.train.checkpoint import CheckpointManager
 from gfedntm_tpu.train.early_stopping import EarlyStopping
 from gfedntm_tpu.train.optimizers import build_optimizer
+from gfedntm_tpu.train.schedulers import ReduceLROnPlateau, set_learning_rate

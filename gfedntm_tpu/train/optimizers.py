@@ -13,23 +13,43 @@ import optax
 
 
 def build_optimizer(
-    solver: str = "adam", lr: float = 2e-3, momentum: float = 0.99
+    solver: str = "adam",
+    lr: float = 2e-3,
+    momentum: float = 0.99,
+    inject_lr: bool = False,
 ) -> optax.GradientTransformation:
+    """``inject_lr`` wraps the solver in ``optax.inject_hyperparams`` so the
+    learning rate becomes part of the optimizer state and can be changed
+    between epochs (ReduceLROnPlateau) without recompiling."""
     solver = solver.lower()
     if solver == "adam":
-        return optax.adam(lr, b1=momentum, b2=0.99, eps=1e-8)
-    if solver == "sgd":
-        return optax.sgd(lr, momentum=momentum)
-    if solver == "adagrad":
+        fn = lambda learning_rate: optax.adam(  # noqa: E731
+            learning_rate, b1=momentum, b2=0.99, eps=1e-8
+        )
+    elif solver == "sgd":
+        fn = lambda learning_rate: optax.sgd(  # noqa: E731
+            learning_rate, momentum=momentum
+        )
+    elif solver == "adagrad":
         # torch Adagrad: lr_decay=0, eps=1e-10
-        return optax.adagrad(lr, eps=1e-10)
-    if solver == "adadelta":
+        fn = lambda learning_rate: optax.adagrad(  # noqa: E731
+            learning_rate, eps=1e-10
+        )
+    elif solver == "adadelta":
         # torch Adadelta defaults: rho=0.9, eps=1e-6
-        return optax.adadelta(lr, rho=0.9, eps=1e-6)
-    if solver == "rmsprop":
+        fn = lambda learning_rate: optax.adadelta(  # noqa: E731
+            learning_rate, rho=0.9, eps=1e-6
+        )
+    elif solver == "rmsprop":
         # torch RMSprop defaults: alpha=0.99, eps=1e-8
-        return optax.rmsprop(lr, decay=0.99, eps=1e-8, momentum=momentum)
-    raise ValueError(
-        "solver must be 'adam', 'adadelta', 'sgd', 'rmsprop' or 'adagrad', "
-        f"got {solver!r}"
-    )
+        fn = lambda learning_rate: optax.rmsprop(  # noqa: E731
+            learning_rate, decay=0.99, eps=1e-8, momentum=momentum
+        )
+    else:
+        raise ValueError(
+            "solver must be 'adam', 'adadelta', 'sgd', 'rmsprop' or "
+            f"'adagrad', got {solver!r}"
+        )
+    if inject_lr:
+        return optax.inject_hyperparams(fn)(learning_rate=lr)
+    return fn(lr)

@@ -1,0 +1,59 @@
+"""Host-side learning-rate scheduling.
+
+The reference constructs ``ReduceLROnPlateau(self.optimizer, patience=10)``
+when ``reduce_on_plateau`` is set (``avitm.py:155-157``, ``ctm.py:170-172``)
+but never calls ``scheduler.step`` — a vestigial wiring (SURVEY.md §2.5
+policy: implement *intended* semantics). Here the torch semantics are
+implemented for real: on a monitored metric plateau of ``patience`` epochs,
+multiply the LR by ``factor``. The LR lives inside the optax state (via
+``optax.inject_hyperparams``) so changing it between epochs does not
+recompile the train program.
+"""
+
+from __future__ import annotations
+
+
+class ReduceLROnPlateau:
+    """torch.optim.lr_scheduler.ReduceLROnPlateau (mode='min') semantics:
+    factor=0.1, patience=10, threshold=1e-4 (relative), min_lr=0."""
+
+    def __init__(
+        self,
+        initial_lr: float,
+        factor: float = 0.1,
+        patience: int = 10,
+        threshold: float = 1e-4,
+        min_lr: float = 0.0,
+    ):
+        self.lr = float(initial_lr)
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.min_lr = min_lr
+        self.best = float("inf")
+        self.num_bad_epochs = 0
+
+    def step(self, metric: float) -> float:
+        """Record one epoch's monitored metric; returns the (possibly
+        reduced) learning rate."""
+        if metric < self.best * (1.0 - self.threshold):
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            self.lr = max(self.lr * self.factor, self.min_lr)
+            self.num_bad_epochs = 0
+        return self.lr
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Write a new LR into an ``inject_hyperparams`` optax state in place
+    (the state is host-side between compiled epoch programs)."""
+    import jax.numpy as jnp
+
+    if hasattr(opt_state, "hyperparams"):
+        opt_state.hyperparams["learning_rate"] = jnp.asarray(
+            lr, dtype=opt_state.hyperparams["learning_rate"].dtype
+        )
+    return opt_state

@@ -1,1 +1,8 @@
-
+from gfedntm_tpu.utils import observability as observability
+from gfedntm_tpu.utils import serialization as serialization
+from gfedntm_tpu.utils.observability import MetricsLogger, phase_timer, trace
+from gfedntm_tpu.utils.serialization import (
+    load_variables,
+    save_model_as_npz,
+    save_variables,
+)

@@ -1,0 +1,85 @@
+"""Structured metrics + profiling (first-class, unlike the reference).
+
+The reference's telemetry is log-line based (per-minibatch loss strings,
+``federated_avitm.py:109``) with a vestigial ``GRPC_TRACE`` constant and no
+profiler hooks (SURVEY.md §5). Here:
+
+- :class:`MetricsLogger` — structured JSONL event stream (one object per
+  line: step/epoch metrics, phase timings) plus an in-memory record, so
+  experiments and dashboards read one format.
+- :func:`phase_timer` — wall-clock timing of named phases (consensus,
+  compile, train, inference) pushed into the logger.
+- :func:`trace` — ``jax.profiler`` trace context for TPU timeline capture
+  (view in TensorBoard / xprof).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Iterator
+
+
+class MetricsLogger:
+    """Append-only structured metrics. ``path=None`` keeps records in memory
+    only (tests); otherwise each event is one JSON line, flushed eagerly so
+    a crashed run keeps its telemetry."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.records: list[dict[str, Any]] = []
+        self._fh = None
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a")
+
+    def log(self, event: str, **fields: Any) -> dict[str, Any]:
+        record = {"event": event, "time": time.time(), **fields}
+        self.records.append(record)
+        if self._fh is not None:
+            self._fh.write(json.dumps(record, default=float) + "\n")
+            self._fh.flush()
+        return record
+
+    def events(self, event: str) -> list[dict[str, Any]]:
+        return [r for r in self.records if r["event"] == event]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+@contextlib.contextmanager
+def phase_timer(
+    logger: MetricsLogger | None, phase: str, **fields: Any
+) -> Iterator[None]:
+    """Time a named phase; logs ``{"event": "phase", "phase": ..., "seconds": ...}``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - t0
+        if logger is not None:
+            logger.log("phase", phase=phase, seconds=elapsed, **fields)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None) -> Iterator[None]:
+    """``jax.profiler.trace`` context when ``log_dir`` is set; no-op
+    otherwise (so call sites need no branching)."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

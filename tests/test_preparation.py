@@ -1,0 +1,150 @@
+"""Tests for dataset preparation + the preprocessing pipeline.
+
+Parity model: ``prepare_dataset`` must reproduce the reference flow
+(train_test_split(seed 42) + CountVectorizer(lowercase, english stop-words)
+fit on train only — ``pytorchavitm/utils/data_preparation.py:11-64``);
+verified here directly against sklearn.
+"""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.preparation import (
+    TopicModelDataPreparation,
+    WhiteSpacePreprocessing,
+    prepare_ctm_dataset,
+    prepare_dataset,
+    prepare_hold_out_dataset,
+)
+from gfedntm_tpu.data.preproc import (
+    PreprocConfig,
+    parse_equivalences,
+    preprocess_corpus,
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "a fast auburn fox vaulted over a sleepy hound",
+    "machine learning with neural topic models",
+    "topic models learn latent topics from documents",
+    "federated learning trains models across clients",
+    "clients hold private corpora of documents",
+    "the dog sleeps while the fox runs",
+    "neural networks learn representations of text",
+]
+
+
+def test_prepare_dataset_matches_sklearn_flow():
+    from sklearn.feature_extraction.text import CountVectorizer
+    from sklearn.model_selection import train_test_split
+
+    train_data, val_data, input_size, id2token, docs_train, vocab = (
+        prepare_dataset(CORPUS)
+    )
+
+    ref_train, ref_val = train_test_split(
+        CORPUS, test_size=0.25, random_state=42
+    )
+    cv = CountVectorizer(lowercase=True, stop_words="english")
+    ref_train_bow = cv.fit_transform(ref_train).toarray()
+    ref_val_bow = cv.transform(ref_val).toarray()
+
+    assert docs_train == ref_train
+    assert list(vocab.tokens) == list(cv.get_feature_names_out())
+    assert input_size == len(cv.get_feature_names_out())
+    np.testing.assert_array_equal(train_data.X, ref_train_bow)
+    np.testing.assert_array_equal(val_data.X, ref_val_bow)
+    assert id2token[0] == cv.get_feature_names_out()[0]
+
+
+def test_prepare_dataset_accepts_token_lists():
+    token_corpus = [doc.split() for doc in CORPUS]
+    train_a, val_a, size_a, _, _, _ = prepare_dataset(token_corpus)
+    train_b, val_b, size_b, _, _, _ = prepare_dataset(CORPUS)
+    assert size_a == size_b
+    np.testing.assert_array_equal(train_a.X, train_b.X)
+    np.testing.assert_array_equal(val_a.X, val_b.X)
+
+
+def test_prepare_ctm_dataset_and_holdout():
+    emb = np.random.default_rng(0).normal(size=(len(CORPUS), 16)).astype(
+        np.float32
+    )
+    (train, val, input_size, id2token, qt, emb_train, all_emb, docs_train) = (
+        prepare_ctm_dataset(CORPUS, custom_embeddings=emb)
+    )
+    assert train.X.shape[1] == input_size == val.X.shape[1]
+    assert train.X_ctx.shape == (len(docs_train), 16)
+    assert len(train) + len(val) == len(CORPUS)
+
+    ho = prepare_hold_out_dataset(
+        CORPUS[:3], qt, embeddings_ho=emb[:3]
+    )
+    assert ho.X.shape == (3, input_size)
+    assert ho.X_ctx.shape == (3, 16)
+
+
+def test_prepare_ctm_requires_embeddings_or_corpus():
+    with pytest.raises(TypeError):
+        prepare_ctm_dataset(CORPUS)
+
+
+def test_tmdp_fit_transform_labels():
+    emb = np.ones((len(CORPUS), 8), dtype=np.float32)
+    qt = TopicModelDataPreparation()
+    labels = ["a", "b"] * (len(CORPUS) // 2)
+    train = qt.fit(
+        text_for_contextual=CORPUS, text_for_bow=CORPUS,
+        custom_embeddings=emb, labels=labels,
+    )
+    assert train.labels.shape == (len(CORPUS), 2)
+    assert train.labels.sum() == len(CORPUS)
+    # transform without bow text -> zero bow block (zero-shot regime)
+    zs = qt.transform(
+        text_for_contextual=CORPUS[:2], custom_embeddings=emb[:2]
+    )
+    assert zs.X.sum() == 0 and zs.X.shape[1] == train.X.shape[1]
+
+
+def test_whitespace_preprocessing():
+    docs = CORPUS + ["!!! ??? ..."]  # punctuation-only doc must be dropped
+    wsp = WhiteSpacePreprocessing(docs, vocabulary_size=10)
+    pre, unpre, vocab = wsp.preprocess()
+    assert len(vocab) <= 10
+    assert len(pre) == len(unpre) < len(docs)
+    vocab_set = set(vocab)
+    for doc in pre:
+        assert doc and all(w in vocab_set for w in doc.split())
+    # stop words never survive
+    assert "the" not in vocab_set
+
+
+def test_preprocess_corpus_filters():
+    docs = [
+        ["apple", "banana", "common", "rare1"],
+        ["apple", "common"],
+        ["apple", "common", "stopme"],
+        ["common", "pear"],
+    ]
+    cfg = PreprocConfig(
+        min_lemas=1, no_below=2, no_above=0.8, keep_n=100,
+        stopwords=["stopme"], equivalences=["banana:apple"],
+    )
+    res = preprocess_corpus(docs, cfg)
+    # df after equivalences: apple=3, common=4, rare1=1, pear=1 over 4 docs.
+    # 'common' in 4/4 docs > no_above=0.8 -> dropped; rare1/pear < no_below
+    # -> dropped; banana folded into apple.
+    assert res.vocabulary == ["apple"]
+    assert res.docs[0] == ["apple", "apple"]  # apple + folded banana
+    assert res.kept_indices == [0, 1, 2]  # doc 4 emptied -> dropped
+
+
+def test_preprocess_min_lemas_drops_docs():
+    docs = [["a", "b", "c"], ["a"]]
+    cfg = PreprocConfig(min_lemas=2, no_below=1, no_above=1.0, keep_n=10)
+    res = preprocess_corpus(docs, cfg)
+    assert res.kept_indices == [0]
+
+
+def test_parse_equivalences():
+    assert parse_equivalences(["a:b", "bad", "x : y "]) == {"a": "b", "x": "y"}
