@@ -1,6 +1,13 @@
 from gfedntm_tpu.federated import consensus as consensus
+from gfedntm_tpu.federated import stepper as stepper
 from gfedntm_tpu.federated import trainer as trainer
 from gfedntm_tpu.federated.consensus import ConsensusResult, run_vocab_consensus
+from gfedntm_tpu.federated.stepper import (
+    FederatedAVITM,
+    FederatedCTM,
+    FederatedStepper,
+    StepStatus,
+)
 from gfedntm_tpu.federated.trainer import (
     FederatedResult,
     FederatedTrainer,

@@ -1,0 +1,279 @@
+"""Externally-stepped federated models (the C7-C9 protocol surface).
+
+Rebuilds the reference's ``FederatedModel`` mixin contract
+(``src/models/federated/federated_model.py:17-197``) and its two concrete
+specializations ``FederatedAVITM`` (``federated_avitm.py:13-193``) and
+``FederatedCTM`` (``federated_ctm.py:12-190``): training is not driven by a
+local ``fit`` loop but *stepped from outside*, one minibatch at a time, by a
+federation orchestrator — here the network server
+(:mod:`gfedntm_tpu.federation`), in-pod the SPMD trainer
+(:mod:`gfedntm_tpu.federated.trainer`) replaces this with a single program.
+
+Protocol (per global step, mirroring SURVEY.md §3.3):
+1. orchestrator calls :meth:`train_mb_delta` — one jitted
+   forward/backward/optimizer step on the *current* minibatch, returns the
+   post-step shared-parameter snapshot (``federated_avitm.py:51-83``; note
+   the reference's "gradients" are post-Adam-step parameters);
+2. orchestrator averages snapshots across clients (sample-weighted);
+3. orchestrator calls :meth:`delta_update_fit` with the average — shared
+   leaves are overwritten, loss/sample accounting advances, and the data
+   iterator moves to the next minibatch with independent per-client epoch
+   rollover (``federated_avitm.py:85-147``).
+
+Intended-semantics fixes folded in (SURVEY.md §2.5): sample accounting reads
+the minibatch just processed (bug 2); the CTM label loss accumulates into
+the tracked loss (bug 3).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+from gfedntm_tpu.config import SHARE_ALL
+from gfedntm_tpu.data.datasets import BowDataset, make_epoch_schedule
+from gfedntm_tpu.eval.metrics import (
+    convert_topic_word_to_init_size,
+    document_similarity_score,
+    topic_similarity_score,
+)
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.models.params import build_share_mask
+from gfedntm_tpu.train.steps import build_train_step
+from gfedntm_tpu.utils.serialization import save_model_as_npz
+
+THETAS_THRESHOLD = 3e-3  # federated_model.py:172
+
+
+@dataclass
+class StepStatus:
+    """Outcome of one ``delta_update_fit`` (what the reference signals via
+    mutable client state, ``federated_avitm.py:106-147``)."""
+
+    current_mb: int
+    current_epoch: int
+    epoch_ended: bool
+    finished: bool
+    epoch_loss: float | None = None
+
+
+class FederatedStepper:
+    """Wraps a configured :class:`AVITM`/:class:`CTM` for one-minibatch-at-a-
+    time federated stepping (the ``FederatedModel`` contract).
+
+    ``grads_to_share`` accepts reference torch state-dict keys or
+    ``SHARE_ALL`` and is applied as a pytree mask
+    (``federated_model.py:98-131`` -> :func:`build_share_mask`).
+    """
+
+    def __init__(self, model: AVITM, grads_to_share: tuple[str, ...] = SHARE_ALL):
+        self.model = model
+        self.grads_to_share = tuple(grads_to_share)
+        self.share_mask = build_share_mask(
+            {"params": model.params, "batch_stats": model.batch_stats},
+            self.grads_to_share,
+        )
+        self._step_fn = build_train_step(
+            model.module, model.tx, model.family, model._beta_weight()
+        )
+        self._flat_mask = flatten_dict(self.share_mask, sep="/")
+        self._shared_keys = frozenset(
+            k for k, shared in self._flat_mask.items() if shared
+        )
+        # Counters mirroring FederatedModel/FederatedAVITM state
+        self.current_mb = 0  # global minibatch counter
+        self.current_epoch = 0
+        self.samples_processed = 0.0  # within current epoch
+        self.train_loss = 0.0  # summed batch loss within current epoch
+        self.best_loss_train = float("inf")
+        self.best_components: np.ndarray | None = None
+        self.epoch_losses: list[float] = []
+        self.finished = False
+        self._data = None
+        self._schedule = None
+        self._step_in_epoch = 0
+        self._last_batch_size = 0.0
+        self._pending_step = False
+
+    # ---- phase setup (preFit, federated_model.py:57-96) --------------------
+    def pre_fit(self, train_dataset: BowDataset) -> None:
+        """Create the shuffled batch schedule and prime the first minibatch."""
+        self.model.train_data = train_dataset
+        self._data = self.model._device_data(train_dataset)
+        self._new_epoch_schedule()
+
+    def _new_epoch_schedule(self) -> None:
+        self._schedule = make_epoch_schedule(
+            len(self.model.train_data), self.model.batch_size,
+            self.model._np_rng,
+        )
+        self._step_in_epoch = 0
+
+    # ---- the two protocol steps --------------------------------------------
+    def train_mb_delta(self) -> dict[str, np.ndarray]:
+        """One local forward/backward/optimizer step on the current minibatch;
+        returns the post-step shared-parameter snapshot
+        (``federated_avitm.py:51-83`` / ``federated_ctm.py:50-114``)."""
+        if self._schedule is None:
+            raise RuntimeError("pre_fit must be called before stepping")
+        m = self.model
+        idx = jnp.asarray(self._schedule.indices[self._step_in_epoch])
+        mask = jnp.asarray(self._schedule.mask[self._step_in_epoch])
+        m.params, m.batch_stats, m.opt_state, loss = self._step_fn(
+            m.params, m.batch_stats, m.opt_state, self._data, idx, mask,
+            m._next_rng(),
+        )
+        self.loss = float(loss)
+        self._last_batch_size = float(self._schedule.mask[self._step_in_epoch].sum())
+        self._pending_step = True
+        return self.get_gradients()
+
+    def get_gradients(self) -> dict[str, np.ndarray]:
+        """Flat ``{path: array}`` snapshot of the shared subset
+        (``federated_model.py:98-115``; paths are '/'-joined Flax variable
+        paths, e.g. ``params/beta``)."""
+        variables = {
+            "params": self.model.params,
+            "batch_stats": self.model.batch_stats,
+        }
+        flat_vars = flatten_dict(variables, sep="/")
+        return {
+            k: np.asarray(v)
+            for k, v in flat_vars.items()
+            if k in self._shared_keys
+        }
+
+    def set_gradients(self, averaged: dict[str, np.ndarray]) -> None:
+        """Overwrite shared leaves with the server average
+        (``federated_model.py:117-131``)."""
+        variables = {
+            "params": self.model.params,
+            "batch_stats": self.model.batch_stats,
+        }
+        flat_vars = dict(flatten_dict(variables, sep="/"))
+        for key, value in averaged.items():
+            if key not in flat_vars:
+                raise KeyError(f"unknown shared tensor {key!r}")
+            if key not in self._shared_keys:
+                continue  # present but not federated under grads_to_share
+            flat_vars[key] = jnp.asarray(value, flat_vars[key].dtype)
+        restored = unflatten_dict(flat_vars, sep="/")
+        self.model.params = restored["params"]
+        self.model.batch_stats = restored.get("batch_stats", {})
+
+    def delta_update_fit(self, averaged: dict[str, np.ndarray]) -> StepStatus:
+        """Apply the aggregate, account the step, advance the iterator with
+        per-client epoch rollover (``federated_avitm.py:85-147``)."""
+        if not self._pending_step:
+            raise RuntimeError(
+                "delta_update_fit requires a preceding train_mb_delta "
+                "(one aggregate per local step)"
+            )
+        self._pending_step = False
+        self.set_gradients(averaged)
+
+        # Accounting for the minibatch just processed (intended semantics of
+        # the reference's self.X bug, SURVEY.md §2.5 item 2).
+        self.train_loss += self.loss
+        self.samples_processed += self._last_batch_size
+        self.current_mb += 1
+        self._step_in_epoch += 1
+
+        epoch_ended = self._step_in_epoch >= self._schedule.steps_per_epoch
+        epoch_loss = None
+        if epoch_ended:
+            epoch_loss = self.train_loss / max(self.samples_processed, 1.0)
+            self.epoch_losses.append(epoch_loss)
+            self.best_components = np.asarray(self.model.params["beta"])
+            self.model.best_components = self.best_components
+            if epoch_loss < self.best_loss_train:
+                self.best_loss_train = epoch_loss
+            self.train_loss = 0.0
+            self.samples_processed = 0.0
+            self.current_epoch += 1
+            self._new_epoch_schedule()
+            if self.current_epoch >= self.model.num_epochs:
+                self.finished = True
+        return StepStatus(
+            current_mb=self.current_mb,
+            current_epoch=self.current_epoch,
+            epoch_ended=epoch_ended,
+            finished=self.finished,
+            epoch_loss=epoch_loss,
+        )
+
+    # ---- finalization (federated_model.py:151-197) -------------------------
+    def get_results_model(
+        self, save_dir: str | None = None, n_samples: int | None = None
+    ) -> dict[str, Any]:
+        """Client-side final artifacts: MC thetas thresholded at
+        ``3e-3`` and L1-renormalized, softmax betas, top-word topics; npz
+        bundle when ``save_dir`` given (``federated_model.py:151-181``)."""
+        m = self.model
+        n = n_samples or m.num_samples
+        thetas = m.get_doc_topic_distribution(m.train_data, n)
+        thetas = np.where(thetas < THETAS_THRESHOLD, 0.0, thetas)
+        norm = thetas.sum(axis=1, keepdims=True)
+        thetas = thetas / np.where(norm == 0.0, 1.0, norm)
+        betas = m.get_topic_word_distribution()
+        topics = m.get_topics()
+        if save_dir is not None:
+            save_model_as_npz(
+                save_dir, betas=betas, thetas=thetas, topics=topics,
+                n_components=m.n_components,
+            )
+        return {"thetas": thetas, "betas": betas, "topics": topics}
+
+    def get_topics_in_server(self, save_dir: str | None = None) -> np.ndarray:
+        """Server-side final artifact: betas only — the server holds no
+        corpus to infer thetas from (``federated_model.py:183-197``)."""
+        betas = self.model.get_topic_word_distribution()
+        if save_dir is not None:
+            os.makedirs(save_dir, exist_ok=True)
+            save_model_as_npz(
+                save_dir, betas=betas, thetas=None,
+                topics=None, n_components=self.model.n_components,
+                name="server_model",
+            )
+        return betas
+
+    def evaluate_synthetic_model(
+        self,
+        beta_gt: np.ndarray,
+        thetas_gt: np.ndarray | None = None,
+        vocab_size: int | None = None,
+    ) -> dict[str, float]:
+        """Ground-truth recovery scores on a synthetic corpus
+        (``federated_avitm.py:152-193``): TSS on betas re-projected onto the
+        full synthetic vocabulary, DSS on thetas when provided."""
+        m = self.model
+        betas = m.get_topic_word_distribution()
+        # Re-project unconditionally when a synthetic vocab size is given:
+        # equal size does not imply identity column order
+        # (federated_avitm.py:176 always maps via id2token).
+        if vocab_size is not None:
+            betas = convert_topic_word_to_init_size(
+                vocab_size, betas, m.train_data.idx2token
+            )
+        out = {"tss": topic_similarity_score(betas, beta_gt)}
+        if thetas_gt is not None:
+            thetas = m.get_doc_topic_distribution(m.train_data, m.num_samples)
+            out["dss"] = document_similarity_score(thetas, thetas_gt)
+        return out
+
+
+class FederatedAVITM(FederatedStepper):
+    """AVITM under the externally-stepped protocol (``federated_avitm.py``).
+    Construct with a configured :class:`~gfedntm_tpu.models.avitm.AVITM`."""
+
+
+class FederatedCTM(FederatedStepper):
+    """CTM under the externally-stepped protocol (``federated_ctm.py``);
+    the CTM loss (beta-weighted KL + RL + optional label CE) comes from the
+    wrapped model's family. Construct with a configured CTM."""

@@ -41,8 +41,7 @@ from gfedntm_tpu.data.datasets import BowDataset, make_run_schedule
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.models.params import build_share_mask
 from gfedntm_tpu.parallel.mesh import make_client_mesh, stack_and_pad
-from gfedntm_tpu.train.steps import _batch_loss
-import optax
+from gfedntm_tpu.train.steps import grad_step
 
 
 @dataclass
@@ -104,16 +103,10 @@ def build_federated_program(
         return jax.tree.map(mix, tree, mask_tree)
 
     def client_step(params, batch_stats, opt_state, batch, mask, rngs):
-        def loss_fn(p):
-            return _batch_loss(
-                module, family, beta_weight, p, batch_stats, batch, mask,
-                rngs, train=True,
-            )
-
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_bs, new_opt, loss
+        return grad_step(
+            module, tx, family, beta_weight, params, batch_stats, opt_state,
+            batch, mask, rngs,
+        )
 
     def shard_body(params, batch_stats, opt_state, data, weights, client_ids,
                    indices, masks, step_ids, rng):

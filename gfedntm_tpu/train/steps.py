@@ -74,6 +74,24 @@ def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
     return loss, mutated["batch_stats"]
 
 
+def grad_step(module, tx, family, beta_weight, params, batch_stats, opt_state,
+              batch, mask, rngs):
+    """One forward/backward/optimizer update — the single implementation of
+    the training-step semantics shared by the epoch scan, the one-minibatch
+    federation step, and the SPMD federated program."""
+
+    def loss_fn(p):
+        return _batch_loss(
+            module, family, beta_weight, p, batch_stats, batch, mask, rngs,
+            train=True,
+        )
+
+    (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, new_opt = tx.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    return new_params, new_bs, new_opt, loss
+
+
 def build_train_epoch(
     module: DecoderNetwork,
     tx: optax.GradientTransformation,
@@ -98,18 +116,10 @@ def build_train_epoch(
                 "reparam": jax.random.fold_in(step_rng, 1),
             }
             batch = _gather_batch(data, idx)
-
-            def loss_fn(p):
-                return _batch_loss(
-                    module, family, beta_weight, p, batch_stats, batch, mask,
-                    rngs, train=True,
-                )
-
-            (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params
+            new_params, new_bs, new_opt, loss = grad_step(
+                module, tx, family, beta_weight, params, batch_stats,
+                opt_state, batch, mask, rngs,
             )
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
             return (new_params, new_bs, new_opt), loss
 
         steps = indices.shape[0]
@@ -121,6 +131,34 @@ def build_train_epoch(
         return params, batch_stats, opt_state, losses
 
     return jax.jit(train_epoch)
+
+
+def build_train_step(
+    module: DecoderNetwork,
+    tx: optax.GradientTransformation,
+    family: str = "avitm",
+    beta_weight: float = 1.0,
+):
+    """Jitted ONE-minibatch step: ``(params, batch_stats, opt_state, data,
+    idx[B], mask[B], rng) -> (params, batch_stats, opt_state, loss)``.
+
+    The externally-stepped federation protocol (``train_mb_delta``,
+    ``federated_avitm.py:51-83``) drives this once per server poll; the
+    whole-epoch ``lax.scan`` programs above stay the fast path for
+    single-program training."""
+
+    def train_step(params, batch_stats, opt_state, data, idx, mask, rng):
+        rngs = {
+            "dropout": jax.random.fold_in(rng, 0),
+            "reparam": jax.random.fold_in(rng, 1),
+        }
+        batch = _gather_batch(data, idx)
+        return grad_step(
+            module, tx, family, beta_weight, params, batch_stats, opt_state,
+            batch, mask, rngs,
+        )
+
+    return jax.jit(train_step)
 
 
 def build_eval_epoch(

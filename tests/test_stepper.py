@@ -1,0 +1,178 @@
+"""Externally-stepped federated protocol tests (C7-C9 contract).
+
+Exercises the FederatedStepper against the reference semantics of
+``federated_model.py`` / ``federated_avitm.py``: per-minibatch stepping,
+sample-weighted averaging, independent epoch rollover, finalization.
+"""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.datasets import BowDataset, CTMDataset
+from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+from gfedntm_tpu.federated.stepper import FederatedAVITM, FederatedCTM
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.models.ctm import ZeroShotTM
+
+
+def _make_datasets(n_clients=2, docs=20, vocab=50, seed=0):
+    rng = np.random.default_rng(seed)
+    idx2token = {i: f"wd{i}" for i in range(vocab)}
+    return [
+        BowDataset(
+            X=rng.integers(0, 3, size=(docs + 12 * c, vocab)).astype(np.float32),
+            idx2token=idx2token,
+        )
+        for c in range(n_clients)
+    ]
+
+
+def _weighted_average(snapshots, weights):
+    total = float(sum(weights))
+    keys = snapshots[0].keys()
+    return {
+        k: sum(w * s[k] for w, s in zip(weights, snapshots)) / total
+        for k in keys
+    }
+
+
+def _make_steppers(datasets, num_epochs=2, cls=FederatedAVITM, model_fn=None):
+    steppers = []
+    for c, d in enumerate(datasets):
+        model_fn_ = model_fn or (lambda: AVITM(
+            input_size=d.vocab_size, n_components=4, hidden_sizes=(16, 16),
+            batch_size=8, num_epochs=num_epochs, seed=0,
+        ))
+        s = cls(model_fn_())
+        s.pre_fit(d)
+        steppers.append(s)
+    return steppers
+
+
+def test_two_client_protocol_runs_to_completion():
+    datasets = _make_datasets()
+    steppers = _make_steppers(datasets, num_epochs=2)
+    weights = [len(d) for d in datasets]
+
+    statuses = [None] * len(steppers)
+    for _ in range(200):
+        active = [s for s in steppers if not s.finished]
+        if not active:
+            break
+        snaps = [s.train_mb_delta() for s in active]
+        avg = _weighted_average(snaps, [len(s.model.train_data) for s in active])
+        statuses = [s.delta_update_fit(avg) for s in active]
+    assert all(s.finished for s in steppers)
+    assert all(s.current_epoch == 2 for s in steppers)
+    # datasets differ in size -> different per-epoch step counts
+    assert steppers[0].current_mb != steppers[1].current_mb
+    for s in steppers:
+        assert len(s.epoch_losses) == 2
+        assert all(np.isfinite(v) for v in s.epoch_losses)
+
+
+def test_shared_params_identical_after_update():
+    datasets = _make_datasets()
+    steppers = _make_steppers(datasets)
+    snaps = [s.train_mb_delta() for s in steppers]
+    # post-step snapshots differ (different local data)
+    assert not np.allclose(snaps[0]["params/beta"], snaps[1]["params/beta"])
+    avg = _weighted_average(snaps, [len(d) for d in datasets])
+    for s in steppers:
+        s.delta_update_fit(avg)
+    g0 = steppers[0].get_gradients()
+    g1 = steppers[1].get_gradients()
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], rtol=1e-6)
+
+
+def test_share_subset_only_touches_named_leaves():
+    datasets = _make_datasets(n_clients=1)
+    model = AVITM(
+        input_size=datasets[0].vocab_size, n_components=4,
+        hidden_sizes=(16, 16), batch_size=8, num_epochs=1, seed=0,
+    )
+    s = FederatedAVITM(
+        model, grads_to_share=("prior_mean", "prior_variance", "beta")
+    )
+    s.pre_fit(datasets[0])
+    snap = s.train_mb_delta()
+    assert set(snap) == {
+        "params/prior_mean", "params/prior_variance", "params/beta"
+    }
+    kernel_before = np.asarray(
+        s.model.params["inf_net"]["input_layer"]["kernel"]
+    )
+    # zero out the average: only the three shared leaves may change
+    s.delta_update_fit({k: np.zeros_like(v) for k, v in snap.items()})
+    np.testing.assert_array_equal(
+        np.asarray(s.model.params["beta"]), 0.0
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.model.params["inf_net"]["input_layer"]["kernel"]),
+        kernel_before,
+    )
+
+
+def test_results_model_thetas_thresholded_and_normalized(tmp_path):
+    datasets = _make_datasets(n_clients=1)
+    steppers = _make_steppers(datasets, num_epochs=1)
+    s = steppers[0]
+    while not s.finished:
+        snap = s.train_mb_delta()
+        s.delta_update_fit(snap)
+    out = s.get_results_model(save_dir=str(tmp_path), n_samples=3)
+    thetas = out["thetas"]
+    assert ((thetas == 0.0) | (thetas >= 3e-3)).all()
+    np.testing.assert_allclose(thetas.sum(axis=1), 1.0, rtol=1e-5)
+    assert (tmp_path / "model.npz").exists()
+    betas_srv = s.get_topics_in_server(save_dir=str(tmp_path))
+    assert betas_srv.shape == (4, datasets[0].vocab_size)
+    assert (tmp_path / "server_model.npz").exists()
+
+
+def test_evaluate_synthetic_model_scores():
+    corpus = generate_synthetic_corpus(
+        vocab_size=60, n_topics=4, n_docs=24, nwords=(20, 30), n_nodes=1,
+        frozen_topics=2, seed=0, materialize_docs=False,
+    )
+    node = corpus.nodes[0]
+    d = BowDataset(
+        X=node.bow, idx2token={i: f"wd{i}" for i in range(60)}
+    )
+    model = AVITM(
+        input_size=60, n_components=4, hidden_sizes=(16, 16), batch_size=8,
+        num_epochs=1, seed=0,
+    )
+    s = FederatedAVITM(model)
+    s.pre_fit(d)
+    while not s.finished:
+        s.delta_update_fit(s.train_mb_delta())
+    scores = s.evaluate_synthetic_model(
+        beta_gt=corpus.topic_vectors, thetas_gt=node.doc_topics,
+        vocab_size=60,
+    )
+    assert np.isfinite(scores["tss"]) and 0 < scores["tss"] <= 4.0
+    assert np.isfinite(scores["dss"]) and scores["dss"] >= 0
+
+
+def test_ctm_stepper():
+    rng = np.random.default_rng(0)
+    vocab, ctx = 40, 12
+    d = CTMDataset(
+        X=rng.integers(0, 3, size=(16, vocab)).astype(np.float32),
+        idx2token={i: f"wd{i}" for i in range(vocab)},
+        X_ctx=rng.normal(size=(16, ctx)).astype(np.float32),
+    )
+    model = ZeroShotTM(
+        input_size=vocab, contextual_size=ctx, n_components=3,
+        hidden_sizes=(8, 8), batch_size=8, num_epochs=1, seed=0,
+    )
+    s = FederatedCTM(model)
+    s.pre_fit(d)
+    status = None
+    while not s.finished:
+        snap = s.train_mb_delta()
+        status = s.delta_update_fit(snap)
+    assert status.finished and status.current_epoch == 1
+    assert np.isfinite(s.epoch_losses[0])
