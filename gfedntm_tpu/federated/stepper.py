@@ -27,7 +27,6 @@ the tracked loss (bug 3).
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Any
 
@@ -190,10 +189,11 @@ class FederatedStepper:
         if epoch_ended:
             epoch_loss = self.train_loss / max(self.samples_processed, 1.0)
             self.epoch_losses.append(epoch_loss)
-            self.best_components = np.asarray(self.model.params["beta"])
-            self.model.best_components = self.best_components
+            # Keep the best epoch's beta, not the last (federated_avitm.py:125-130).
             if epoch_loss < self.best_loss_train:
                 self.best_loss_train = epoch_loss
+                self.best_components = np.asarray(self.model.params["beta"])
+                self.model.best_components = self.best_components
             self.train_loss = 0.0
             self.samples_processed = 0.0
             self.current_epoch += 1
@@ -235,7 +235,6 @@ class FederatedStepper:
         corpus to infer thetas from (``federated_model.py:183-197``)."""
         betas = self.model.get_topic_word_distribution()
         if save_dir is not None:
-            os.makedirs(save_dir, exist_ok=True)
             save_model_as_npz(
                 save_dir, betas=betas, thetas=None,
                 topics=None, n_components=self.model.n_components,

@@ -1,0 +1,14 @@
+"""Network federation for genuinely-remote clients (gRPC over DCN/WAN).
+
+In-pod federation never touches this package — it is one SPMD program with
+``lax.psum`` over ICI (:mod:`gfedntm_tpu.federated.trainer`). This package
+exists for the reference's actual deployment shape — one process per
+organization/container (``docker-compose.yaml:21-149``) — and bridges such
+remote clients into the same stepper protocol.
+"""
+
+from gfedntm_tpu.federation import codec as codec
+from gfedntm_tpu.federation import rpc as rpc
+from gfedntm_tpu.federation.client import Client, FederatedClientServicer
+from gfedntm_tpu.federation.registry import ClientRecord, Federation
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model

@@ -1,0 +1,228 @@
+"""Federated client node for the cross-datacenter network path.
+
+Rebuilds ``src/federation/client.py``: the consensus-phase ``Client``
+(:190-532 — local vocab, blocking wait for the global vocabulary + initial
+state, re-vectorization against the global vocabulary) and the
+training-phase ``FederatedClientServer`` (:43-185 — a gRPC servicer embedded
+in the client that answers the server's per-minibatch polls). The local
+stepping itself is the :class:`~gfedntm_tpu.federated.stepper.FederatedStepper`
+protocol; this module only adds the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any
+
+import numpy as np
+
+from gfedntm_tpu.data.datasets import BowDataset, CTMDataset
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.data.vocab import Vocabulary, build_vocabulary, vectorize
+from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.server import build_template_model
+from gfedntm_tpu.federated.stepper import FederatedStepper
+
+
+class FederatedClientServicer:
+    """The in-client gRPC service the server polls during training
+    (``FederatedClientServer``, ``client.py:43-185``). A lock serializes
+    access to the stepper — the reference relies on the server never
+    overlapping requests (SURVEY.md §5 race note); here it is enforced."""
+
+    def __init__(self, client_id: int, stepper: FederatedStepper,
+                 on_stop, logger: logging.Logger):
+        self.client_id = client_id
+        self.stepper = stepper
+        self.on_stop = on_stop
+        self.logger = logger
+        self._lock = threading.Lock()
+
+    def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
+        """One local minibatch step; reply with the post-step shared subset
+        (``getGradient``, ``client.py:77-133``)."""
+        with self._lock:
+            snapshot = self.stepper.train_mb_delta()
+            return pb.StepReply(
+                client_id=self.client_id,
+                shared=codec.flatdict_to_bundle(snapshot),
+                loss=self.stepper.loss,
+                nr_samples=self.stepper._last_batch_size,
+                current_mb=self.stepper.current_mb,
+                current_epoch=self.stepper.current_epoch,
+                finished=self.stepper.finished,
+            )
+
+    def ApplyAggregate(self, request: pb.Aggregate, context) -> pb.AggregateReply:
+        """Overwrite shared params with the global average and advance
+        (``sendAggregatedTensor``, ``client.py:135-185``); a stop broadcast
+        triggers finalization instead."""
+        with self._lock:
+            if request.stop:
+                self.on_stop()
+                return pb.AggregateReply(
+                    client_id=self.client_id, finished=True,
+                    current_epoch=self.stepper.current_epoch,
+                )
+            average = codec.bundle_to_flatdict(request.shared)
+            status = self.stepper.delta_update_fit(average)
+            if status.epoch_ended:
+                self.logger.info(
+                    "client %d epoch %d done, loss %.4f",
+                    self.client_id, status.current_epoch, status.epoch_loss,
+                )
+            return pb.AggregateReply(
+                client_id=self.client_id, finished=status.finished,
+                current_epoch=status.current_epoch,
+            )
+
+
+class Client:
+    """A federation participant (``Client``, ``client.py:190-532``).
+
+    Drives the full client lifecycle: local vocabulary → consensus →
+    re-vectorization → replicated init → serving per-minibatch polls →
+    finalization artifacts on stop.
+    """
+
+    def __init__(
+        self,
+        client_id: int,
+        corpus: RawCorpus,
+        server_address: str,
+        listen_address: str = "[::]:0",
+        advertise_host: str = "localhost",
+        max_features: int | None = 2000,
+        stop_words: str | None = None,
+        save_dir: str | None = None,
+        logger: logging.Logger | None = None,
+    ):
+        assert client_id > 0, "client ids start at 1 (0 is the server)"
+        self.client_id = client_id
+        self.corpus = corpus
+        self.server_address = server_address
+        self.listen_address = listen_address
+        self.advertise_host = advertise_host
+        self.max_features = max_features
+        self.stop_words = stop_words
+        self.save_dir = save_dir
+        self.logger = logger or logging.getLogger(f"Client{client_id}")
+
+        self.stepper: FederatedStepper | None = None
+        self.global_vocab: Vocabulary | None = None
+        self.dataset: BowDataset | None = None
+        self.results: dict[str, Any] | None = None
+        self.stopped = threading.Event()
+        self._grpc_server = None
+
+    # ---- lifecycle ---------------------------------------------------------
+    def run(self) -> None:
+        """Blocking end-to-end client lifecycle; returns once the server's
+        stop broadcast has been processed and artifacts are written."""
+        self.join_federation()
+        self.serve_training()
+        self.stopped.wait()
+
+    def join_federation(self) -> None:
+        """Phases 1-2 of the client lifecycle (``client.py:378-507``)."""
+        channel = rpc.make_channel(self.server_address)
+        self._federation_stub = rpc.ServiceStub(channel, "gfedntm.Federation")
+
+        # 1. local vocabulary -> server (client.py:358-406)
+        local_vocab = build_vocabulary(
+            self.corpus.documents, max_features=self.max_features,
+            stop_words=self.stop_words,
+        )
+        self._federation_stub.OfferVocab(
+            pb.VocabOffer(
+                client_id=self.client_id,
+                tokens=list(local_vocab.tokens),
+                nr_samples=float(len(self.corpus)),
+            )
+        )
+
+        # 2. blocking wait for consensus + replicated init (client.py:408-507)
+        setup = self._federation_stub.GetGlobalSetup(
+            pb.JoinRequest(client_id=self.client_id)
+        )
+        self.global_vocab = Vocabulary(tuple(setup.vocab))
+        hyper = json.loads(setup.hyperparams_json)
+        model = build_template_model(
+            hyper["family"], len(self.global_vocab), hyper["kwargs"]
+        )
+        # Overwrite the locally-initialized state with the server's
+        # replicated init (NNUpdate/AdamUpdate semantics, client.py:498-503).
+        variables = codec.bundle_to_tree(
+            {"params": model.params, "batch_stats": model.batch_stats},
+            setup.init_variables,
+        )
+        model.params = variables["params"]
+        model.batch_stats = variables["batch_stats"]
+        model.opt_state = codec.bundle_to_tree(
+            model.opt_state, setup.init_opt_state
+        )
+
+        # 3. re-vectorize the local corpus against the GLOBAL vocabulary
+        # (client.py:460-468) and build the dataset
+        X = vectorize(self.corpus.documents, self.global_vocab)
+        if hyper["family"] == "ctm":
+            if self.corpus.embeddings is None:
+                raise ValueError("CTM federation requires embeddings")
+            labels = None
+            label_size = hyper["kwargs"].get("label_size", 0)
+            if label_size and self.corpus.labels is not None:
+                lab = np.asarray(self.corpus.labels)
+                labels = (
+                    lab if lab.ndim == 2
+                    else np.eye(label_size, dtype=np.float32)[lab]
+                )
+            self.dataset = CTMDataset(
+                X=X, idx2token=self.global_vocab.id2token,
+                X_ctx=self.corpus.embeddings, labels=labels,
+            )
+        else:
+            self.dataset = BowDataset(
+                X=X, idx2token=self.global_vocab.id2token
+            )
+
+        self.stepper = FederatedStepper(
+            model, grads_to_share=tuple(hyper["grads_to_share"])
+        )
+        self.stepper.pre_fit(self.dataset)
+
+    def serve_training(self) -> None:
+        """Start the in-client servicer and signal readiness
+        (``__start_client_server`` + ``__send_ready_for_training``,
+        ``client.py:282-319,509-532``)."""
+        servicer = FederatedClientServicer(
+            self.client_id, self.stepper, self._on_stop, self.logger
+        )
+        self._grpc_server = rpc.make_server(max_workers=4)
+        rpc.add_service(
+            self._grpc_server, "gfedntm.FederationClient", servicer
+        )
+        port = self._grpc_server.add_insecure_port(self.listen_address)
+        self._grpc_server.start()
+        self.logger.info("client %d serving on port %d", self.client_id, port)
+        self._federation_stub.ReadyForTraining(
+            pb.JoinRequest(
+                client_id=self.client_id,
+                address=f"{self.advertise_host}:{port}",
+            )
+        )
+
+    def _on_stop(self) -> None:
+        """Finalize on the server's stop broadcast: per-client artifacts
+        (thresholded thetas + betas + topics, ``client.py:173-183`` →
+        ``get_results_model``)."""
+        try:
+            self.results = self.stepper.get_results_model(self.save_dir)
+        finally:
+            self.stopped.set()
+
+    def shutdown(self, grace: float = 0.5) -> None:
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace)

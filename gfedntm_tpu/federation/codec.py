@@ -1,0 +1,105 @@
+"""numpy/pytree ⇄ protobuf tensor codecs for the network federation path.
+
+Rebuilds the role of ``src/utils/auxiliary_functions.py``'s codec family
+(``serializeTensor``/``deserializeNumpy`` :102-173, ``modelStateDict_to_proto``
+:301-385, ``optStateDict_to_proto`` :176-298) with one generalization: any
+pytree — Flax params, batch stats, or the full optax optimizer state —
+round-trips through a flat list of named ``TensorRecord``s, so there is no
+per-model field mapping and no Adam-only special case.
+
+Leaf naming uses ``jax.tree_util.keystr`` paths; restoration reuses the
+*template* tree's structure (both endpoints construct the same model, so
+structure equality is the invariant the protocol already relies on — the
+names are verified, not used for reordering).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+
+# dtype whitelist (superset of the reference's float32/float64/int64,
+# auxiliary_functions.py:24-35; int32/bool appear in optax/BatchNorm state).
+ALLOWED_DTYPES = frozenset(
+    {"float32", "float64", "bfloat16", "int32", "int64", "uint32", "bool"}
+)
+
+
+def array_to_record(name: str, value: Any) -> pb.TensorRecord:
+    arr = np.asarray(value)
+    dtype = arr.dtype.name
+    if dtype not in ALLOWED_DTYPES:
+        raise TypeError(f"dtype {dtype!r} of {name!r} is not serializable")
+    if dtype == "bfloat16":  # no stable raw-buffer format across stacks
+        arr, dtype = arr.astype(np.float32), "float32"
+    return pb.TensorRecord(
+        name=name, shape=list(arr.shape), dtype=dtype,
+        data=np.ascontiguousarray(arr).tobytes(),
+    )
+
+
+def record_to_array(record: pb.TensorRecord) -> np.ndarray:
+    if record.dtype not in ALLOWED_DTYPES:
+        raise TypeError(f"dtype {record.dtype!r} not allowed on the wire")
+    arr = np.frombuffer(record.data, dtype=np.dtype(record.dtype))
+    return arr.reshape(tuple(record.shape)).copy()
+
+
+# ---- flat {name: array} dicts (the shared-subset snapshots) ----------------
+
+def flatdict_to_bundle(tensors: Mapping[str, np.ndarray]) -> pb.TensorBundle:
+    return pb.TensorBundle(
+        tensors=[array_to_record(k, v) for k, v in sorted(tensors.items())]
+    )
+
+
+def bundle_to_flatdict(bundle: pb.TensorBundle) -> dict[str, np.ndarray]:
+    return {r.name: record_to_array(r) for r in bundle.tensors}
+
+
+# ---- arbitrary pytrees (params / batch_stats / optax state) ----------------
+
+def _leaf_names(tree: Any) -> list[str]:
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(p) for p, _ in paths]
+
+
+def tree_to_bundle(tree: Any) -> pb.TensorBundle:
+    """Serialize every array leaf of ``tree`` in flatten order."""
+    names = _leaf_names(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    return pb.TensorBundle(
+        tensors=[array_to_record(n, l) for n, l in zip(names, leaves)]
+    )
+
+
+def bundle_to_tree(template: Any, bundle: pb.TensorBundle) -> Any:
+    """Rebuild a pytree with ``template``'s structure from a bundle produced
+    by :func:`tree_to_bundle` on a structurally-identical tree. Leaf names
+    are checked to catch template/wire mismatches early."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    records = list(bundle.tensors)
+    if len(records) != len(leaves):
+        raise ValueError(
+            f"bundle has {len(records)} tensors, template {len(leaves)} leaves"
+        )
+    names = _leaf_names(template)
+    new_leaves = []
+    for name, leaf, record in zip(names, leaves, records):
+        if record.name != name:
+            raise ValueError(
+                f"leaf path mismatch: wire {record.name!r} vs template {name!r}"
+            )
+        arr = record_to_array(record)
+        tmpl = np.asarray(leaf)
+        if tuple(arr.shape) != tmpl.shape:
+            raise ValueError(
+                f"shape mismatch at {name!r}: wire {arr.shape} vs "
+                f"template {tmpl.shape}"
+            )
+        new_leaves.append(arr.astype(tmpl.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

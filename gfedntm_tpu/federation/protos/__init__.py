@@ -1,0 +1,9 @@
+"""Wire schema. Regenerate the message module after editing the schema:
+
+    cd gfedntm_tpu/federation/protos && protoc --python_out=. federated.proto
+
+(Only message codegen is needed; services are wired through gRPC's
+generic-handler API in :mod:`gfedntm_tpu.federation.rpc`.)
+"""
+
+from gfedntm_tpu.federation.protos import federated_pb2 as federated_pb2

@@ -1,0 +1,125 @@
+"""Thread-safe federation membership registry.
+
+Rebuilds ``src/federation/federation.py:14-170`` (``Federation``) and
+``src/federation/federation_client.py:10-125`` (``FederationClient``): the
+server's bookkeeping of connected clients across the consensus and training
+phases. Differences from the reference: clients are keyed by their declared
+``client_id`` (the reference keys by gRPC peer string and back-fills ids);
+state transitions are guarded by one RLock + a Condition so quorum waits are
+event-driven instead of poll-with-timeout (``server.py:237-238``'s
+``waiting`` library with its 120 s expiry — SURVEY.md §2.5 item 9)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ClientRecord:
+    """Per-client federation state (reference ``FederationClient``):
+    identity, FedAvg weight, phase flags, and training progress counters."""
+
+    client_id: int
+    nr_samples: float = 0.0
+    vocab: tuple[str, ...] = ()
+    address: str = ""
+    vocab_sent: bool = False
+    ready_for_training: bool = False
+    finished: bool = False
+    current_mb: int = 0
+    current_epoch: int = 0
+    last_loss: float = float("nan")
+
+
+@dataclass
+class Federation:
+    """Registry of connected clients with quorum signalling."""
+
+    min_clients: int = 1
+    _clients: dict[int, ClientRecord] = field(default_factory=dict)
+    _lock: threading.RLock = field(default_factory=threading.RLock)
+
+    def __post_init__(self):
+        self._cond = threading.Condition(self._lock)
+
+    # ---- consensus phase ---------------------------------------------------
+    def connect_vocab(
+        self, client_id: int, vocab: tuple[str, ...], nr_samples: float
+    ) -> ClientRecord:
+        with self._cond:
+            rec = self._clients.setdefault(client_id, ClientRecord(client_id))
+            rec.vocab = tuple(vocab)
+            rec.nr_samples = float(nr_samples)
+            rec.vocab_sent = True
+            self._cond.notify_all()
+            return rec
+
+    def wait_vocab_quorum(self, timeout: float | None = None) -> bool:
+        """Block until ``min_clients`` clients have offered vocabularies
+        (reference ``can_send_aggragated_vocab``, ``server.py:333-347``)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: sum(c.vocab_sent for c in self._clients.values())
+                >= self.min_clients,
+                timeout=timeout,
+            )
+
+    # ---- training phase ----------------------------------------------------
+    def connect_ready(self, client_id: int, address: str) -> ClientRecord:
+        with self._cond:
+            rec = self._clients.setdefault(client_id, ClientRecord(client_id))
+            rec.address = address
+            rec.ready_for_training = True
+            self._cond.notify_all()
+            return rec
+
+    def wait_training_quorum(self, timeout: float | None = None) -> bool:
+        """Reference ``can_start_training`` (``server.py:349-363``)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: sum(
+                    c.ready_for_training for c in self._clients.values()
+                )
+                >= self.min_clients,
+                timeout=timeout,
+            )
+
+    def update_progress(
+        self, client_id: int, current_mb: int, current_epoch: int,
+        loss: float, finished: bool,
+    ) -> None:
+        with self._lock:
+            rec = self._clients[client_id]
+            rec.current_mb = current_mb
+            rec.current_epoch = current_epoch
+            rec.last_loss = loss
+            rec.finished = finished or rec.finished
+
+    def disconnect(self, client_id: int) -> None:
+        with self._cond:
+            self._clients.pop(client_id, None)
+            self._cond.notify_all()
+
+    # ---- views -------------------------------------------------------------
+    def get_clients(self) -> list[ClientRecord]:
+        with self._lock:
+            return sorted(self._clients.values(), key=lambda c: c.client_id)
+
+    def active_clients(self) -> list[ClientRecord]:
+        with self._lock:
+            return [
+                c for c in self.get_clients()
+                if c.ready_for_training and not c.finished
+            ]
+
+    def total_weight(self) -> float:
+        with self._lock:
+            return float(
+                sum(c.nr_samples for c in self._clients.values()
+                    if c.ready_for_training)
+            )
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._clients)

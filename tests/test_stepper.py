@@ -52,16 +52,15 @@ def _make_steppers(datasets, num_epochs=2, cls=FederatedAVITM, model_fn=None):
 def test_two_client_protocol_runs_to_completion():
     datasets = _make_datasets()
     steppers = _make_steppers(datasets, num_epochs=2)
-    weights = [len(d) for d in datasets]
 
-    statuses = [None] * len(steppers)
     for _ in range(200):
         active = [s for s in steppers if not s.finished]
         if not active:
             break
         snaps = [s.train_mb_delta() for s in active]
         avg = _weighted_average(snaps, [len(s.model.train_data) for s in active])
-        statuses = [s.delta_update_fit(avg) for s in active]
+        for s in active:
+            s.delta_update_fit(avg)
     assert all(s.finished for s in steppers)
     assert all(s.current_epoch == 2 for s in steppers)
     # datasets differ in size -> different per-epoch step counts
