@@ -1,0 +1,317 @@
+"""Command-line entry point (rebuild of the reference ``main.py:178-291``).
+
+Three roles, selected by ``--id`` exactly as the reference does (server if
+``--id 0``, network client otherwise), plus the TPU-native default the
+reference cannot express: ``--id`` omitted runs the WHOLE federation as one
+SPMD program on the local device mesh (``simulate``), where the gRPC
+hub-and-spoke collapses into ``lax.psum`` over ICI.
+
+Data paths mirror ``main.py:138-152``: synthetic ``.npz`` archives (node
+``id-1`` of a multi-node archive) or real ``.parquet`` filtered by ``--fos``.
+Hyperparameters come from a reference-format INI (``--config``,
+``config/dft_params.cf`` works verbatim) with CLI overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Any
+
+import numpy as np
+
+from gfedntm_tpu.config import GfedConfig, from_ini
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu",
+        description=(
+            "TPU-native federated neural topic modeling. --id 0: federation "
+            "server; --id N: network client; no --id: whole federation as "
+            "one SPMD program."
+        ),
+    )
+    p.add_argument("--id", type=int, default=None,
+                   help="node id (0 = server, >=1 = client; omit to simulate)")
+    p.add_argument("--source", type=str, default=None,
+                   help="data path (.npz synthetic archive or .parquet)")
+    p.add_argument("--data_type", choices=("synthetic", "real"),
+                   default="synthetic")
+    p.add_argument("--fos", type=str, default=None,
+                   help="parquet category filter; comma-list = one client "
+                        "per category in simulate mode")
+    p.add_argument("--min_clients_federation", type=int, default=1)
+    p.add_argument("--model_type", choices=("avitm", "ctm"), default="avitm")
+    p.add_argument("--max_iters", type=int, default=25_000)
+    p.add_argument("--config", type=str, default=None,
+                   help="reference-format INI (config/dft_params.cf)")
+    p.add_argument("--server_address", type=str, default="localhost:50051")
+    p.add_argument("--listen_port", type=int, default=None,
+                   help="serving port (default: 50051 for the server, "
+                        "50051+id for clients — the reference scheme)")
+    p.add_argument("--save_dir", type=str, default="output")
+    p.add_argument("--n_clients", type=int, default=None,
+                   help="simulate mode: partition a single corpus into N "
+                        "IID shards (ignored for multi-node archives)")
+    p.add_argument("--num_epochs", type=int, default=None)
+    p.add_argument("--n_components", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def load_config(args: argparse.Namespace) -> GfedConfig:
+    import dataclasses
+
+    cfg = from_ini(args.config) if args.config else GfedConfig()
+    train_over = {
+        k: getattr(args, k)
+        for k in ("num_epochs", "batch_size", "seed")
+        if getattr(args, k) is not None
+    }
+    if train_over:
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_over))
+    if args.n_components is not None:
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, n_components=args.n_components)
+        )
+    return cfg
+
+
+def model_kwargs_from_config(cfg: GfedConfig, family: str) -> dict[str, Any]:
+    """Flatten the typed config into AVITM/CTM constructor kwargs (the
+    hyperparameter set the reference protofies at ``server.py:241-267``)."""
+    m, t = cfg.model, cfg.train
+    kwargs: dict[str, Any] = dict(
+        n_components=m.n_components,
+        model_type=m.model_type,
+        hidden_sizes=tuple(m.hidden_sizes),
+        activation=m.activation,
+        dropout=m.dropout,
+        learn_priors=m.learn_priors,
+        topic_prior_mean=m.topic_prior_mean,
+        topic_prior_variance=m.topic_prior_variance,
+        batch_size=t.batch_size,
+        lr=t.lr,
+        momentum=t.momentum,
+        solver=t.solver,
+        num_epochs=t.num_epochs,
+        num_samples=t.num_samples,
+        reduce_on_plateau=t.reduce_on_plateau,
+        seed=t.seed,
+    )
+    if family == "ctm":
+        kwargs.update(
+            contextual_size=m.contextual_size,
+            label_size=m.label_size,
+            inference_type=m.inference_type("ctm"),
+            loss_weights={"beta": m.loss_beta_weight},
+        )
+    return kwargs
+
+
+def _load_corpora(args: argparse.Namespace):
+    """Resolve ``--source``/``--data_type``/``--fos`` into per-client corpora
+    (simulate) plus optional synthetic ground truth."""
+    from gfedntm_tpu.data.loaders import (
+        RawCorpus,
+        load_parquet_corpus,
+        partition_corpus,
+    )
+    from gfedntm_tpu.data.synthetic import load_reference_npz
+
+    if args.data_type == "synthetic":
+        if args.source is None:
+            raise SystemExit("--source <archive.npz> required for synthetic data")
+        corpus = load_reference_npz(args.source)
+        corpora = [RawCorpus(documents=n.documents) for n in corpus.nodes]
+        return corpora, corpus
+    if args.source is None:
+        raise SystemExit("--source <corpus.parquet> required for real data")
+    if args.fos and "," in args.fos:
+        corpora = [
+            load_parquet_corpus(args.source, fos=f.strip())
+            for f in args.fos.split(",")
+        ]
+    else:
+        one = load_parquet_corpus(args.source, fos=args.fos)
+        corpora = partition_corpus(one, args.n_clients or 1)
+    return corpora, None
+
+
+# ---- roles -----------------------------------------------------------------
+
+def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
+    """``--id 0``: network federation server (``main.py:27-95``)."""
+    from gfedntm_tpu.federation.server import FederatedServer
+
+    server = FederatedServer(
+        min_clients=args.min_clients_federation,
+        family=args.model_type,
+        model_kwargs=model_kwargs_from_config(cfg, args.model_type),
+        grads_to_share=cfg.federation.grads_to_share,
+        max_iters=args.max_iters,
+        save_dir=args.save_dir,
+    )
+    port = args.listen_port if args.listen_port is not None else 50051
+    server.start(f"[::]:{port}")
+    logging.info("server on port %d; waiting for federation", port)
+    server.wait_done()
+    server.stop()
+    return 0
+
+
+def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
+    """``--id N``: network federation client (``main.py:98-175``)."""
+    from gfedntm_tpu.data.loaders import RawCorpus, load_parquet_corpus
+    from gfedntm_tpu.data.synthetic import load_reference_npz
+    from gfedntm_tpu.federation.client import Client
+
+    if args.data_type == "synthetic":
+        archive = load_reference_npz(args.source)
+        node = archive.nodes[(args.id - 1) % len(archive.nodes)]
+        corpus = RawCorpus(documents=node.documents)
+    else:
+        corpus = load_parquet_corpus(args.source, fos=args.fos)
+
+    port = (
+        args.listen_port if args.listen_port is not None else 50051 + args.id
+    )
+    client = Client(
+        client_id=args.id,
+        corpus=corpus,
+        server_address=args.server_address,
+        listen_address=f"[::]:{port}",
+        max_features=cfg.data.max_features,
+        stop_words=cfg.data.stop_words,
+        save_dir=os.path.join(args.save_dir, f"client{args.id}"),
+    )
+    client.run()
+    client.shutdown()
+    return 0
+
+
+def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
+    """No ``--id``: the whole federation as ONE SPMD program (the TPU-native
+    path — no server process, no RPC; SURVEY.md §7.1)."""
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.eval.metrics import (
+        convert_topic_word_to_init_size,
+        topic_similarity_score,
+    )
+    from gfedntm_tpu.federated.consensus import run_vocab_consensus
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+    from gfedntm_tpu.models.avitm import AVITM
+    from gfedntm_tpu.models.ctm import CTM
+    from gfedntm_tpu.utils.observability import MetricsLogger, phase_timer
+
+    corpora, synthetic = _load_corpora(args)
+    n_clients = len(corpora)
+    metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
+
+    with phase_timer(metrics, "consensus"):
+        if synthetic is not None:
+            # fixed wd-token vocabulary: skip tokenization, reuse the BoW
+            idx2token = dict(enumerate(synthetic.vocab_tokens))
+            datasets = [
+                BowDataset(X=n.bow, idx2token=idx2token)
+                for n in synthetic.nodes
+            ]
+            vocab_size = len(synthetic.vocab_tokens)
+        else:
+            consensus = run_vocab_consensus(
+                corpora,
+                max_features=cfg.data.max_features,
+                stop_words=cfg.data.stop_words,
+                contextual=args.model_type == "ctm",
+                label_size=cfg.model.label_size,
+            )
+            datasets = consensus.datasets
+            vocab_size = len(consensus.global_vocab)
+
+    kwargs = model_kwargs_from_config(cfg, args.model_type)
+    kwargs["input_size"] = vocab_size
+    template = (
+        AVITM(**kwargs) if args.model_type == "avitm" else CTM(**kwargs)
+    )
+    trainer = FederatedTrainer(
+        template,
+        n_clients=n_clients,
+        grads_to_share=cfg.federation.grads_to_share,
+        max_iters=args.max_iters,
+        seed=cfg.train.seed,
+    )
+    with phase_timer(metrics, "federated_fit", n_clients=n_clients):
+        result = trainer.fit(datasets, metrics=metrics)
+
+    global_model = trainer.make_global_model(result)
+    global_model.train_data = datasets[0]
+    summary: dict[str, Any] = {
+        "n_clients": n_clients,
+        "vocab_size": vocab_size,
+        "global_steps": int(result.losses.shape[0]),
+        "final_mean_loss": float(result.losses[-1].mean()),
+    }
+    os.makedirs(args.save_dir, exist_ok=True)
+    from gfedntm_tpu.utils.serialization import save_model_as_npz
+
+    save_model_as_npz(
+        args.save_dir,
+        betas=global_model.get_topic_word_distribution(),
+        thetas=None,
+        topics=global_model.get_topics(),
+        n_components=template.n_components,
+        name="global_model",
+    )
+    for c in range(n_clients):
+        client_model = trainer.make_client_model(result, c, datasets[c])
+        thetas = client_model.get_doc_topic_distribution(
+            datasets[c], cfg.train.num_samples
+        )
+        thetas = np.where(thetas < cfg.train.thetas_thr, 0.0, thetas)
+        norm = thetas.sum(axis=1, keepdims=True)
+        thetas /= np.where(norm == 0, 1.0, norm)
+        save_model_as_npz(
+            os.path.join(args.save_dir, f"client{c + 1}"),
+            betas=client_model.get_topic_word_distribution(),
+            thetas=thetas,
+            topics=client_model.get_topics(),
+            n_components=template.n_components,
+        )
+
+    if synthetic is not None:
+        betas = convert_topic_word_to_init_size(
+            synthetic.topic_vectors.shape[1],
+            global_model.get_topic_word_distribution(),
+            dict(enumerate(synthetic.vocab_tokens)),
+        )
+        summary["tss"] = topic_similarity_score(
+            betas, synthetic.topic_vectors
+        )
+    metrics.log("summary", **summary)
+    metrics.close()
+    print(json.dumps(summary))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s [%(threadName)s] %(levelname)s: %(message)s",
+    )
+    cfg = load_config(args)
+    if args.id is None:
+        return run_simulate(args, cfg)
+    if args.id == 0:
+        return run_server(args, cfg)
+    return run_client(args, cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

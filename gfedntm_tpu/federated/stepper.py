@@ -216,6 +216,11 @@ class FederatedStepper:
         ``3e-3`` and L1-renormalized, softmax betas, top-word topics; npz
         bundle when ``save_dir`` given (``federated_model.py:151-181``)."""
         m = self.model
+        if m.best_components is None:
+            # stopped before the first epoch completed: fall back to the
+            # current beta so finalization still produces artifacts
+            m.best_components = np.asarray(m.params["beta"])
+            self.best_components = m.best_components
         n = n_samples or m.num_samples
         thetas = m.get_doc_topic_distribution(m.train_data, n)
         thetas = np.where(thetas < THETAS_THRESHOLD, 0.0, thetas)

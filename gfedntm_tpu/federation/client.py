@@ -220,6 +220,11 @@ class Client:
         ``get_results_model``)."""
         try:
             self.results = self.stepper.get_results_model(self.save_dir)
+        except Exception:
+            self.logger.exception(
+                "client %d finalization failed", self.client_id
+            )
+            raise
         finally:
             self.stopped.set()
 

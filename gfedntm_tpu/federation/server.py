@@ -87,6 +87,7 @@ class FederatedServer:
         self.template: AVITM | None = None
         self.global_vocab: Vocabulary | None = None
         self.last_average: dict[str, np.ndarray] | None = None
+        self.global_betas: np.ndarray | None = None
         self.global_iterations = 0
 
         self._setup_lock = threading.Lock()
@@ -98,7 +99,13 @@ class FederatedServer:
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self, address: str = "[::]:50051") -> str:
-        self._grpc_server = rpc.make_server(max_workers=self.poll_workers)
+        # Every client parks one worker thread inside GetGlobalSetup until
+        # quorum; size the pool so intake RPCs can still be dispatched.
+        self._grpc_server = rpc.make_server(
+            max_workers=max(
+                self.poll_workers, 2 * self.federation.min_clients + 4
+            )
+        )
         rpc.add_service(self._grpc_server, "gfedntm.Federation", self)
         port = self._grpc_server.add_insecure_port(address)
         self._grpc_server.start()
@@ -135,14 +142,13 @@ class FederatedServer:
         return self._setup_reply
 
     def _build_setup_reply(self) -> pb.GlobalSetup:
+        from gfedntm_tpu.data.vocab import union_vocabularies
+
         vocabs = [
             Vocabulary(c.vocab) for c in self.federation.get_clients()
             if c.vocab_sent
         ]
-        merged: set[str] = set()
-        for v in vocabs:
-            merged.update(v.tokens)
-        self.global_vocab = Vocabulary(tuple(sorted(merged)))
+        self.global_vocab = union_vocabularies(vocabs)
         self.template = build_template_model(
             self.family, len(self.global_vocab), self.model_kwargs
         )
@@ -187,15 +193,15 @@ class FederatedServer:
         return pb.Ack(code=0, detail="ready recorded")
 
     # ---- phase-2 training loop (server.py:408-553) -------------------------
-    def _client_stubs(self) -> dict[int, rpc.ServiceStub]:
-        stubs = {}
-        for rec in self.federation.get_clients():
-            if rec.ready_for_training and rec.address:
-                channel = rpc.make_channel(rec.address)
-                stubs[rec.client_id] = rpc.ServiceStub(
-                    channel, "gfedntm.FederationClient"
-                )
-        return stubs
+    def _stub_for(self, stubs: dict, rec) -> rpc.ServiceStub | None:
+        """Persistent per-client stub, created on first use so clients that
+        become ready after the loop starts still get polled."""
+        if rec.client_id not in stubs and rec.address:
+            channel = rpc.make_channel(rec.address)
+            stubs[rec.client_id] = rpc.ServiceStub(
+                channel, "gfedntm.FederationClient"
+            )
+        return stubs.get(rec.client_id)
 
     def _run_training(self) -> None:
         try:
@@ -206,12 +212,11 @@ class FederatedServer:
             self.training_done.set()
 
     def _training_loop(self) -> None:
-        stubs = self._client_stubs()
-        total_weight = self.federation.total_weight()
+        stubs: dict[int, rpc.ServiceStub] = {}
         pool = ThreadPoolExecutor(max_workers=self.poll_workers)
         self.logger.info(
-            "starting federated training: %d clients, total weight %.0f",
-            len(stubs), total_weight,
+            "starting federated training: total weight %.0f",
+            self.federation.total_weight(),
         )
 
         for iteration in range(self.max_iters):
@@ -222,7 +227,10 @@ class FederatedServer:
             # 1. concurrent poll: one local step per client
             def poll(rec):
                 try:
-                    return rec, stubs[rec.client_id].TrainStep(
+                    stub = self._stub_for(stubs, rec)
+                    if stub is None:
+                        raise RuntimeError("client has no serving address")
+                    return rec, stub.TrainStep(
                         pb.StepRequest(global_iter=iteration)
                     )
                 except Exception as exc:
@@ -243,14 +251,17 @@ class FederatedServer:
                 break
 
             # 2. sample-weighted average over the shared subset, weighted by
-            # each client's total corpus size (server.py:476-487)
+            # each client's total corpus size (server.py:476-487). The
+            # denominator is THIS round's contributors — clients that
+            # finished early or were dropped must not dilute the average.
             snapshots = [
                 (rec.nr_samples, codec.bundle_to_flatdict(reply.shared))
                 for rec, reply in replies
             ]
+            round_weight = float(sum(w for w, _ in snapshots))
             keys = snapshots[0][1].keys()
             average = {
-                k: sum(w * s[k] for w, s in snapshots) / total_weight
+                k: sum(w * s[k] for w, s in snapshots) / round_weight
                 for k in keys
             }
             self.last_average = average
@@ -285,14 +296,22 @@ class FederatedServer:
                     ),
                 )
 
-        # 4. stop broadcast + server-side artifact (server.py:523-551)
+        # 4. stop broadcast + server-side artifact (server.py:523-551);
+        # every ready client gets the broadcast, stub created if need be
         stop = pb.Aggregate(stop=True)
         for rec in self.federation.get_clients():
-            if rec.client_id in stubs:
-                try:
-                    stubs[rec.client_id].ApplyAggregate(stop)
-                except Exception:
-                    pass
+            if not rec.ready_for_training:
+                continue
+            stub = self._stub_for(stubs, rec)
+            if stub is None:
+                continue
+            try:
+                stub.ApplyAggregate(stop)
+            except Exception as exc:
+                self.logger.warning(
+                    "stop broadcast to client %d failed: %s",
+                    rec.client_id, exc,
+                )
         self._finalize()
         pool.shutdown(wait=False)
 

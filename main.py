@@ -1,0 +1,15 @@
+"""Entry point, role-compatible with the reference's ``main.py``:
+
+    python main.py --id 0 --min_clients_federation 5 --model_type ctm   # server
+    python main.py --id 1 --source corpus.parquet --data_type real      # client
+    python main.py --source synthetic.npz                               # SPMD sim
+
+See :mod:`gfedntm_tpu.cli` for the full surface.
+"""
+
+import sys
+
+from gfedntm_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,9 +80,12 @@ def _make_corpora(n_clients: int, docs: int = 18, seed: int = 0):
     corpora = []
     for c in range(n_clients):
         lo = 20 * c
+        # sizes diverge enough that steps-per-epoch differ across clients
+        # (18 vs 40 docs at batch 8 -> 3 vs 5 steps), so the early-finisher
+        # drop-out path of the aggregation loop is exercised
         docs_c = [
             " ".join(rng.choice(words[lo:lo + 60], size=25))
-            for _ in range(docs + 6 * c)
+            for _ in range(docs + 22 * c)
         ]
         corpora.append(RawCorpus(documents=docs_c))
     return corpora
@@ -148,9 +151,41 @@ def test_grpc_federation_end_to_end(tmp_path):
     # consensus vocabulary is the sorted union of client vocabularies
     tokens = server.global_vocab.tokens
     assert list(tokens) == sorted(tokens)
+
+    # unequal epoch lengths: the late-running client keeps averaging after
+    # the early one finishes; a stale total-weight denominator would have
+    # shrunk the betas toward zero exponentially (regression guard)
+    assert np.abs(server.global_betas).max() > 1e-3
     server.stop()
     for cl in clients:
         cl.shutdown()
+
+
+@pytest.mark.slow
+def test_grpc_federation_stop_before_first_epoch(tmp_path):
+    """max_iters smaller than steps-per-epoch: clients must still finalize
+    (best_components falls back to the current beta)."""
+    server = FederatedServer(
+        min_clients=1, family="avitm",
+        model_kwargs=dict(
+            n_components=3, hidden_sizes=(8, 8), batch_size=8, num_epochs=5,
+            seed=0,
+        ),
+        max_iters=2, save_dir=str(tmp_path),
+    )
+    addr = server.start("[::]:0")
+    client = Client(
+        client_id=1, corpus=_make_corpora(1, docs=30)[0], server_address=addr,
+        max_features=60, save_dir=str(tmp_path / "c1"),
+    )
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    assert server.wait_done(timeout=180)
+    t.join(timeout=60)
+    assert client.results is not None
+    assert (tmp_path / "c1" / "model.npz").exists()
+    server.stop()
+    client.shutdown()
 
 
 @pytest.mark.slow
