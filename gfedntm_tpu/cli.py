@@ -46,7 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "per category in simulate mode")
     p.add_argument("--min_clients_federation", type=int, default=1)
     p.add_argument("--model_type", choices=("avitm", "ctm"), default="avitm")
-    p.add_argument("--max_iters", type=int, default=25_000)
+    p.add_argument("--max_iters", type=int, default=None,
+                   help="global step cap (default: INI federation.max_iters, "
+                        "else 25000)")
     p.add_argument("--config", type=str, default=None,
                    help="reference-format INI (config/dft_params.cf)")
     p.add_argument("--server_address", type=str, default="localhost:50051")
@@ -79,6 +81,12 @@ def load_config(args: argparse.Namespace) -> GfedConfig:
     if args.n_components is not None:
         cfg = cfg.replace(
             model=dataclasses.replace(cfg.model, n_components=args.n_components)
+        )
+    if args.max_iters is not None:
+        cfg = cfg.replace(
+            federation=dataclasses.replace(
+                cfg.federation, max_iters=args.max_iters
+            )
         )
     return cfg
 
@@ -155,7 +163,7 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         family=args.model_type,
         model_kwargs=model_kwargs_from_config(cfg, args.model_type),
         grads_to_share=cfg.federation.grads_to_share,
-        max_iters=args.max_iters,
+        max_iters=cfg.federation.max_iters,
         save_dir=args.save_dir,
     )
     port = args.listen_port if args.listen_port is not None else 50051
@@ -172,6 +180,10 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
     from gfedntm_tpu.data.synthetic import load_reference_npz
     from gfedntm_tpu.federation.client import Client
 
+    if args.source is None:
+        raise SystemExit(
+            "--source required (synthetic .npz archive or .parquet corpus)"
+        )
     if args.data_type == "synthetic":
         archive = load_reference_npz(args.source)
         node = archive.nodes[(args.id - 1) % len(archive.nodes)]
@@ -211,6 +223,12 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
     from gfedntm_tpu.utils.observability import MetricsLogger, phase_timer
 
     corpora, synthetic = _load_corpora(args)
+    if synthetic is not None and args.model_type == "ctm":
+        raise SystemExit(
+            "--model_type ctm needs contextual embeddings; synthetic .npz "
+            "archives carry none (use --data_type real with an 'embeddings' "
+            "parquet column, as the reference does)"
+        )
     n_clients = len(corpora)
     metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
 
@@ -243,7 +261,7 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
         template,
         n_clients=n_clients,
         grads_to_share=cfg.federation.grads_to_share,
-        max_iters=args.max_iters,
+        max_iters=cfg.federation.max_iters,
         seed=cfg.train.seed,
     )
     with phase_timer(metrics, "federated_fit", n_clients=n_clients):

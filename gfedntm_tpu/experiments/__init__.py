@@ -1,0 +1,23 @@
+"""Experiment harnesses — the reference's L5 layer, rebuilt natively.
+
+Rebuilds `experiments/dss_tss/run_simulation.py` (DSS/TSS simulations),
+`experiments/collab_vs_non_collab/train.py` (real-corpus comparisons),
+`src/aux_modules/tmWrapper/tm_wrapper.py` (centralized-baseline driver) and
+`aux_scripts/evaluation/wmd.py` (word-mover's-distance evaluation) on top of
+the TPU-native model stack — no Java Mallet, Spark, or subprocess drivers.
+"""
+
+from gfedntm_tpu.experiments.dss_tss import (  # noqa: F401
+    SimulationConfig,
+    run_iter_simulation,
+    run_simulation,
+)
+from gfedntm_tpu.experiments.tm_wrapper import TMWrapper  # noqa: F401
+from gfedntm_tpu.experiments.collab import (  # noqa: F401
+    CollabExperimentConfig,
+    run_collab_experiment,
+)
+from gfedntm_tpu.experiments.wmd import (  # noqa: F401
+    topic_set_wmd_matrix,
+    wmd_centralized_vs_nodes,
+)

@@ -1,0 +1,272 @@
+"""DSS/TSS ground-truth recovery simulations.
+
+Rebuilds the reference experiment `experiments/dss_tss/run_simulation.py`:
+per sweep point (eta or number-of-frozen-topics), repeat ``iters`` times:
+generate a synthetic multi-node LDA corpus with known topic-word
+(``topic_vectors``) and doc-topic (``doc_topics``) distributions, then score
+
+- a **centralized** model trained on the union of all node corpora,
+- **non-collaborative** per-node models (scores averaged over nodes),
+- a **random baseline** (Dirichlet-random betas / thetas),
+
+with TSS (topic similarity, `run_simulation.py:321-334`) on betas reprojected
+onto the full synthetic vocabulary and DSS (doc-similarity error,
+`run_simulation.py:337-355`) on thetas inferred for a held-out global
+inference corpus. Results aggregate to mean/std per sweep point
+(`run_simulation.py:618-734`) and are saved as JSON (+ pickle of a pandas
+DataFrame matching the reference artifact schema when pandas is available).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pickle
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from gfedntm_tpu.data.datasets import BowDataset
+from gfedntm_tpu.data.preparation import prepare_dataset
+from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+from gfedntm_tpu.data.vocab import vectorize
+from gfedntm_tpu.eval.metrics import (
+    convert_topic_word_to_init_size,
+    document_similarity_score,
+    topic_similarity_score,
+)
+from gfedntm_tpu.models.avitm import AVITM
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SimulationConfig:
+    """Mirror of the reference's ``config.json`` schema
+    (`experiments/dss_tss/config/*/config.json`)."""
+
+    vocab_size: int = 5000
+    n_topics: int = 50
+    beta: float = 0.01          # eta: topic-word Dirichlet prior
+    alpha: float = 0.02         # doc-topic Dirichlet prior (frozen part)
+    n_docs: int = 10000         # training docs per node
+    n_docs_global_inf: int = 1000   # held-out inference docs per node
+    n_nodes: int = 5
+    frozen_topics: int = 40
+    nwords: tuple[int, int] = (150, 250)
+    experiment: int = 1         # 0: sweep frozen topics; 1: sweep eta
+    frozen_topics_list: tuple[int, ...] = (10, 20, 30, 40, 48)
+    eta_list: tuple[float, ...] = (1e-4, 1e-3, 1e-2, 1e-1)
+    iters: int = 20
+    # model hyperparameters (reference train_avitm: hidden (100,100), 100 ep)
+    hidden_sizes: tuple[int, ...] = (100, 100)
+    num_epochs: int = 100
+    batch_size: int = 64
+    lr: float = 2e-3
+    seed: int = 0
+    model_kwargs: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_json(cls, path: str | Path) -> "SimulationConfig":
+        with open(path, encoding="utf8") as f:
+            info = json.load(f)
+        kwargs: dict[str, Any] = {}
+        for key in (
+            "vocab_size", "n_topics", "beta", "alpha", "n_docs",
+            "n_docs_global_inf", "n_nodes", "frozen_topics", "experiment",
+            "iters",
+        ):
+            if key in info:
+                kwargs[key] = info[key]
+        if "nwords" in info:
+            nw = info["nwords"]
+            kwargs["nwords"] = (
+                tuple(nw.values()) if isinstance(nw, dict) else tuple(nw)
+            )
+        for key in ("frozen_topics_list", "eta_list"):
+            if key in info:
+                v = info[key]
+                v = v.split() if isinstance(v, str) else v
+                cast = int if key == "frozen_topics_list" else float
+                kwargs[key] = tuple(cast(x) for x in v)
+        return cls(**kwargs)
+
+
+def _train_avitm(
+    corpus: list[str], cfg: SimulationConfig, seed: int
+) -> tuple[AVITM, Any, dict[int, str]]:
+    """Reference ``train_avitm`` (`run_simulation.py:271-318`): 25% val
+    split, CountVectorizer vocab, prodLDA fit with early stopping."""
+    train_data, val_data, input_size, id2token, _docs, vocab = prepare_dataset(
+        corpus
+    )
+    model = AVITM(
+        input_size=input_size,
+        n_components=cfg.n_topics,
+        hidden_sizes=cfg.hidden_sizes,
+        batch_size=cfg.batch_size,
+        num_epochs=cfg.num_epochs,
+        lr=cfg.lr,
+        seed=seed,
+        **cfg.model_kwargs,
+    )
+    model.fit(train_data, val_data)
+    return model, vocab, id2token
+
+
+def _score_model(
+    model: AVITM,
+    vocab,
+    id2token: dict[int, str],
+    cfg: SimulationConfig,
+    inf_docs: list[str],
+    topic_vectors: np.ndarray,
+    inf_doc_topics: np.ndarray,
+) -> tuple[float, float]:
+    """TSS on reprojected betas + DSS on inferred thetas for ``inf_docs``."""
+    betas = model.get_topic_word_distribution()
+    betas_full = convert_topic_word_to_init_size(
+        cfg.vocab_size, betas, id2token
+    )
+    tss = topic_similarity_score(betas_full, topic_vectors)
+
+    val_bow = vectorize(inf_docs, vocab)
+    val_data = BowDataset(X=val_bow, idx2token=id2token)
+    thetas_inf = model.get_doc_topic_distribution(val_data)
+    dss = document_similarity_score(thetas_inf, inf_doc_topics)
+    return tss, dss
+
+
+def run_iter_simulation(
+    cfg: SimulationConfig, seed: int
+) -> dict[str, dict[str, float]]:
+    """One simulation iteration (`run_simulation.py:361-512`): generate,
+    train all three arms, score. Returns
+    ``{arm: {"betas": TSS, "thetas": DSS}}``."""
+    rng = np.random.default_rng(seed)
+    docs_per_node = cfg.n_docs + cfg.n_docs_global_inf
+    corpus = generate_synthetic_corpus(
+        vocab_size=cfg.vocab_size,
+        n_topics=cfg.n_topics,
+        beta=cfg.beta,
+        alpha=cfg.alpha,
+        n_docs=docs_per_node,
+        nwords=cfg.nwords,
+        n_nodes=cfg.n_nodes,
+        frozen_topics=cfg.frozen_topics,
+        seed=seed,
+    )
+    topic_vectors = corpus.topic_vectors
+
+    train_docs = [node.documents[: cfg.n_docs] for node in corpus.nodes]
+    inf_docs = [
+        doc
+        for node in corpus.nodes
+        for doc in node.documents[cfg.n_docs : docs_per_node]
+    ]
+    inf_doc_topics = np.concatenate(
+        [node.doc_topics[cfg.n_docs : docs_per_node] for node in corpus.nodes]
+    )
+
+    result: dict[str, dict[str, float]] = {}
+
+    # Baseline arm: Dirichlet-random betas/thetas (`run_simulation.py:396-400,505-512`).
+    random_betas = rng.dirichlet(
+        np.full(cfg.vocab_size, cfg.beta), cfg.n_topics
+    )
+    random_thetas = rng.dirichlet(
+        np.full(cfg.n_topics, cfg.alpha), len(inf_doc_topics)
+    )
+    result["baseline"] = {
+        "betas": topic_similarity_score(random_betas, topic_vectors),
+        "thetas": document_similarity_score(random_thetas, inf_doc_topics),
+    }
+
+    # Centralized arm: one model on the union of node corpora.
+    logger.info("simulation: centralized arm (seed=%d)", seed)
+    central_corpus = [doc for docs in train_docs for doc in docs]
+    model, vocab, id2token = _train_avitm(central_corpus, cfg, seed)
+    tss, dss = _score_model(
+        model, vocab, id2token, cfg, inf_docs, topic_vectors, inf_doc_topics
+    )
+    result["centralized"] = {"betas": tss, "thetas": dss}
+
+    # Non-collaborative arm: per-node models, scores averaged.
+    tss_nodes, dss_nodes = [], []
+    for node_id in range(cfg.n_nodes):
+        logger.info("simulation: non-collab node %d (seed=%d)", node_id, seed)
+        model, vocab, id2token = _train_avitm(
+            train_docs[node_id], cfg, seed + node_id + 1
+        )
+        tss, dss = _score_model(
+            model, vocab, id2token, cfg, inf_docs, topic_vectors,
+            inf_doc_topics,
+        )
+        tss_nodes.append(tss)
+        dss_nodes.append(dss)
+    result["non_colab"] = {
+        "betas": float(np.mean(tss_nodes)),
+        "thetas": float(np.mean(dss_nodes)),
+    }
+    return result
+
+
+def run_simulation(
+    cfg: SimulationConfig, results_dir: str | Path | None = None
+) -> dict[str, Any]:
+    """Full sweep (`run_simulation.py:618-734`): for each sweep point run
+    ``cfg.iters`` iterations and aggregate mean/std per arm/statistic.
+
+    Returns ``{"index": [...], "index_name": ..., "columns":
+    {"<arm>_<stat>_<mean|std>": [...]}}`` and, when ``results_dir`` is given,
+    writes ``results.json`` plus — if pandas is importable — the reference's
+    ``results.pickle`` DataFrame artifact."""
+    if cfg.experiment == 0:
+        sweep = list(cfg.frozen_topics_list)
+        index_name = "Nr frozen topics"
+    else:
+        sweep = list(cfg.eta_list)
+        index_name = "Eta"
+
+    arms = ("centralized", "non_colab", "baseline")
+    stats = ("betas", "thetas")
+    columns: dict[str, list[float]] = {
+        f"{arm}_{stat}_{agg}": []
+        for arm in arms for stat in stats for agg in ("mean", "std")
+    }
+
+    for point in sweep:
+        point_cfg = SimulationConfig(**{**cfg.__dict__})
+        if cfg.experiment == 0:
+            point_cfg.frozen_topics = int(point)
+        else:
+            point_cfg.beta = float(point)
+        per_iter = {arm: {stat: [] for stat in stats} for arm in arms}
+        for it in range(cfg.iters):
+            res = run_iter_simulation(point_cfg, seed=cfg.seed + 1000 * it)
+            for arm in arms:
+                for stat in stats:
+                    per_iter[arm][stat].append(res[arm][stat])
+        for arm in arms:
+            for stat in stats:
+                vals = np.asarray(per_iter[arm][stat])
+                columns[f"{arm}_{stat}_mean"].append(float(vals.mean()))
+                columns[f"{arm}_{stat}_std"].append(float(vals.std()))
+
+    out = {"index": sweep, "index_name": index_name, "columns": columns}
+    if results_dir is not None:
+        results_dir = Path(results_dir)
+        results_dir.mkdir(parents=True, exist_ok=True)
+        with open(results_dir / "results.json", "w", encoding="utf8") as f:
+            json.dump(out, f, indent=2)
+        try:
+            import pandas as pd
+
+            df = pd.DataFrame(columns, index=pd.Index(sweep, name=index_name))
+            with open(results_dir / "results.pickle", "wb") as f:
+                pickle.dump(df, f)
+        except ImportError:
+            pass
+    return out

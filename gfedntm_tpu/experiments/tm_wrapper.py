@@ -1,0 +1,153 @@
+"""Native centralized-baseline driver (the reference's TMWrapper).
+
+The reference's `src/aux_modules/tmWrapper/tm_wrapper.py:15-400` shells out to
+the external ``topicmodeler`` git submodule (Java Mallet / torch CTM) to train
+centralized baseline models, manages model folders with backup semantics
+(`tm_wrapper.py:226-241`), writes train-config JSONs
+(`tm_wrapper.py:123-169`), and computes post-hoc quality metrics — NPMI
+coherence vs a reference corpus, RBO, topic diversity
+(`tm_wrapper.py:358-400`).
+
+This rebuild trains the framework's own TPU-native AVITM/CTM models in
+process — no subprocesses, no Java — while keeping the same workflow surface:
+named model folders, persisted train configs, timing, and the same metric set
+(computed by :mod:`gfedntm_tpu.eval.metrics`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Sequence
+
+import numpy as np
+
+from gfedntm_tpu.data.preparation import prepare_dataset, prepare_ctm_dataset
+from gfedntm_tpu.eval.metrics import (
+    inverted_rbo,
+    npmi_coherence,
+    topic_diversity,
+)
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.models.ctm import CombinedTM, ZeroShotTM
+
+logger = logging.getLogger(__name__)
+
+
+class TMWrapper:
+    """Train/evaluate centralized topic models with managed output folders."""
+
+    def __init__(self, models_root: str | Path):
+        self.models_root = Path(models_root)
+        self.models_root.mkdir(parents=True, exist_ok=True)
+
+    # ---- folder management (`tm_wrapper.py:226-241`) -----------------------
+    def _prepare_model_dir(self, name: str, overwrite: bool = True) -> Path:
+        """Create the model folder; an existing one is moved aside to
+        ``<name>_old`` first (reference backup semantics)."""
+        model_dir = self.models_root / name
+        if model_dir.exists():
+            backup = self.models_root / f"{name}_old"
+            if backup.exists():
+                shutil.rmtree(backup)
+            if overwrite:
+                model_dir.rename(backup)
+            else:
+                raise FileExistsError(str(model_dir))
+        model_dir.mkdir(parents=True)
+        return model_dir
+
+    # ---- training ----------------------------------------------------------
+    def train_model(
+        self,
+        name: str,
+        corpus: Sequence[str],
+        model_type: str = "avitm",
+        n_topics: int = 25,
+        embeddings: np.ndarray | None = None,
+        model_kwargs: dict[str, Any] | None = None,
+    ) -> tuple[Any, Path]:
+        """Train one centralized model; persists the train config JSON and
+        the trained model under ``models_root/name`` and returns
+        ``(model, model_dir)``.
+
+        ``model_type``: ``avitm`` (prodLDA), ``lda`` (NeuralLDA),
+        ``zeroshot`` or ``combined`` (CTM — needs ``embeddings``)."""
+        model_kwargs = dict(model_kwargs or {})
+        model_dir = self._prepare_model_dir(name)
+        t0 = time.perf_counter()
+
+        if model_type in ("avitm", "lda", "prodlda"):
+            train_data, val_data, input_size, id2token, _docs, vocab = (
+                prepare_dataset(corpus)
+            )
+            model = AVITM(
+                input_size=input_size,
+                n_components=n_topics,
+                model_type="LDA" if model_type == "lda" else "prodLDA",
+                **model_kwargs,
+            )
+            model.fit(train_data, val_data)
+        elif model_type in ("zeroshot", "combined"):
+            if embeddings is None:
+                raise ValueError(
+                    f"model_type={model_type!r} needs precomputed contextual "
+                    "embeddings"
+                )
+            (train_data, val_data, input_size, id2token, qt, _emb_train,
+             _emb_all, _docs) = prepare_ctm_dataset(
+                list(corpus), custom_embeddings=embeddings
+            )
+            cls = ZeroShotTM if model_type == "zeroshot" else CombinedTM
+            model = cls(
+                input_size=input_size,
+                contextual_size=train_data.contextual_size,
+                n_components=n_topics,
+                **model_kwargs,
+            )
+            model.fit(train_data, val_data)
+            vocab = qt.vectorizer
+        else:
+            raise ValueError(f"unknown model_type: {model_type!r}")
+
+        elapsed = time.perf_counter() - t0
+        config = {
+            "name": name,
+            "model_type": model_type,
+            "n_topics": n_topics,
+            "n_docs": len(corpus),
+            "train_seconds": elapsed,
+            "model_kwargs": {
+                k: v for k, v in model_kwargs.items()
+                if isinstance(v, (int, float, str, bool, list, tuple))
+            },
+        }
+        with open(model_dir / "trainconfig.json", "w", encoding="utf8") as f:
+            json.dump(config, f, indent=2)
+        model.save(str(model_dir))
+        logger.info("trained %s (%s) in %.1fs", name, model_type, elapsed)
+        self._vocab = vocab
+        return model, model_dir
+
+    # ---- metrics (`tm_wrapper.py:358-400`) ---------------------------------
+    def evaluate_model(
+        self,
+        model: Any,
+        reference_corpus: Sequence[str] | None = None,
+        topn: int = 10,
+    ) -> dict[str, float]:
+        """NPMI coherence (vs reference corpus), inverted RBO, and topic
+        diversity of the trained model's topics."""
+        n_take = min(max(topn, 25), model.input_size)
+        topics = model.get_topics(n_take)
+        metrics: dict[str, float] = {
+            "topic_diversity": topic_diversity(topics, topn=n_take),
+            "inverted_rbo": inverted_rbo(topics, topn=topn),
+        }
+        if reference_corpus is not None:
+            tokenized = [doc.split() for doc in reference_corpus]
+            metrics["npmi"] = npmi_coherence(topics, tokenized, topn=topn)
+        return metrics

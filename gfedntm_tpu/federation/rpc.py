@@ -61,20 +61,45 @@ def add_service(server: grpc.Server, service_name: str, impl: Any) -> None:
     )
 
 
+def _with_deadline(fn, default_timeout: float | None):
+    """Apply a default gRPC deadline: a deadline-less unary call on an
+    unconnectable channel blocks forever (no RST ⇒ no error), which would
+    hang the training thread on the first unreachable client."""
+
+    def call(request, timeout: float | None = None, **kwargs):
+        if timeout is None:
+            timeout = default_timeout
+        return fn(request, timeout=timeout, **kwargs)
+
+    return call
+
+
 class ServiceStub:
     """Client-side callables for one service over a persistent channel —
     unlike the reference, which opens a fresh channel per RPC
-    (``server.py:449,515``; part of its ≥3 s/step orchestration floor)."""
+    (``server.py:449,515``; part of its ≥3 s/step orchestration floor).
 
-    def __init__(self, channel: grpc.Channel, service_name: str):
+    Every call carries a default deadline (the reference's 120 s
+    phase-transition timeout, ``server.py:237``); pass ``timeout=`` per call
+    to override."""
+
+    def __init__(
+        self,
+        channel: grpc.Channel,
+        service_name: str,
+        default_timeout: float | None = 120.0,
+    ):
         for method, (req_cls, resp_cls) in SERVICES[service_name].items():
             setattr(
                 self,
                 method,
-                channel.unary_unary(
-                    f"/{service_name}/{method}",
-                    request_serializer=req_cls.SerializeToString,
-                    response_deserializer=resp_cls.FromString,
+                _with_deadline(
+                    channel.unary_unary(
+                        f"/{service_name}/{method}",
+                        request_serializer=req_cls.SerializeToString,
+                        response_deserializer=resp_cls.FromString,
+                    ),
+                    default_timeout,
                 ),
             )
 

@@ -1,0 +1,338 @@
+"""Pallas TPU kernel: fused prodLDA decoder + reconstruction loss.
+
+The reference decoder computes, per batch (CUDA via libtorch,
+`src/models/base/pytorchavitm/avitm_network/decoder_network.py:121-126` +
+`avitm.py:222-227`)::
+
+    z  = theta @ beta                       # [B, V]
+    n  = batchnorm(z, affine=False)         # per-feature batch stats
+    p  = softmax(n, axis=V)
+    rl = -sum(x_bow * log(p + 1e-10), axis=V)
+
+Composed naively this materializes four [B, V] intermediates in HBM. For the
+production vocabulary sizes the reference targets (V up to 100k,
+`aux_scripts/preprocessing/text_preproc.py:49`) that is the training loss'
+entire bandwidth budget. This kernel streams beta/x over V tiles and keeps
+every [B, TILE_V] intermediate in VMEM: two passes (batch-norm statistics +
+online softmax max/denominator, then the log-prob reduction), with only the
+[B]-sized loss and [V]-sized batch statistics ever written back.
+
+Exposed as :func:`prodlda_recon_loss` with a custom VJP so it drops into the
+training loss; gradients recompute z tile-free in plain JAX (same
+rematerialization trade XLA makes under `jax.checkpoint`).
+
+Interpret mode (`interpret=True`) runs the same kernels on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_tile_v(v_pad: int) -> int:
+    for tile in (2048, 1024, 512, 256, 128):
+        if v_pad % tile == 0:
+            return tile
+    return 128
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: per-tile batch-norm stats + online-softmax partials
+# ---------------------------------------------------------------------------
+def _stats_kernel(
+    dims_ref,        # SMEM [2]: (B_actual, V_actual)
+    theta_ref,       # VMEM [B_pad, K]
+    beta_ref,        # VMEM [K, TILE_V]
+    run_mean_ref,    # VMEM [1, TILE_V] (running stats; ignored when training)
+    run_var_ref,     # VMEM [1, TILE_V]
+    mean_ref,        # out VMEM [1, TILE_V]
+    var_ref,         # out VMEM [1, TILE_V]
+    m_ref,           # out VMEM [B_pad, 1]  tile max
+    s_ref,           # out VMEM [B_pad, 1]  tile exp-sum (rel. tile max)
+    *,
+    training: bool,
+    eps: float,
+    tile_v: int,
+):
+    b_actual = dims_ref[0]
+    v_actual = dims_ref[1]
+    j = pl.program_id(0)
+
+    b_pad = theta_ref.shape[0]
+    z = jnp.dot(
+        theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
+    )  # [B_pad, TILE_V]
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
+    row_ok = row_ids < b_actual
+    col_ok = (col_ids + j * tile_v) < v_actual
+    valid = jnp.logical_and(row_ok, col_ok)
+
+    if training:
+        # Exact per-feature batch statistics: BN stats are independent
+        # across features, so a V tile computes its own columns' stats.
+        cnt = b_actual.astype(jnp.float32)
+        zr = jnp.where(row_ok, z, 0.0)
+        mean = jnp.sum(zr, axis=0, keepdims=True) / cnt          # [1, TILE_V]
+        dev = jnp.where(row_ok, z - mean, 0.0)
+        var = jnp.sum(dev * dev, axis=0, keepdims=True) / cnt    # biased
+    else:
+        mean = run_mean_ref[:]
+        var = run_var_ref[:]
+    mean_ref[:] = mean
+    var_ref[:] = var
+
+    n = (z - mean) * jax.lax.rsqrt(var + eps)
+    n = jnp.where(valid, n, _NEG_INF)
+    m_tile = jnp.max(n, axis=1, keepdims=True)                   # [B_pad, 1]
+    # Guard fully-masked rows (padding): exp(-1e30 - -1e30) would be 1.
+    safe_m = jnp.maximum(m_tile, _NEG_INF * 0.5)
+    e = jnp.where(valid, jnp.exp(n - safe_m), 0.0)
+    m_ref[:] = m_tile
+    s_ref[:] = jnp.sum(e, axis=1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: -sum(x * log(softmax + floor)) reduction
+# ---------------------------------------------------------------------------
+def _loss_kernel(
+    dims_ref,        # SMEM [2]
+    theta_ref,       # VMEM [B_pad, K]
+    beta_ref,        # VMEM [K, TILE_V]
+    x_ref,           # VMEM [B_pad, TILE_V]
+    mean_ref,        # VMEM [1, TILE_V]
+    var_ref,         # VMEM [1, TILE_V]
+    m_ref,           # VMEM [B_pad, 1] global max
+    l_ref,           # VMEM [B_pad, 1] global denominator
+    out_ref,         # out VMEM [B_pad, 1] accumulated loss
+    *,
+    eps: float,
+    floor: float,
+    tile_v: int,
+):
+    b_actual = dims_ref[0]
+    v_actual = dims_ref[1]
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    b_pad = theta_ref.shape[0]
+    z = jnp.dot(
+        theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
+    )
+    n = (z - mean_ref[:]) * jax.lax.rsqrt(var_ref[:] + eps)
+    p = jnp.exp(n - m_ref[:]) / l_ref[:]
+
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
+    col_ok = (col_ids + j * tile_v) < v_actual
+    contrib = jnp.where(col_ok, x_ref[:] * jnp.log(p + floor), 0.0)
+    out_ref[:] += -jnp.sum(contrib, axis=1, keepdims=True)
+
+
+def _fused_forward(
+    theta: jax.Array,
+    beta: jax.Array,
+    x_bow: jax.Array,
+    run_mean: jax.Array,
+    run_var: jax.Array,
+    *,
+    training: bool,
+    eps: float,
+    floor: float,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    b, k = theta.shape
+    _, v = beta.shape
+    b_pad = _round_up(max(b, 8), 8)
+    k_pad = _round_up(max(k, 8), 8)
+    v_pad = _round_up(max(v, 128), 128)
+    tile_v = _pick_tile_v(v_pad)
+    n_tiles = v_pad // tile_v
+
+    theta_p = jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta)
+    beta_p = jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta)
+    x_p = jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow)
+    rmean_p = jnp.zeros((1, v_pad), jnp.float32).at[0, :v].set(run_mean)
+    rvar_p = jnp.ones((1, v_pad), jnp.float32).at[0, :v].set(run_var)
+    dims = jnp.array([b, v], jnp.int32)
+
+    grid = (n_tiles,)
+    theta_spec = pl.BlockSpec(
+        (b_pad, k_pad), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
+    )
+    beta_spec = pl.BlockSpec(
+        (k_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+    vrow_spec = pl.BlockSpec(
+        (1, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+    bcol_spec = pl.BlockSpec(
+        (b_pad, 1), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+
+    mean, var, m_tiles, s_tiles = pl.pallas_call(
+        functools.partial(
+            _stats_kernel, training=training, eps=eps, tile_v=tile_v
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[theta_spec, beta_spec, vrow_spec, vrow_spec],
+            out_specs=[vrow_spec, vrow_spec, bcol_spec, bcol_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b_pad, n_tiles), jnp.float32),
+            jax.ShapeDtypeStruct((b_pad, n_tiles), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dims, theta_p, beta_p, rmean_p, rvar_p)
+
+    # Combine per-tile online-softmax partials (tiny [B, n_tiles] work).
+    m_global = jnp.max(m_tiles, axis=1, keepdims=True)           # [B_pad, 1]
+    l_global = jnp.sum(
+        s_tiles * jnp.exp(m_tiles - m_global), axis=1, keepdims=True
+    )
+    l_global = jnp.maximum(l_global, 1e-30)
+
+    loss = pl.pallas_call(
+        functools.partial(
+            _loss_kernel, eps=eps, floor=floor, tile_v=tile_v
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                theta_spec,
+                beta_spec,
+                pl.BlockSpec(
+                    (b_pad, tile_v), lambda j, dims: (0, j),
+                    memory_space=pltpu.VMEM,
+                ),
+                vrow_spec,
+                vrow_spec,
+                pl.BlockSpec(
+                    (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(dims, theta_p, beta_p, x_p, mean, var, m_global, l_global)
+
+    return (
+        loss[:b, 0],
+        mean[0, :v],
+        var[0, :v],
+    )
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper
+# ---------------------------------------------------------------------------
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+)
+def prodlda_recon_loss(
+    theta: jax.Array,
+    beta: jax.Array,
+    x_bow: jax.Array,
+    run_mean: jax.Array,
+    run_var: jax.Array,
+    training: bool = True,
+    eps: float = 1e-5,
+    floor: float = 1e-10,
+    interpret: bool | None = None,
+):
+    """Fused ``-sum(x * log(softmax(batchnorm(theta @ beta)) + floor))``.
+
+    Returns ``(rl [B], batch_mean [V], batch_var [V])``; in eval mode the
+    stats echo ``run_mean``/``run_var``. The stats outputs carry no gradient
+    (they feed the BN running-stat update, exactly like torch's
+    ``track_running_stats``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_forward(
+        theta, beta, x_bow, run_mean, run_var,
+        training=training, eps=eps, floor=floor, interpret=interpret,
+    )
+
+
+def _fwd(theta, beta, x_bow, run_mean, run_var, training, eps, floor,
+         interpret):
+    out = prodlda_recon_loss(
+        theta, beta, x_bow, run_mean, run_var, training, eps, floor,
+        interpret,
+    )
+    rl, mean, var = out
+    return out, (theta, beta, x_bow, mean, var)
+
+
+def _bwd(training, eps, floor, interpret, residuals, cotangents):
+    theta, beta, x_bow, mean, var = residuals
+    g_rl = cotangents[0]  # stats outputs are gradient-free
+
+    b = theta.shape[0]
+    inv_std = jax.lax.rsqrt(var + eps)                     # [V]
+    z = theta @ beta                                       # rematerialized
+    n = (z - mean[None, :]) * inv_std[None, :]
+    p = jax.nn.softmax(n, axis=-1)
+
+    gp = -(x_bow / (p + floor)) * g_rl[:, None]
+    gn = p * (gp - jnp.sum(gp * p, axis=-1, keepdims=True))
+    if training:
+        # Affine-free batch-norm backward through the batch statistics
+        # (biased variance, matching torch's normalization path).
+        gz = inv_std[None, :] * (
+            gn
+            - jnp.mean(gn, axis=0, keepdims=True)
+            - n * jnp.mean(gn * n, axis=0, keepdims=True)
+        )
+    else:
+        gz = gn * inv_std[None, :]
+    g_theta = gz @ beta.T
+    g_beta = theta.T @ gz
+    return g_theta, g_beta, None, None, None
+
+
+prodlda_recon_loss.defvjp(_fwd, _bwd)
+
+
+def prodlda_recon_loss_reference(
+    theta, beta, x_bow, run_mean, run_var, training=True, eps=1e-5,
+    floor=1e-10,
+):
+    """Unfused XLA implementation with identical semantics — the parity
+    oracle for tests and the fallback for platforms without Pallas."""
+    z = theta @ beta
+    if training:
+        mean = jnp.mean(z, axis=0)
+        var = jnp.var(z, axis=0)
+    else:
+        mean, var = run_mean, run_var
+    n = (z - mean[None, :]) * jax.lax.rsqrt(var + eps)[None, :]
+    p = jax.nn.softmax(n, axis=-1)
+    rl = -jnp.sum(x_bow * jnp.log(p + floor), axis=1)
+    return rl, mean, var

@@ -1,0 +1,194 @@
+"""Experiment-harness tests (reference L5: run_simulation.py, TMWrapper,
+collab_vs_non_collab/train.py, wmd.py) on tiny shapes."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+from gfedntm_tpu.experiments import (
+    CollabExperimentConfig,
+    SimulationConfig,
+    TMWrapper,
+    run_collab_experiment,
+    run_iter_simulation,
+    run_simulation,
+    topic_set_wmd_matrix,
+    wmd_centralized_vs_nodes,
+)
+from gfedntm_tpu.experiments.wmd import relaxed_wmd
+
+
+def tiny_sim_config(**overrides) -> SimulationConfig:
+    base = dict(
+        vocab_size=120,
+        n_topics=4,
+        beta=0.05,
+        alpha=0.25,
+        n_docs=40,
+        n_docs_global_inf=8,
+        n_nodes=2,
+        frozen_topics=2,
+        nwords=(20, 30),
+        experiment=1,
+        eta_list=(0.05,),
+        frozen_topics_list=(2,),
+        iters=1,
+        hidden_sizes=(16, 16),
+        num_epochs=2,
+        batch_size=8,
+    )
+    base.update(overrides)
+    return SimulationConfig(**base)
+
+
+def synthetic_docs(n_docs=30, vocab=80, seed=0):
+    corpus = generate_synthetic_corpus(
+        vocab_size=vocab, n_topics=3, n_docs=n_docs, nwords=(15, 25),
+        n_nodes=1, frozen_topics=1, seed=seed,
+    )
+    return corpus.nodes[0].documents
+
+
+class TestDssTssSimulation:
+    def test_run_iter_has_all_arms_and_finite_scores(self):
+        res = run_iter_simulation(tiny_sim_config(), seed=0)
+        assert set(res) == {"centralized", "non_colab", "baseline"}
+        for arm in res.values():
+            assert np.isfinite(arm["betas"])
+            assert np.isfinite(arm["thetas"])
+        # TSS is bounded by the number of ground-truth topics.
+        for arm in res.values():
+            assert 0.0 < arm["betas"] <= 4.0 + 1e-6
+
+    def test_run_simulation_sweep_schema_and_artifacts(self, tmp_path):
+        cfg = tiny_sim_config(eta_list=(0.05, 0.1))
+        out = run_simulation(cfg, results_dir=tmp_path)
+        assert out["index"] == [0.05, 0.1]
+        assert out["index_name"] == "Eta"
+        for arm in ("centralized", "non_colab", "baseline"):
+            for stat in ("betas", "thetas"):
+                assert len(out["columns"][f"{arm}_{stat}_mean"]) == 2
+                assert len(out["columns"][f"{arm}_{stat}_std"]) == 2
+        saved = json.loads((tmp_path / "results.json").read_text())
+        assert saved["columns"].keys() == out["columns"].keys()
+
+    def test_frozen_topics_sweep_uses_frozen_list(self):
+        cfg = tiny_sim_config(experiment=0, frozen_topics_list=(0, 2))
+        out = run_simulation(cfg)
+        assert out["index"] == [0, 2]
+        assert out["index_name"] == "Nr frozen topics"
+
+    def test_config_from_json_reference_schema(self, tmp_path):
+        # The reference config.json stores the sweep lists as
+        # space-separated strings and nwords as a dict.
+        payload = {
+            "vocab_size": 500, "n_topics": 10, "beta": 0.01, "alpha": 0.1,
+            "n_docs": 100, "n_docs_global_inf": 10, "n_nodes": 3,
+            "frozen_topics": 5, "experiment": 0, "iters": 2,
+            "frozen_topics_list": "1 2 3", "eta_list": "0.01 0.1",
+            "nwords": {"min": 10, "max": 20},
+        }
+        p = tmp_path / "config.json"
+        p.write_text(json.dumps(payload))
+        cfg = SimulationConfig.from_json(p)
+        assert cfg.frozen_topics_list == (1, 2, 3)
+        assert cfg.eta_list == (0.01, 0.1)
+        assert cfg.nwords == (10, 20)
+        assert cfg.n_nodes == 3
+
+
+class TestTMWrapper:
+    def test_train_and_evaluate_avitm(self, tmp_path):
+        docs = synthetic_docs()
+        wrapper = TMWrapper(tmp_path)
+        model, model_dir = wrapper.train_model(
+            "base", docs, model_type="avitm", n_topics=3,
+            model_kwargs=dict(
+                hidden_sizes=(16, 16), num_epochs=2, batch_size=8
+            ),
+        )
+        assert (model_dir / "trainconfig.json").exists()
+        cfgd = json.loads((model_dir / "trainconfig.json").read_text())
+        assert cfgd["model_type"] == "avitm"
+        metrics = wrapper.evaluate_model(model, reference_corpus=docs)
+        assert 0.0 <= metrics["topic_diversity"] <= 1.0
+        assert -1.0 <= metrics["npmi"] <= 1.0
+        assert 0.0 <= metrics["inverted_rbo"] <= 1.0
+
+    def test_existing_model_dir_backed_up(self, tmp_path):
+        docs = synthetic_docs(n_docs=20)
+        wrapper = TMWrapper(tmp_path)
+        kwargs = dict(hidden_sizes=(8, 8), num_epochs=1, batch_size=8)
+        wrapper.train_model("m", docs, n_topics=2, model_kwargs=kwargs)
+        wrapper.train_model("m", docs, n_topics=2, model_kwargs=kwargs)
+        assert (tmp_path / "m").exists()
+        assert (tmp_path / "m_old").exists()
+
+    def test_ctm_requires_embeddings(self, tmp_path):
+        wrapper = TMWrapper(tmp_path)
+        with pytest.raises(ValueError, match="embeddings"):
+            wrapper.train_model("ctm", ["a b c"] * 8, model_type="zeroshot")
+
+    def test_train_zeroshot_ctm(self, tmp_path):
+        docs = synthetic_docs(n_docs=24)
+        emb = np.random.default_rng(0).normal(
+            size=(len(docs), 16)
+        ).astype(np.float32)
+        wrapper = TMWrapper(tmp_path)
+        model, _ = wrapper.train_model(
+            "ctm", docs, model_type="zeroshot", n_topics=3, embeddings=emb,
+            model_kwargs=dict(
+                hidden_sizes=(8, 8), num_epochs=1, batch_size=8
+            ),
+        )
+        assert len(model.get_topics(5)) == 3
+
+
+class TestCollabExperiment:
+    def test_runs_both_arms_and_saves(self, tmp_path):
+        partitions = {
+            "cat_a": synthetic_docs(n_docs=16, seed=0),
+            "cat_b": synthetic_docs(n_docs=16, seed=1),
+        }
+        cfg = CollabExperimentConfig(
+            n_topics_grid=(2,),
+            model_kwargs=dict(
+                hidden_sizes=(8, 8), num_epochs=1, batch_size=8
+            ),
+        )
+        out = run_collab_experiment(
+            partitions, tmp_path / "models", cfg,
+            results_path=tmp_path / "results.json",
+        )
+        assert set(out["non_collab"]) == {"cat_a", "cat_b"}
+        assert 2 in out["centralized"]
+        saved = json.loads((tmp_path / "results.json").read_text())
+        assert "topic_diversity" in saved["centralized"]["2"]
+
+
+class TestWMD:
+    def embeddings(self):
+        rng = np.random.default_rng(0)
+        return {f"w{i}": rng.normal(size=8) for i in range(20)}
+
+    def test_identical_topics_zero_distance(self):
+        emb = self.embeddings()
+        topic = ["w0", "w1", "w2"]
+        assert relaxed_wmd(topic, topic, emb) == pytest.approx(0.0)
+
+    def test_oov_topic_is_inf(self):
+        emb = self.embeddings()
+        assert np.isinf(relaxed_wmd(["zzz"], ["w0"], emb))
+
+    def test_matrix_shape_and_summary(self):
+        emb = self.embeddings()
+        central = [["w0", "w1"], ["w2", "w3"]]
+        nodes = {"n1": [["w0", "w1"], ["w4", "w5"]]}
+        mat = topic_set_wmd_matrix(nodes["n1"], central, emb)
+        assert mat.shape == (2, 2)
+        summary = wmd_centralized_vs_nodes(central, nodes, emb)
+        assert summary["n1"] >= 0.0
+        # first node topic equals a centralized topic -> its min is 0
+        assert mat[0].min() == pytest.approx(0.0)
