@@ -6,8 +6,8 @@ The reference builds client vocabularies and vectorizes corpora with sklearn's
 the exact semantics needed — lowercase, ``\\b\\w\\w+\\b`` token pattern,
 optional english stop words, ``max_features`` by corpus frequency with
 alphabetical tie-ordering — so the framework has no hard sklearn dependency
-in its core path, plus an optional C++ fast path (``gfedntm_tpu.ops.native``)
-for tokenizing+counting large corpora on host.
+in its core path, plus a C++ fast path (``gfedntm_tpu.native``)
+for tokenizing/counting/vectorizing large corpora on host.
 
 Vocabulary-consensus helpers mirror ``server.py:270-288``: the global
 vocabulary is the sorted set-union of client vocabularies.
@@ -20,6 +20,11 @@ from dataclasses import dataclass
 from typing import Iterable, Sequence
 
 import numpy as np
+
+try:
+    from gfedntm_tpu import native as _native
+except ImportError:  # pragma: no cover - native loader always importable
+    _native = None
 
 _TOKEN_RE = re.compile(r"(?u)\b\w\w+\b")
 
@@ -72,6 +77,25 @@ class Vocabulary:
         return token in self.token2id
 
 
+def _count_terms(
+    corpus: Iterable[str], lowercase: bool, token_pattern: str | None
+) -> dict[str, int]:
+    """Corpus-wide token occurrence counts, via the C++ fast path
+    (``gfedntm_tpu.native``) when it can guarantee exact parity (default
+    token pattern, ASCII text), else pure Python."""
+    docs = corpus if isinstance(corpus, (list, tuple)) else list(corpus)
+    if token_pattern is None and _native is not None:
+        try:
+            return _native.count_terms(docs, lowercase)
+        except _native.NativeUnavailable:
+            pass
+    counts: dict[str, int] = {}
+    for doc in docs:
+        for tok in tokenize(doc, lowercase, token_pattern):
+            counts[tok] = counts.get(tok, 0) + 1
+    return counts
+
+
 def build_vocabulary(
     corpus: Iterable[str],
     max_features: int | None = None,
@@ -86,11 +110,9 @@ def build_vocabulary(
     does), then order the kept terms alphabetically.
     """
     stops = get_stop_words(stop_words)
-    counts: dict[str, int] = {}
-    for doc in corpus:
-        for tok in tokenize(doc, lowercase, token_pattern):
-            if tok not in stops:
-                counts[tok] = counts.get(tok, 0) + 1
+    counts = _count_terms(corpus, lowercase, token_pattern)
+    if stops:
+        counts = {t: c for t, c in counts.items() if t not in stops}
     terms = sorted(counts)
     if max_features is not None and len(terms) > max_features:
         # sklearn's _limit_features: keep argsort(-term_freqs)[:k] over the
@@ -110,6 +132,14 @@ def vectorize(
 ) -> np.ndarray:
     """Dense document-term count matrix [n_docs, len(vocab)] against a FIXED
     vocabulary (``client.py:460-468``: local docs x global vocab)."""
+    if vocab.token_pattern is None and dtype == np.float32 and _native is not None:
+        try:
+            return _native.vectorize(
+                corpus if isinstance(corpus, (list, tuple)) else list(corpus),
+                vocab.tokens, lowercase,
+            )
+        except _native.NativeUnavailable:
+            pass
     token2id = vocab.token2id
     n_docs, n_terms = len(corpus), len(vocab)
     X = np.zeros((n_docs, n_terms), dtype=dtype)

@@ -49,7 +49,11 @@ def run_collab_experiment(
     cfg = cfg or CollabExperimentConfig()
     wrapper = TMWrapper(models_root)
     full_corpus = [doc for docs in partitions.values() for doc in docs]
-    reference_corpus = full_corpus if cfg.compute_npmi else None
+    # Tokenize the reference corpus ONCE; every model in the sweep scores
+    # against the same token lists.
+    reference_corpus = (
+        [doc.split() for doc in full_corpus] if cfg.compute_npmi else None
+    )
 
     results: dict[str, Any] = {"centralized": {}, "non_collab": {}}
     for k in cfg.n_topics_grid:

@@ -50,13 +50,12 @@ class TMWrapper:
         ``<name>_old`` first (reference backup semantics)."""
         model_dir = self.models_root / name
         if model_dir.exists():
+            if not overwrite:
+                raise FileExistsError(str(model_dir))
             backup = self.models_root / f"{name}_old"
             if backup.exists():
                 shutil.rmtree(backup)
-            if overwrite:
-                model_dir.rename(backup)
-            else:
-                raise FileExistsError(str(model_dir))
+            model_dir.rename(backup)
         model_dir.mkdir(parents=True)
         return model_dir
 
@@ -109,7 +108,6 @@ class TMWrapper:
                 **model_kwargs,
             )
             model.fit(train_data, val_data)
-            vocab = qt.vectorizer
         else:
             raise ValueError(f"unknown model_type: {model_type!r}")
 
@@ -129,18 +127,21 @@ class TMWrapper:
             json.dump(config, f, indent=2)
         model.save(str(model_dir))
         logger.info("trained %s (%s) in %.1fs", name, model_type, elapsed)
-        self._vocab = vocab
         return model, model_dir
 
     # ---- metrics (`tm_wrapper.py:358-400`) ---------------------------------
     def evaluate_model(
         self,
         model: Any,
-        reference_corpus: Sequence[str] | None = None,
+        reference_corpus: Sequence[str] | Sequence[list[str]] | None = None,
         topn: int = 10,
     ) -> dict[str, float]:
         """NPMI coherence (vs reference corpus), inverted RBO, and topic
-        diversity of the trained model's topics."""
+        diversity of the trained model's topics.
+
+        ``reference_corpus`` may be raw strings or pre-tokenized token
+        lists — sweeps that score many models against one corpus should
+        tokenize once and pass the token lists."""
         n_take = min(max(topn, 25), model.input_size)
         topics = model.get_topics(n_take)
         metrics: dict[str, float] = {
@@ -148,6 +149,9 @@ class TMWrapper:
             "inverted_rbo": inverted_rbo(topics, topn=topn),
         }
         if reference_corpus is not None:
-            tokenized = [doc.split() for doc in reference_corpus]
+            tokenized = [
+                doc.split() if isinstance(doc, str) else doc
+                for doc in reference_corpus
+            ]
             metrics["npmi"] = npmi_coherence(topics, tokenized, topn=topn)
         return metrics

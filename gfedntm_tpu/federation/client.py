@@ -98,6 +98,7 @@ class Client:
         max_features: int | None = 2000,
         stop_words: str | None = None,
         save_dir: str | None = None,
+        setup_timeout: float = 3600.0,
         logger: logging.Logger | None = None,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
@@ -109,6 +110,7 @@ class Client:
         self.max_features = max_features
         self.stop_words = stop_words
         self.save_dir = save_dir
+        self.setup_timeout = setup_timeout
         self.logger = logger or logging.getLogger(f"Client{client_id}")
 
         self.stepper: FederatedStepper | None = None
@@ -145,8 +147,14 @@ class Client:
         )
 
         # 2. blocking wait for consensus + replicated init (client.py:408-507)
+        # GetGlobalSetup blocks server-side until the vocabulary quorum is
+        # reached, so it gets a long phase timeout rather than the stub's
+        # 120 s per-RPC default — clients routinely join minutes apart
+        # (the reference's hard 120 s consensus wait is a documented defect,
+        # SURVEY.md §2.5 item 9).
         setup = self._federation_stub.GetGlobalSetup(
-            pb.JoinRequest(client_id=self.client_id)
+            pb.JoinRequest(client_id=self.client_id),
+            timeout=self.setup_timeout,
         )
         self.global_vocab = Vocabulary(tuple(setup.vocab))
         hyper = json.loads(setup.hyperparams_json)

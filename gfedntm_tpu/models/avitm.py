@@ -70,6 +70,7 @@ class AVITM:
         num_data_loader_workers: int = 0,
         verbose: bool = False,
         seed: int = 0,
+        fused_decoder: bool | str = "auto",
     ):
         assert isinstance(input_size, int) and input_size > 0, \
             "input_size must by type int > 0."
@@ -111,6 +112,7 @@ class AVITM:
         self.num_data_loader_workers = num_data_loader_workers
         self.verbose = verbose
         self.seed = seed
+        self.fused_decoder = fused_decoder
 
         self.best_loss_train = float("inf")
         self.model_dir = None
@@ -141,6 +143,19 @@ class AVITM:
         self._infer_fns: dict[int, Any] = {}
 
     # ---- subclass hooks (CTM overrides) ------------------------------------
+    def _resolve_fused(self) -> bool:
+        """'auto' enables the Pallas fused decode+loss kernel where it pays:
+        on TPU, prodLDA, vocabulary large enough that the [B, V] word-dist
+        intermediates dominate the loss' HBM traffic."""
+        fused = getattr(self, "fused_decoder", False)
+        if fused == "auto":
+            return (
+                jax.default_backend() == "tpu"
+                and self.model_type.lower() == "prodlda"
+                and self.input_size >= 4096
+            )
+        return bool(fused)
+
     def _build_module(self) -> DecoderNetwork:
         return DecoderNetwork(
             input_size=self.input_size,
@@ -153,6 +168,7 @@ class AVITM:
             topic_prior_mean=self.topic_prior_mean,
             topic_prior_variance=self.topic_prior_variance,
             inference_type="bow",
+            fused_decoder=self._resolve_fused(),
         )
 
     def _contextual_size(self) -> int:

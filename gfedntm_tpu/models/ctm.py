@@ -51,6 +51,7 @@ class CTM(AVITM):
         inference_type: str = "zeroshot",
         verbose: bool = False,
         seed: int = 0,
+        fused_decoder: bool | str = "auto",
     ):
         assert contextual_size > 0, "contextual_size must be > 0"
         assert inference_type in ("zeroshot", "combined")
@@ -79,6 +80,7 @@ class CTM(AVITM):
             num_data_loader_workers=num_data_loader_workers,
             verbose=verbose,
             seed=seed,
+            fused_decoder=fused_decoder,
         )
 
     def _build_module(self) -> DecoderNetwork:
@@ -95,6 +97,7 @@ class CTM(AVITM):
             inference_type=self.inference_type,
             contextual_size=self.contextual_size,
             label_size=self.label_size,
+            fused_decoder=self._resolve_fused(),
         )
 
     def _contextual_size(self) -> int:

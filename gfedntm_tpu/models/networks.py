@@ -182,6 +182,10 @@ class DecoderNetwork(nn.Module):
     inference_type: str = "bow"
     contextual_size: int = 0
     label_size: int = 0
+    # Use the Pallas fused decode+loss kernel for the prodLDA training path
+    # (ops/fused_decoder.py) instead of materializing word_dist in the
+    # forward. Set by the trainer layer; only consulted for training losses.
+    fused_decoder: bool = False
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -313,6 +317,46 @@ class DecoderNetwork(nn.Module):
             posterior_variance=posterior_sigma,
             posterior_log_variance=posterior_log_sigma,
             word_dist=word_dist,
+            estimated_labels=estimated_labels,
+            theta=theta,
+        )
+
+    def encode_theta(
+        self, x_bow, x_ctx=None, labels=None, *, train: bool, mask=None,
+        noise=None,
+    ):
+        """Encoder + reparameterization + theta-dropout WITHOUT the decode —
+        the front half of ``__call__``, for callers that fuse the decode +
+        reconstruction loss into one kernel
+        (:func:`gfedntm_tpu.ops.fused_decoder.prodlda_recon_loss`). Returns a
+        :class:`TopicModelOutput` whose ``word_dist`` is None; the
+        ``beta_batchnorm`` running stats are left untouched (the fused caller
+        updates them from the kernel's batch statistics)."""
+        prior_mean, prior_variance = self.prior_mean, self.prior_variance
+        posterior_mu, posterior_log_sigma = self._encode(
+            x_bow, x_ctx, labels, train=train, mask=mask
+        )
+        posterior_sigma = jnp.exp(posterior_log_sigma)
+        std = jnp.exp(0.5 * posterior_log_sigma)
+        eps = (
+            noise
+            if noise is not None
+            else jax.random.normal(
+                self.make_rng("reparam"), std.shape, dtype=std.dtype
+            )
+        )
+        theta = jax.nn.softmax(posterior_mu + eps * std, axis=1)
+        theta = self.drop_theta(theta, deterministic=not train)
+        estimated_labels = None
+        if labels is not None and self.label_size > 0:
+            estimated_labels = self.label_classification(theta)
+        return TopicModelOutput(
+            prior_mean=prior_mean,
+            prior_variance=prior_variance,
+            posterior_mean=posterior_mu,
+            posterior_variance=posterior_sigma,
+            posterior_log_variance=posterior_log_sigma,
+            word_dist=None,
             estimated_labels=estimated_labels,
             theta=theta,
         )

@@ -1,1 +1,12 @@
+"""Pallas TPU kernels for the framework's hot ops.
 
+The models are small MLP VAEs whose compute XLA fuses well on its own
+(SURVEY.md §2.4); custom kernels exist only where a fused implementation
+beats XLA's — currently the prodLDA decode + reconstruction-loss path, whose
+[B, V] intermediates dominate HBM traffic at production vocabulary sizes.
+"""
+
+from gfedntm_tpu.ops.fused_decoder import (  # noqa: F401
+    prodlda_recon_loss,
+    prodlda_recon_loss_reference,
+)

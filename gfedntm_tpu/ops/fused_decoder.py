@@ -17,11 +17,17 @@ every [B, TILE_V] intermediate in VMEM: two passes (batch-norm statistics +
 online softmax max/denominator, then the log-prob reduction), with only the
 [B]-sized loss and [V]-sized batch statistics ever written back.
 
-Exposed as :func:`prodlda_recon_loss` with a custom VJP so it drops into the
-training loss; gradients recompute z tile-free in plain JAX (same
-rematerialization trade XLA makes under `jax.checkpoint`).
+A row ``mask`` carries the SPMD padding semantics of
+:class:`gfedntm_tpu.models.layers.MaskedBatchNorm`: masked rows are excluded
+from the batch statistics but still produce (finite) outputs; their loss rows
+are zeroed by the caller's ``sample_mask``.
 
-Interpret mode (`interpret=True`) runs the same kernels on CPU for tests.
+Exposed as :func:`prodlda_recon_loss` with a custom VJP so it drops into the
+training loss; gradients recompute z in plain JAX (the same rematerialization
+trade XLA makes under `jax.checkpoint`).
+
+Interpret mode (`interpret=True`, the default off-TPU) runs the same kernels
+on CPU for tests.
 """
 
 from __future__ import annotations
@@ -51,9 +57,10 @@ def _pick_tile_v(v_pad: int) -> int:
 # Pass 1: per-tile batch-norm stats + online-softmax partials
 # ---------------------------------------------------------------------------
 def _stats_kernel(
-    dims_ref,        # SMEM [2]: (B_actual, V_actual)
+    dims_ref,        # SMEM [1]: (V_actual,)
     theta_ref,       # VMEM [B_pad, K]
     beta_ref,        # VMEM [K, TILE_V]
+    mask_ref,        # VMEM [B_pad, 1] row mask (1 = real row)
     run_mean_ref,    # VMEM [1, TILE_V] (running stats; ignored when training)
     run_var_ref,     # VMEM [1, TILE_V]
     mean_ref,        # out VMEM [1, TILE_V]
@@ -65,8 +72,7 @@ def _stats_kernel(
     eps: float,
     tile_v: int,
 ):
-    b_actual = dims_ref[0]
-    v_actual = dims_ref[1]
+    v_actual = dims_ref[0]
     j = pl.program_id(0)
 
     b_pad = theta_ref.shape[0]
@@ -74,19 +80,19 @@ def _stats_kernel(
         theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
     )  # [B_pad, TILE_V]
 
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 0)
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
-    row_ok = row_ids < b_actual
     col_ok = (col_ids + j * tile_v) < v_actual
+    mask = mask_ref[:]                                            # [B_pad, 1]
+    row_ok = mask > 0.0
     valid = jnp.logical_and(row_ok, col_ok)
 
     if training:
-        # Exact per-feature batch statistics: BN stats are independent
+        # Exact per-feature masked batch statistics: BN stats are independent
         # across features, so a V tile computes its own columns' stats.
-        cnt = b_actual.astype(jnp.float32)
-        zr = jnp.where(row_ok, z, 0.0)
+        cnt = jnp.maximum(jnp.sum(mask), 1.0)
+        zr = z * mask
         mean = jnp.sum(zr, axis=0, keepdims=True) / cnt          # [1, TILE_V]
-        dev = jnp.where(row_ok, z - mean, 0.0)
+        dev = (z - mean) * mask
         var = jnp.sum(dev * dev, axis=0, keepdims=True) / cnt    # biased
     else:
         mean = run_mean_ref[:]
@@ -108,7 +114,7 @@ def _stats_kernel(
 # Pass 2: -sum(x * log(softmax + floor)) reduction
 # ---------------------------------------------------------------------------
 def _loss_kernel(
-    dims_ref,        # SMEM [2]
+    dims_ref,        # SMEM [1]
     theta_ref,       # VMEM [B_pad, K]
     beta_ref,        # VMEM [K, TILE_V]
     x_ref,           # VMEM [B_pad, TILE_V]
@@ -122,8 +128,7 @@ def _loss_kernel(
     floor: float,
     tile_v: int,
 ):
-    b_actual = dims_ref[0]
-    v_actual = dims_ref[1]
+    v_actual = dims_ref[0]
     j = pl.program_id(0)
 
     @pl.when(j == 0)
@@ -135,11 +140,17 @@ def _loss_kernel(
         theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
     )
     n = (z - mean_ref[:]) * jax.lax.rsqrt(var_ref[:] + eps)
-    p = jnp.exp(n - m_ref[:]) / l_ref[:]
+    # Fully-masked (padding) rows have m = -inf sentinel, l ~ 0; force their
+    # rows finite — the caller zeroes them via its sample mask anyway.
+    row_valid = l_ref[:] > 1e-20
+    safe_m = jnp.where(row_valid, m_ref[:], 0.0)
+    safe_l = jnp.where(row_valid, l_ref[:], 1.0)
+    p = jnp.exp(jnp.minimum(n - safe_m, 0.0)) / safe_l
 
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
     col_ok = (col_ids + j * tile_v) < v_actual
-    contrib = jnp.where(col_ok, x_ref[:] * jnp.log(p + floor), 0.0)
+    keep = jnp.logical_and(col_ok, row_valid)
+    contrib = jnp.where(keep, x_ref[:] * jnp.log(p + floor), 0.0)
     out_ref[:] += -jnp.sum(contrib, axis=1, keepdims=True)
 
 
@@ -149,6 +160,7 @@ def _fused_forward(
     x_bow: jax.Array,
     run_mean: jax.Array,
     run_var: jax.Array,
+    mask: jax.Array,
     *,
     training: bool,
     eps: float,
@@ -166,9 +178,14 @@ def _fused_forward(
     theta_p = jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta)
     beta_p = jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta)
     x_p = jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow)
+    mask_p = (
+        jnp.zeros((b_pad, 1), jnp.float32)
+        .at[:b, 0]
+        .set(mask.astype(jnp.float32))
+    )
     rmean_p = jnp.zeros((1, v_pad), jnp.float32).at[0, :v].set(run_mean)
     rvar_p = jnp.ones((1, v_pad), jnp.float32).at[0, :v].set(run_var)
-    dims = jnp.array([b, v], jnp.int32)
+    dims = jnp.array([v], jnp.int32)
 
     grid = (n_tiles,)
     theta_spec = pl.BlockSpec(
@@ -180,8 +197,11 @@ def _fused_forward(
     vrow_spec = pl.BlockSpec(
         (1, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
     )
-    bcol_spec = pl.BlockSpec(
+    btile_spec = pl.BlockSpec(
         (b_pad, 1), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+    bfix_spec = pl.BlockSpec(
+        (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
     )
 
     mean, var, m_tiles, s_tiles = pl.pallas_call(
@@ -191,8 +211,8 @@ def _fused_forward(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[theta_spec, beta_spec, vrow_spec, vrow_spec],
-            out_specs=[vrow_spec, vrow_spec, bcol_spec, bcol_spec],
+            in_specs=[theta_spec, beta_spec, bfix_spec, vrow_spec, vrow_spec],
+            out_specs=[vrow_spec, vrow_spec, btile_spec, btile_spec],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
@@ -201,14 +221,13 @@ def _fused_forward(
             jax.ShapeDtypeStruct((b_pad, n_tiles), jnp.float32),
         ],
         interpret=interpret,
-    )(dims, theta_p, beta_p, rmean_p, rvar_p)
+    )(dims, theta_p, beta_p, mask_p, rmean_p, rvar_p)
 
     # Combine per-tile online-softmax partials (tiny [B, n_tiles] work).
     m_global = jnp.max(m_tiles, axis=1, keepdims=True)           # [B_pad, 1]
     l_global = jnp.sum(
         s_tiles * jnp.exp(m_tiles - m_global), axis=1, keepdims=True
     )
-    l_global = jnp.maximum(l_global, 1e-30)
 
     loss = pl.pallas_call(
         functools.partial(
@@ -226,16 +245,10 @@ def _fused_forward(
                 ),
                 vrow_spec,
                 vrow_spec,
-                pl.BlockSpec(
-                    (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
-                ),
-                pl.BlockSpec(
-                    (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
-                ),
+                bfix_spec,
+                bfix_spec,
             ],
-            out_specs=pl.BlockSpec(
-                (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
-            ),
+            out_specs=bfix_spec,
         ),
         out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
         interpret=interpret,
@@ -252,7 +265,7 @@ def _fused_forward(
 # custom-VJP wrapper
 # ---------------------------------------------------------------------------
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9)
 )
 def prodlda_recon_loss(
     theta: jax.Array,
@@ -260,6 +273,7 @@ def prodlda_recon_loss(
     x_bow: jax.Array,
     run_mean: jax.Array,
     run_var: jax.Array,
+    mask: jax.Array | None = None,
     training: bool = True,
     eps: float = 1e-5,
     floor: float = 1e-10,
@@ -270,66 +284,84 @@ def prodlda_recon_loss(
     Returns ``(rl [B], batch_mean [V], batch_var [V])``; in eval mode the
     stats echo ``run_mean``/``run_var``. The stats outputs carry no gradient
     (they feed the BN running-stat update, exactly like torch's
-    ``track_running_stats``).
+    ``track_running_stats``). ``mask`` rows equal to 0 are excluded from the
+    batch statistics (MaskedBatchNorm semantics); their rl rows are
+    well-defined but meaningless — callers zero them via their sample mask.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if mask is None:
+        mask = jnp.ones((theta.shape[0],), jnp.float32)
     return _fused_forward(
-        theta, beta, x_bow, run_mean, run_var,
+        theta, beta, x_bow, run_mean, run_var, mask,
         training=training, eps=eps, floor=floor, interpret=interpret,
     )
 
 
-def _fwd(theta, beta, x_bow, run_mean, run_var, training, eps, floor,
+def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
          interpret):
     out = prodlda_recon_loss(
-        theta, beta, x_bow, run_mean, run_var, training, eps, floor,
+        theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
         interpret,
     )
     rl, mean, var = out
-    return out, (theta, beta, x_bow, mean, var)
+    if mask is None:
+        mask = jnp.ones((theta.shape[0],), jnp.float32)
+    return out, (theta, beta, x_bow, mean, var, mask)
 
 
 def _bwd(training, eps, floor, interpret, residuals, cotangents):
-    theta, beta, x_bow, mean, var = residuals
+    theta, beta, x_bow, mean, var, mask = residuals
     g_rl = cotangents[0]  # stats outputs are gradient-free
 
-    b = theta.shape[0]
+    m = mask.astype(jnp.float32)[:, None]
     inv_std = jax.lax.rsqrt(var + eps)                     # [V]
     z = theta @ beta                                       # rematerialized
     n = (z - mean[None, :]) * inv_std[None, :]
     p = jax.nn.softmax(n, axis=-1)
 
-    gp = -(x_bow / (p + floor)) * g_rl[:, None]
+    # Padding rows must carry zero cotangent (the caller's sample mask
+    # guarantees it for the loss; enforce for robustness).
+    g = (g_rl[:, None]) * m
+    gp = -(x_bow / (p + floor)) * g
     gn = p * (gp - jnp.sum(gp * p, axis=-1, keepdims=True))
     if training:
-        # Affine-free batch-norm backward through the batch statistics
-        # (biased variance, matching torch's normalization path).
+        # Affine-free masked batch-norm backward through the batch statistics
+        # (biased variance, matching torch's normalization path). Means run
+        # over the masked row count; the correction terms apply only to rows
+        # that participated in the statistics.
+        cnt = jnp.maximum(jnp.sum(m), 1.0)
+        sum_gn = jnp.sum(gn * m, axis=0, keepdims=True)
+        sum_gnn = jnp.sum(gn * n * m, axis=0, keepdims=True)
         gz = inv_std[None, :] * (
-            gn
-            - jnp.mean(gn, axis=0, keepdims=True)
-            - n * jnp.mean(gn * n, axis=0, keepdims=True)
+            gn - m * (sum_gn / cnt) - n * m * (sum_gnn / cnt)
         )
     else:
         gz = gn * inv_std[None, :]
     g_theta = gz @ beta.T
     g_beta = theta.T @ gz
-    return g_theta, g_beta, None, None, None
+    return g_theta, g_beta, None, None, None, None
 
 
 prodlda_recon_loss.defvjp(_fwd, _bwd)
 
 
 def prodlda_recon_loss_reference(
-    theta, beta, x_bow, run_mean, run_var, training=True, eps=1e-5,
-    floor=1e-10,
+    theta, beta, x_bow, run_mean, run_var, mask=None, training=True,
+    eps=1e-5, floor=1e-10,
 ):
     """Unfused XLA implementation with identical semantics — the parity
     oracle for tests and the fallback for platforms without Pallas."""
     z = theta @ beta
     if training:
-        mean = jnp.mean(z, axis=0)
-        var = jnp.var(z, axis=0)
+        if mask is None:
+            mean = jnp.mean(z, axis=0)
+            var = jnp.var(z, axis=0)
+        else:
+            mk = mask.astype(jnp.float32)[:, None]
+            cnt = jnp.maximum(jnp.sum(mk), 1.0)
+            mean = jnp.sum(z * mk, axis=0) / cnt
+            var = jnp.sum(jnp.square(z - mean[None, :]) * mk, axis=0) / cnt
     else:
         mean, var = run_mean, run_var
     n = (z - mean[None, :]) * jax.lax.rsqrt(var + eps)[None, :]

@@ -1,0 +1,145 @@
+"""GSPMD sharded centralized training: data parallelism + vocabulary-axis
+model parallelism for large-V corpora.
+
+SURVEY.md §5: the reference's scaling axes are corpus size and vocabulary
+size (beta is [K, V]; production preprocessing keeps V up to 100k,
+``aux_scripts/preprocessing/text_preproc.py:49``); there is no sequence axis
+by construction. This module covers both axes for centralized training with
+a 2-D ``(data, model)`` mesh:
+
+- the document/batch axis shards over ``data`` (classic DP),
+- every V-sized axis shards over ``model``: ``beta``'s columns, the encoder
+  input layer's rows, the decoder BatchNorm's running statistics, and the
+  corpus' term axis.
+
+No program rewrite is needed: placement is the program. The existing jitted
+epoch program (``train/steps.py``) runs on inputs carrying these shardings
+and XLA/GSPMD inserts the collectives (a psum over ``model`` for the encoder
+contraction and the softmax normalizer; a psum over ``data`` for batch-norm
+statistics) — the "annotate shardings, let the compiler do the rest" recipe.
+
+The federated trainer composes with this orthogonally: its ``clients`` axis
+is a separate mesh dimension (one client per device block); use this module
+when a SINGLE model must scale beyond one device's convenient working set.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gfedntm_tpu.data.datasets import BowDataset, make_epoch_schedule
+
+
+def make_dp_mp_mesh(
+    dp: int, mp: int, devices: list | None = None
+) -> Mesh:
+    """2-D ``(data, model)`` mesh over ``dp * mp`` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if dp * mp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[: dp * mp]).reshape(dp, mp)
+    return Mesh(arr, ("data", "model"))
+
+
+def _leaf_spec(shape: tuple[int, ...], vocab_size: int) -> P:
+    """Vocabulary-axis sharding rule: any V-sized axis shards over
+    ``model``; everything else replicates. Applies uniformly to params,
+    batch stats, and the optimizer state's params-shaped leaves."""
+    if len(shape) == 2:
+        if shape[1] == vocab_size and shape[0] != vocab_size:
+            return P(None, "model")          # beta [K, V]
+        if shape[0] == vocab_size:
+            return P("model", None)          # encoder input kernel [V, h]
+    if len(shape) == 1 and shape[0] == vocab_size:
+        return P("model")                    # BN running stats over V
+    return P()
+
+
+def shard_tree(tree: Any, mesh: Mesh, vocab_size: int) -> Any:
+    """device_put every array leaf with its vocabulary-axis sharding."""
+
+    def place(leaf):
+        if not hasattr(leaf, "shape"):
+            return leaf
+        spec = _leaf_spec(tuple(leaf.shape), vocab_size)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, tree)
+
+
+def shard_data(data: dict[str, Any], mesh: Mesh, vocab_size: int) -> dict:
+    """Corpus placement: the BoW matrix shards over both axes
+    ([docs, terms] -> (data, model)); auxiliary arrays shard over docs."""
+    out = {}
+    for k, v in data.items():
+        if v is None:
+            out[k] = None
+        elif v.ndim == 2 and v.shape[1] == vocab_size:
+            out[k] = jax.device_put(v, NamedSharding(mesh, P("data", "model")))
+        else:
+            out[k] = jax.device_put(v, NamedSharding(mesh, P("data")))
+    return out
+
+
+def _replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def fit_sharded(
+    model,
+    train_dataset: BowDataset,
+    mesh: Mesh | None = None,
+    dp: int | None = None,
+    mp: int | None = None,
+) -> None:
+    """Run ``model``'s training epochs under the (data, model) sharding.
+
+    Semantically identical to ``model.fit(train_dataset)`` (GSPMD preserves
+    program semantics; only float reduction order differs). The model's
+    state is left sharded on exit — subsequent host reads (``np.asarray``)
+    gather transparently.
+    """
+    if model.family != "avitm" or model._contextual_size() > 0:
+        raise NotImplementedError(
+            "fit_sharded currently covers the BoW AVITM family"
+        )
+    if mesh is None:
+        mesh = make_dp_mp_mesh(dp or 1, mp or 1)
+    if model.module.fused_decoder and mesh.devices.size > 1:
+        raise NotImplementedError(
+            "the Pallas fused decoder is a single-device kernel; construct "
+            "the model with fused_decoder=False for multi-device sharding"
+        )
+    V = model.input_size
+
+    model.train_data = train_dataset
+    model.params = shard_tree(model.params, mesh, V)
+    model.batch_stats = shard_tree(model.batch_stats, mesh, V)
+    model.opt_state = shard_tree(model.opt_state, mesh, V)
+    data = shard_data(model._device_data(train_dataset), mesh, V)
+
+    n_train = len(train_dataset)
+    for epoch in range(model.num_epochs):
+        model.nn_epoch = epoch
+        sched = make_epoch_schedule(n_train, model.batch_size, model._np_rng)
+        model.params, model.batch_stats, model.opt_state, losses = (
+            model._train_epoch_fn(
+                model.params, model.batch_stats, model.opt_state, data,
+                _replicate(np.asarray(sched.indices), mesh),
+                _replicate(np.asarray(sched.mask), mesh),
+                _replicate(model._next_rng(), mesh),
+            )
+        )
+        train_loss = float(np.sum(np.asarray(losses))) / n_train
+        model.best_components = np.asarray(model.params["beta"])
+        if model.verbose:
+            model.logger.info(
+                "Epoch: [%d/%d]\tSharded Train Loss: %.4f",
+                epoch + 1, model.num_epochs, train_loss,
+            )

@@ -20,7 +20,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from gfedntm_tpu.models.losses import avitm_loss, ctm_loss
+from gfedntm_tpu.models.losses import (
+    avitm_loss,
+    cross_entropy_with_logits,
+    ctm_loss,
+    gaussian_kl,
+)
 from gfedntm_tpu.models.networks import DecoderNetwork
 
 
@@ -28,9 +33,73 @@ def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
     return {k: jnp.take(v, idx, axis=0) for k, v in data.items() if v is not None}
 
 
+def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
+                      mask, rngs):
+    """Training loss via the Pallas fused decode+reconstruction kernel
+    (ops/fused_decoder.py): the [B, V] word distribution never exists; the
+    decoder BatchNorm's running stats are updated here from the kernel's
+    batch statistics with MaskedBatchNorm's torch semantics (momentum 0.1,
+    unbiased running variance)."""
+    from gfedntm_tpu.ops.fused_decoder import prodlda_recon_loss
+
+    out, mutated = module.apply(
+        {"params": params, "batch_stats": batch_stats},
+        batch["x_bow"],
+        batch.get("x_ctx"),
+        batch.get("labels"),
+        train=True,
+        mask=mask,
+        mutable=["batch_stats"],
+        rngs=rngs,
+        method="encode_theta",
+    )
+    m = mask.astype(jnp.float32)
+    bn = batch_stats["beta_batchnorm"]
+    rl, b_mean, b_var = prodlda_recon_loss(
+        out.theta, params["beta"], batch["x_bow"],
+        bn["running_mean"], bn["running_var"], m, True,
+    )
+    kl = gaussian_kl(
+        out.prior_mean, out.prior_variance, out.posterior_mean,
+        out.posterior_variance, out.posterior_log_variance,
+    )
+    if family == "avitm":
+        loss = jnp.sum((kl + rl) * m)
+    else:
+        loss = jnp.sum((beta_weight * kl + rl) * m)
+        if out.estimated_labels is not None:
+            loss = loss + cross_entropy_with_logits(
+                out.estimated_labels,
+                jnp.argmax(batch["labels"], axis=1),
+                sample_mask=m,
+            )
+
+    cnt = jnp.maximum(jnp.sum(m), 1.0)
+    var_unbiased = b_var * (cnt / jnp.maximum(cnt - 1.0, 1.0))
+    momentum = 0.1
+    new_bs = dict(mutated["batch_stats"])
+    new_bs["beta_batchnorm"] = {
+        "running_mean": (1 - momentum) * bn["running_mean"]
+        + momentum * b_mean,
+        "running_var": (1 - momentum) * bn["running_var"]
+        + momentum * var_unbiased,
+        "num_batches_tracked": bn["num_batches_tracked"] + 1,
+    }
+    return loss, new_bs
+
+
 def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
                 rngs, train: bool):
     """Forward + reference loss on one (padded, masked) batch."""
+    if (
+        train
+        and getattr(module, "fused_decoder", False)
+        and module.model_type.lower() == "prodlda"
+    ):
+        return _fused_batch_loss(
+            module, family, beta_weight, params, batch_stats, batch, mask,
+            rngs,
+        )
     out, mutated = module.apply(
         {"params": params, "batch_stats": batch_stats},
         batch["x_bow"],

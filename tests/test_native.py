@@ -1,0 +1,98 @@
+"""C++ BoW fast-path tests: exact parity with the Python tokenizer, and the
+fallback contract for inputs it cannot serve."""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu import native
+from gfedntm_tpu.data.vocab import (
+    Vocabulary,
+    build_vocabulary,
+    tokenize,
+    vectorize,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain in this environment"
+)
+
+
+def python_vectorize(docs, vocab: Vocabulary) -> np.ndarray:
+    token2id = vocab.token2id
+    X = np.zeros((len(docs), len(vocab)), dtype=np.float32)
+    for i, doc in enumerate(docs):
+        for tok in tokenize(doc):
+            j = token2id.get(tok)
+            if j is not None:
+                X[i, j] += 1
+    return X
+
+
+CORPUS = [
+    "Hello world_7 the quick-brown fox; a ab ABC abc",
+    "numbers 123 42x under_score __dunder__ x",
+    "punctuation!!! (parens) [brackets] {braces} end.",
+    "",
+    "repeat repeat REPEAT rePEAT",
+]
+
+
+@needs_native
+class TestNativeParity:
+    def test_vectorize_matches_python(self):
+        vocab = build_vocabulary(CORPUS)
+        X_native = native.vectorize(CORPUS, vocab.tokens)
+        np.testing.assert_array_equal(X_native, python_vectorize(CORPUS, vocab))
+
+    def test_count_terms_matches_python(self):
+        counts = native.count_terms(CORPUS)
+        expected: dict[str, int] = {}
+        for doc in CORPUS:
+            for tok in tokenize(doc):
+                expected[tok] = expected.get(tok, 0) + 1
+        assert counts == expected
+
+    def test_no_lowercase(self):
+        docs = ["Mixed CASE Mixed"]
+        counts = native.count_terms(docs, lowercase=False)
+        assert counts == {"Mixed": 2, "CASE": 1}
+
+    def test_random_corpus_parity(self):
+        rng = np.random.default_rng(0)
+        docs = [
+            " ".join(f"wd{i}" for i in rng.integers(0, 300, size=50))
+            for _ in range(40)
+        ]
+        vocab = build_vocabulary(docs)
+        np.testing.assert_array_equal(
+            native.vectorize(docs, vocab.tokens),
+            python_vectorize(docs, vocab),
+        )
+
+    def test_non_ascii_raises_unavailable(self):
+        with pytest.raises(native.NativeUnavailable):
+            native.vectorize(["naïve café"], ("cafe",))
+        with pytest.raises(native.NativeUnavailable):
+            native.count_terms(["münchen"])
+
+
+class TestFallbackIntegration:
+    def test_vocab_layer_handles_non_ascii(self):
+        # The public API must transparently fall back to Python for
+        # non-ASCII text and produce the unicode-correct answer.
+        docs = ["naïve café naïve", "ascii words here"]
+        vocab = build_vocabulary(docs)
+        assert "naïve" in vocab.tokens and "café" in vocab.tokens
+        X = vectorize(docs, vocab)
+        np.testing.assert_array_equal(X, python_vectorize(docs, vocab))
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("GFEDNTM_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_LOAD_ERROR", None)
+        assert not native.available()
+        # public API still works via the Python path
+        vocab = build_vocabulary(CORPUS)
+        np.testing.assert_array_equal(
+            vectorize(CORPUS, vocab), python_vectorize(CORPUS, vocab)
+        )

@@ -32,14 +32,41 @@ def test_forward_parity(training, shape):
     b, k, v = shape
     theta, beta, x, rm, rv = make_inputs(b, k, v)
     rl_f, mean_f, var_f = prodlda_recon_loss(
-        theta, beta, x, rm, rv, training, 1e-5, 1e-10, True
+        theta, beta, x, rm, rv, None, training, 1e-5, 1e-10, True
     )
     rl_r, mean_r, var_r = prodlda_recon_loss_reference(
-        theta, beta, x, rm, rv, training
+        theta, beta, x, rm, rv, None, training
     )
     np.testing.assert_allclose(rl_f, rl_r, rtol=2e-5, atol=2e-4)
     np.testing.assert_allclose(mean_f, mean_r, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(var_f, var_r, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_parity_with_mask():
+    theta, beta, x, rm, rv = make_inputs(10, 5, 260)
+    mask = jnp.asarray([1, 1, 1, 0, 1, 1, 0, 1, 1, 1], jnp.float32)
+    rl_f, mean_f, var_f = prodlda_recon_loss(
+        theta, beta, x, rm, rv, mask, True, 1e-5, 1e-10, True
+    )
+    rl_r, mean_r, var_r = prodlda_recon_loss_reference(
+        theta, beta, x, rm, rv, mask, True
+    )
+    np.testing.assert_allclose(mean_f, mean_r, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var_f, var_r, rtol=1e-5, atol=1e-6)
+    real = np.asarray(mask) > 0
+    np.testing.assert_allclose(
+        np.asarray(rl_f)[real], np.asarray(rl_r)[real], rtol=2e-5, atol=2e-4
+    )
+    assert np.isfinite(np.asarray(rl_f)).all()
+
+
+def test_all_masked_rows_are_finite():
+    theta, beta, x, rm, rv = make_inputs(8, 4, 140)
+    mask = jnp.zeros((8,), jnp.float32)
+    rl, mean, var = prodlda_recon_loss(
+        theta, beta, x, rm, rv, mask, True, 1e-5, 1e-10, True
+    )
+    assert np.isfinite(np.asarray(rl)).all()
 
 
 @pytest.mark.parametrize("training", [True, False])
@@ -48,13 +75,37 @@ def test_gradient_parity(training):
 
     def loss_fused(th, be):
         rl, _, _ = prodlda_recon_loss(
-            th, be, x, rm, rv, training, 1e-5, 1e-10, True
+            th, be, x, rm, rv, None, training, 1e-5, 1e-10, True
         )
         return jnp.sum(rl)
 
     def loss_ref(th, be):
-        rl, _, _ = prodlda_recon_loss_reference(th, be, x, rm, rv, training)
+        rl, _, _ = prodlda_recon_loss_reference(
+            th, be, x, rm, rv, None, training
+        )
         return jnp.sum(rl)
+
+    gf_t, gf_b = jax.grad(loss_fused, argnums=(0, 1))(theta, beta)
+    gr_t, gr_b = jax.grad(loss_ref, argnums=(0, 1))(theta, beta)
+    np.testing.assert_allclose(gf_t, gr_t, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gf_b, gr_b, rtol=1e-4, atol=1e-4)
+
+
+def test_gradient_parity_with_mask():
+    theta, beta, x, rm, rv = make_inputs(9, 5, 200)
+    mask = jnp.asarray([1, 1, 0, 1, 1, 1, 0, 1, 1], jnp.float32)
+
+    def loss_fused(th, be):
+        rl, _, _ = prodlda_recon_loss(
+            th, be, x, rm, rv, mask, True, 1e-5, 1e-10, True
+        )
+        return jnp.sum(rl * mask)
+
+    def loss_ref(th, be):
+        rl, _, _ = prodlda_recon_loss_reference(
+            th, be, x, rm, rv, mask, True
+        )
+        return jnp.sum(rl * mask)
 
     gf_t, gf_b = jax.grad(loss_fused, argnums=(0, 1))(theta, beta)
     gr_t, gr_b = jax.grad(loss_ref, argnums=(0, 1))(theta, beta)
@@ -67,7 +118,7 @@ def test_stats_have_no_gradient_path():
 
     def mean_sum(th):
         _, mean, _ = prodlda_recon_loss(
-            th, beta, x, rm, rv, True, 1e-5, 1e-10, True
+            th, beta, x, rm, rv, None, True, 1e-5, 1e-10, True
         )
         return jnp.sum(mean)
 
@@ -81,10 +132,83 @@ def test_jit_compatible():
     @jax.jit
     def f(th, be, xx):
         rl, _, _ = prodlda_recon_loss(
-            th, be, xx, rm, rv, True, 1e-5, 1e-10, True
+            th, be, xx, rm, rv, None, True, 1e-5, 1e-10, True
         )
         return rl
 
     rl = f(theta, beta, x)
-    rl_r, _, _ = prodlda_recon_loss_reference(theta, beta, x, rm, rv, True)
+    rl_r, _, _ = prodlda_recon_loss_reference(
+        theta, beta, x, rm, rv, None, True
+    )
     np.testing.assert_allclose(rl, rl_r, rtol=2e-5, atol=2e-4)
+
+
+class TestFusedTrainingPath:
+    """The fused kernel dropped into the real training step must reproduce
+    the unfused trajectory (same rng folds, same BN running-stat updates)."""
+
+    def _train(self, fused: bool, seed=0):
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(3)
+        V, docs = 150, 24
+        X = rng.integers(0, 3, size=(docs, V)).astype(np.float32)
+        data = BowDataset(X=X, idx2token={i: f"wd{i}" for i in range(V)})
+        model = AVITM(
+            input_size=V, n_components=4, hidden_sizes=(16, 16),
+            batch_size=8, num_epochs=2, seed=seed, fused_decoder=fused,
+        )
+        model.fit(data)
+        return model
+
+    def test_fused_matches_unfused_training(self):
+        m_fused = self._train(True)
+        m_plain = self._train(False)
+        np.testing.assert_allclose(
+            np.asarray(m_fused.params["beta"]),
+            np.asarray(m_plain.params["beta"]),
+            rtol=5e-4, atol=5e-4,
+        )
+        bn_f = m_fused.batch_stats["beta_batchnorm"]
+        bn_p = m_plain.batch_stats["beta_batchnorm"]
+        np.testing.assert_allclose(
+            np.asarray(bn_f["running_mean"]),
+            np.asarray(bn_p["running_mean"]), rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(bn_f["running_var"]),
+            np.asarray(bn_p["running_var"]), rtol=1e-4, atol=1e-5,
+        )
+        assert int(bn_f["num_batches_tracked"]) == int(
+            bn_p["num_batches_tracked"]
+        )
+
+    def test_fused_federated_program(self):
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(5)
+        V, docs, C = 130, 16, 2
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(docs, V)).astype(np.float32),
+                idx2token={i: f"wd{i}" for i in range(V)},
+            )
+            for _ in range(C)
+        ]
+        results = {}
+        for fused in (True, False):
+            template = AVITM(
+                input_size=V, n_components=3, hidden_sizes=(8, 8),
+                batch_size=8, num_epochs=1, seed=0, fused_decoder=fused,
+            )
+            trainer = FederatedTrainer(template, n_clients=C)
+            results[fused] = trainer.fit(datasets)
+        np.testing.assert_allclose(
+            np.asarray(results[True].client_params["beta"]),
+            np.asarray(results[False].client_params["beta"]),
+            rtol=5e-4, atol=5e-4,
+        )
+        assert np.isfinite(results[True].losses).all()

@@ -1,0 +1,96 @@
+"""GSPMD (data, model)-mesh trainer tests on the virtual CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gfedntm_tpu.data.datasets import BowDataset
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.parallel.sharded import (
+    _leaf_spec,
+    fit_sharded,
+    make_dp_mp_mesh,
+)
+
+
+def make_model_and_data(V=96, docs=32, seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, 3, size=(docs, V)).astype(np.float32)
+    data = BowDataset(X=X, idx2token={i: f"wd{i}" for i in range(V)})
+    kw.setdefault("fused_decoder", False)
+    model = AVITM(
+        input_size=V, n_components=4, hidden_sizes=(16, 16), batch_size=8,
+        num_epochs=2, seed=seed, **kw,
+    )
+    return model, data
+
+
+class TestLeafSpec:
+    def test_rules(self):
+        V = 500
+        assert _leaf_spec((4, V), V) == P(None, "model")      # beta
+        assert _leaf_spec((V, 16), V) == P("model", None)     # input kernel
+        assert _leaf_spec((V,), V) == P("model")              # BN stats
+        assert _leaf_spec((16, 16), V) == P()                 # hidden
+        assert _leaf_spec((4,), V) == P()                     # priors
+        assert _leaf_spec((), V) == P()                       # scalars
+
+
+class TestFitSharded:
+    @pytest.mark.parametrize("dp,mp", [(1, 1), (2, 2), (1, 4), (4, 1)])
+    def test_parity_with_unsharded_fit(self, dp, mp):
+        model_ref, data = make_model_and_data()
+        model_ref.fit(data)
+
+        model_sh, data2 = make_model_and_data()
+        fit_sharded(model_sh, data2, dp=dp, mp=mp)
+
+        np.testing.assert_allclose(
+            np.asarray(model_sh.params["beta"]),
+            np.asarray(model_ref.params["beta"]),
+            rtol=2e-4, atol=2e-4,
+        )
+        bn_s = model_sh.batch_stats["beta_batchnorm"]
+        bn_r = model_ref.batch_stats["beta_batchnorm"]
+        np.testing.assert_allclose(
+            np.asarray(bn_s["running_mean"]),
+            np.asarray(bn_r["running_mean"]),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_beta_actually_sharded_over_model_axis(self):
+        model, data = make_model_and_data()
+        mesh = make_dp_mp_mesh(2, 4)
+        fit_sharded(model, data, mesh=mesh)
+        spec = model.params["beta"].sharding.spec
+        assert spec == P(None, "model")
+        enc_spec = model.params["inf_net"]["input_layer"]["kernel"].sharding.spec
+        # GSPMD may trim the trailing replicated axis from the output spec.
+        assert enc_spec[0] == "model"
+        assert len(enc_spec) < 2 or enc_spec[1] is None
+
+    def test_inference_after_sharded_fit(self):
+        model, data = make_model_and_data()
+        fit_sharded(model, data, dp=2, mp=2)
+        thetas = model.get_doc_topic_distribution(data, n_samples=3)
+        assert thetas.shape == (len(data), 4)
+        assert np.isfinite(thetas).all()
+        topics = model.get_topics(5)
+        assert len(topics) == 4
+
+    def test_rejects_ctm(self):
+        from gfedntm_tpu.models.ctm import ZeroShotTM
+
+        model = ZeroShotTM(
+            input_size=64, contextual_size=8, n_components=3,
+            hidden_sizes=(8, 8), batch_size=8, num_epochs=1,
+            fused_decoder=False,
+        )
+        with pytest.raises(NotImplementedError):
+            fit_sharded(model, None, dp=1, mp=1)
+
+    def test_rejects_fused_multi_device(self):
+        model, data = make_model_and_data(fused_decoder=True)
+        with pytest.raises(NotImplementedError, match="fused"):
+            fit_sharded(model, data, dp=1, mp=2)
