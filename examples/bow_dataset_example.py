@@ -1,0 +1,24 @@
+"""BoW data-prep walkthrough (script form of the reference's
+`notebooks/tests/BoW dataset example.ipynb`): build a vocabulary, vectorize,
+split, and inspect a BowDataset.
+
+Run: python examples/bow_dataset_example.py
+"""
+
+from gfedntm_tpu.data.preparation import prepare_dataset
+from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+
+corpus = generate_synthetic_corpus(
+    vocab_size=300, n_topics=5, n_docs=100, nwords=(20, 40), n_nodes=1,
+    frozen_topics=2, seed=0,
+)
+docs = corpus.nodes[0].documents
+print(f"{len(docs)} documents; first doc: {docs[0][:70]}...")
+
+train_data, val_data, input_size, id2token, docs_train, vocab = (
+    prepare_dataset(docs)
+)
+print(f"vocabulary: {input_size} terms (25% validation split, seed 42)")
+print(f"train matrix: {train_data.X.shape}, val matrix: {val_data.X.shape}")
+print("first 10 terms:", [id2token[i] for i in range(10)])
+print("doc 0 active terms:", int((train_data.X[0] > 0).sum()))

@@ -267,23 +267,10 @@ class DecoderNetwork(nn.Module):
     def __call__(
         self, x_bow, x_ctx=None, labels=None, *, train: bool, mask=None, noise=None
     ) -> TopicModelOutput:
-        prior_mean, prior_variance = self.prior_mean, self.prior_variance
-        posterior_mu, posterior_log_sigma = self._encode(
-            x_bow, x_ctx, labels, train=train, mask=mask
+        encoded = self.encode_theta(
+            x_bow, x_ctx, labels, train=train, mask=mask, noise=noise
         )
-        posterior_sigma = jnp.exp(posterior_log_sigma)
-
-        # Reparameterization trick (decoder_network.py:102-107); the reference
-        # samples in eval mode too, so the rng is drawn unconditionally.
-        # ``noise`` injects a fixed eps (parity tests / deterministic eval).
-        std = jnp.exp(0.5 * posterior_log_sigma)
-        eps = (
-            noise
-            if noise is not None
-            else jax.random.normal(self.make_rng("reparam"), std.shape, dtype=std.dtype)
-        )
-        theta = jax.nn.softmax(posterior_mu + eps * std, axis=1)
-        theta = self.drop_theta(theta, deterministic=not train)
+        theta = encoded.theta
 
         if self.model_type.lower() == "prodlda":
             word_dist = jax.nn.softmax(
@@ -306,20 +293,7 @@ class DecoderNetwork(nn.Module):
         else:
             raise ValueError("model_type must be 'prodLDA' or 'LDA'")
 
-        estimated_labels = None
-        if labels is not None and self.label_size > 0:
-            estimated_labels = self.label_classification(theta)
-
-        return TopicModelOutput(
-            prior_mean=prior_mean,
-            prior_variance=prior_variance,
-            posterior_mean=posterior_mu,
-            posterior_variance=posterior_sigma,
-            posterior_log_variance=posterior_log_sigma,
-            word_dist=word_dist,
-            estimated_labels=estimated_labels,
-            theta=theta,
-        )
+        return encoded._replace(word_dist=word_dist)
 
     def encode_theta(
         self, x_bow, x_ctx=None, labels=None, *, train: bool, mask=None,
@@ -337,6 +311,9 @@ class DecoderNetwork(nn.Module):
             x_bow, x_ctx, labels, train=train, mask=mask
         )
         posterior_sigma = jnp.exp(posterior_log_sigma)
+        # Reparameterization trick (decoder_network.py:102-107); the reference
+        # samples in eval mode too, so the rng is drawn unconditionally.
+        # ``noise`` injects a fixed eps (parity tests / deterministic eval).
         std = jnp.exp(0.5 * posterior_log_sigma)
         eps = (
             noise

@@ -18,7 +18,6 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import tempfile
 import threading
 from pathlib import Path
 
@@ -42,8 +41,11 @@ class NativeUnavailable(RuntimeError):
 
 
 def _cache_path(digest: str) -> Path:
+    # Per-user cache (XDG default ~/.cache): the library is dlopen'd, so a
+    # world-writable location like /tmp would let another local user plant a
+    # predictable-path .so and execute code in this process.
     cache_root = Path(
-        os.environ.get("XDG_CACHE_HOME", os.path.join(tempfile.gettempdir()))
+        os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")
     )
     d = cache_root / "gfedntm_tpu"
     d.mkdir(parents=True, exist_ok=True)

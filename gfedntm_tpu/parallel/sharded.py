@@ -100,10 +100,12 @@ def fit_sharded(
 ) -> None:
     """Run ``model``'s training epochs under the (data, model) sharding.
 
-    Semantically identical to ``model.fit(train_dataset)`` (GSPMD preserves
-    program semantics; only float reduction order differs). The model's
-    state is left sharded on exit — subsequent host reads (``np.asarray``)
-    gather transparently.
+    Matches ``model.fit(train_dataset)`` epoch for epoch (GSPMD preserves
+    program semantics; only float reduction order differs), including the
+    plateau LR scheduler and the NaN abort; validation-based early stopping
+    is the one feature not offered here (pass a pre-split dataset to
+    ``model.fit`` on one device for that). The model's state is left sharded
+    on exit — subsequent host reads (``np.asarray``) gather transparently.
     """
     if model.family != "avitm" or model._contextual_size() > 0:
         raise NotImplementedError(
@@ -124,6 +126,15 @@ def fit_sharded(
     model.opt_state = shard_tree(model.opt_state, mesh, V)
     data = shard_data(model._device_data(train_dataset), mesh, V)
 
+    scheduler = None
+    if model.reduce_on_plateau:
+        from gfedntm_tpu.train.schedulers import (
+            ReduceLROnPlateau,
+            set_learning_rate,
+        )
+
+        scheduler = ReduceLROnPlateau(model.lr)
+
     n_train = len(train_dataset)
     for epoch in range(model.num_epochs):
         model.nn_epoch = epoch
@@ -138,6 +149,10 @@ def fit_sharded(
         )
         train_loss = float(np.sum(np.asarray(losses))) / n_train
         model.best_components = np.asarray(model.params["beta"])
+        if np.isnan(train_loss):
+            break
+        if scheduler is not None:
+            set_learning_rate(model.opt_state, scheduler.step(train_loss))
         if model.verbose:
             model.logger.info(
                 "Epoch: [%d/%d]\tSharded Train Loss: %.4f",
