@@ -5,6 +5,11 @@ split, and inspect a BowDataset.
 Run: python examples/bow_dataset_example.py
 """
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 from gfedntm_tpu.data.preparation import prepare_dataset
 from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
 

@@ -5,6 +5,11 @@ scoring — the reference's centralized-baseline workflow
 Run: python examples/centralized_training.py
 """
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from gfedntm_tpu.data.preparation import prepare_dataset

@@ -7,6 +7,11 @@ On a multi-device host each client maps to its own device; on one device the
 clients batch into a single vmapped program.
 """
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from gfedntm_tpu.data.loaders import RawCorpus

@@ -20,6 +20,9 @@ from gfedntm_tpu import data as data
 from gfedntm_tpu import eval as eval  # noqa: A004
 from gfedntm_tpu import federated as federated
 from gfedntm_tpu import models as models
+from gfedntm_tpu import native as native
+from gfedntm_tpu import ops as ops
 from gfedntm_tpu import parallel as parallel
+from gfedntm_tpu import presets as presets
 from gfedntm_tpu import train as train
 from gfedntm_tpu import utils as utils

@@ -149,10 +149,13 @@ class AVITM:
         intermediates dominate the loss' HBM traffic."""
         fused = getattr(self, "fused_decoder", False)
         if fused == "auto":
+            # Threshold picks the regime where the [B, V] intermediates
+            # dominate loss bandwidth; conservative until the compiled
+            # (non-interpret) kernel has soaked on hardware more widely.
             return (
                 jax.default_backend() == "tpu"
                 and self.model_type.lower() == "prodlda"
-                and self.input_size >= 4096
+                and self.input_size >= 16384
             )
         return bool(fused)
 
@@ -270,6 +273,11 @@ class AVITM:
                 if scheduler is not None:
                     set_learning_rate(self.opt_state, scheduler.step(val_loss))
             else:
+                # NaN abort in the train-only path too (the reference guards
+                # only its validation branch; a NaN run is garbage either
+                # way — intended semantics per SURVEY.md §2.5 policy).
+                if np.isnan(train_loss):
+                    break
                 if scheduler is not None:
                     set_learning_rate(
                         self.opt_state, scheduler.step(train_loss)

@@ -1,0 +1,59 @@
+"""BASELINE.json preset smoke tests at tiny scale (the five evaluation
+configs any reproduction must cover)."""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu import presets
+
+
+def test_registry_covers_the_five_baseline_configs():
+    assert set(presets.PRESETS) == {
+        "prodlda_1client_synthetic",
+        "neurallda_2client_iid",
+        "prodlda_5client_20ng",
+        "combinedtm_5client",
+        "noniid_fos_5client",
+    }
+
+
+def test_prodlda_1client_synthetic():
+    res = presets.prodlda_1client_synthetic(scale=0.02)
+    assert res.summary["n_clients"] == 1
+    assert np.isfinite(res.summary["final_mean_loss"])
+    gt = res.extras["ground_truth"]
+    assert gt.topic_vectors.shape[0] == 10
+
+
+def test_neurallda_2client_iid():
+    res = presets.neurallda_2client_iid(scale=0.02)
+    assert res.summary["n_clients"] == 2
+    assert np.isfinite(res.summary["final_mean_loss"])
+    # NeuralLDA: the trained template family must be LDA
+    assert res.trainer.template.model_type == "LDA"
+
+
+def test_combinedtm_5client():
+    res = presets.combinedtm_5client(scale=0.02)
+    assert res.summary["n_clients"] == 5
+    assert np.isfinite(res.summary["final_mean_loss"])
+    assert res.trainer.template.inference_type == "combined"
+
+
+def test_20ng_preset_raises_cleanly_without_cache(tmp_path):
+    with pytest.raises(OSError):
+        presets.prodlda_5client_20ng(scale=0.01, data_home=str(tmp_path))
+
+
+def test_noniid_preset_validates_categories():
+    with pytest.raises(ValueError, match="5 categories"):
+        presets.noniid_fos_5client("/nonexistent.parquet", ["a", "b"])
+
+
+def test_hashing_embedder_deterministic_unit_norm():
+    embed = presets.hashing_embedder(32)
+    e1 = embed(["hello world", "foo bar baz"])
+    e2 = embed(["hello world", "foo bar baz"])
+    np.testing.assert_array_equal(e1, e2)
+    norms = np.linalg.norm(e1, axis=1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
