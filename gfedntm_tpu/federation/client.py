@@ -215,12 +215,21 @@ class Client:
         port = self._grpc_server.add_insecure_port(self.listen_address)
         self._grpc_server.start()
         self.logger.info("client %d serving on port %d", self.client_id, port)
-        self._federation_stub.ReadyForTraining(
+        ack = self._federation_stub.ReadyForTraining(
             pb.JoinRequest(
                 client_id=self.client_id,
                 address=f"{self.advertise_host}:{port}",
             )
         )
+        if ack.code == 1:
+            # Rejoined after the federation already finished: there will be
+            # no polls and no stop broadcast — finalize immediately instead
+            # of blocking on stopped.wait() forever.
+            self.logger.warning(
+                "client %d: federation already finished; finalizing",
+                self.client_id,
+            )
+            self._on_stop()
 
     def _on_stop(self) -> None:
         """Finalize on the server's stop broadcast: per-client artifacts

@@ -67,10 +67,14 @@ class Federation:
 
     # ---- training phase ----------------------------------------------------
     def connect_ready(self, client_id: int, address: str) -> ClientRecord:
+        """Also the rejoin path: a client that was dropped mid-training
+        (marked ``finished``) and comes back re-enters the active set; its
+        address may have changed (new serving port)."""
         with self._cond:
             rec = self._clients.setdefault(client_id, ClientRecord(client_id))
             rec.address = address
             rec.ready_for_training = True
+            rec.finished = False
             self._cond.notify_all()
             return rec
 
@@ -84,6 +88,16 @@ class Federation:
                 >= self.min_clients,
                 timeout=timeout,
             )
+
+    def mark_dropped(self, client_id: int, address: str) -> None:
+        """Drop a client after a failed RPC — but only if it has not
+        rejoined since: a rejoin changes the serving address, and a stale
+        in-flight failure against the OLD address must not clobber the
+        fresh registration."""
+        with self._lock:
+            rec = self._clients.get(client_id)
+            if rec is not None and rec.address == address:
+                rec.finished = True
 
     def update_progress(
         self, client_id: int, current_mb: int, current_epoch: int,

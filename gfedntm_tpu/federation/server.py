@@ -174,7 +174,12 @@ class FederatedServer:
 
     def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
         """Client readiness signal; the training thread starts exactly once
-        when quorum is reached (``trainFederatedModel``, ``server.py:365-406``)."""
+        when quorum is reached (``trainFederatedModel``, ``server.py:365-406``).
+        A client (re)joining after the federation already finished gets
+        ``code=1`` so it can finalize instead of waiting for polls that will
+        never come."""
+        if self.training_done.is_set():
+            return pb.Ack(code=1, detail="federation already finished")
         self.federation.connect_ready(request.client_id, request.address)
         with self._train_lock:
             if (
@@ -195,13 +200,21 @@ class FederatedServer:
     # ---- phase-2 training loop (server.py:408-553) -------------------------
     def _stub_for(self, stubs: dict, rec) -> rpc.ServiceStub | None:
         """Persistent per-client stub, created on first use so clients that
-        become ready after the loop starts still get polled."""
-        if rec.client_id not in stubs and rec.address:
+        become ready after the loop starts still get polled. Keyed by
+        (client, address): a rejoining client usually serves on a NEW port,
+        so a stale cached channel is closed and replaced, not reused."""
+        if not rec.address:
+            entry = stubs.get(rec.client_id)
+            return entry[2] if entry else None
+        entry = stubs.get(rec.client_id)
+        if entry is None or entry[0] != rec.address:
+            if entry is not None:
+                entry[1].close()
             channel = rpc.make_channel(rec.address)
-            stubs[rec.client_id] = rpc.ServiceStub(
-                channel, "gfedntm.FederationClient"
-            )
-        return stubs.get(rec.client_id)
+            stub = rpc.ServiceStub(channel, "gfedntm.FederationClient")
+            entry = (rec.address, channel, stub)
+            stubs[rec.client_id] = entry
+        return entry[2]
 
     def _run_training(self) -> None:
         try:
@@ -212,7 +225,7 @@ class FederatedServer:
             self.training_done.set()
 
     def _training_loop(self) -> None:
-        stubs: dict[int, rpc.ServiceStub] = {}
+        stubs: dict[int, tuple[str, rpc.ServiceStub]] = {}
         pool = ThreadPoolExecutor(max_workers=self.poll_workers)
         self.logger.info(
             "starting federated training: total weight %.0f",
@@ -226,6 +239,7 @@ class FederatedServer:
 
             # 1. concurrent poll: one local step per client
             def poll(rec):
+                addr = rec.address  # snapshot: rejoin may change it mid-RPC
                 try:
                     stub = self._stub_for(stubs, rec)
                     if stub is None:
@@ -238,10 +252,7 @@ class FederatedServer:
                         "dropping client %d after failed TrainStep: %s",
                         rec.client_id, exc,
                     )
-                    self.federation.update_progress(
-                        rec.client_id, rec.current_mb, rec.current_epoch,
-                        float("nan"), finished=True,
-                    )
+                    self.federation.mark_dropped(rec.client_id, addr)
                     return rec, None
 
             replies = [
@@ -270,8 +281,9 @@ class FederatedServer:
             # 3. concurrent push + progress bookkeeping
             def push(item):
                 rec, reply = item
+                addr = rec.address
                 try:
-                    ack = stubs[rec.client_id].ApplyAggregate(agg)
+                    ack = stubs[rec.client_id][2].ApplyAggregate(agg)
                     self.federation.update_progress(
                         rec.client_id, reply.current_mb, reply.current_epoch,
                         reply.loss, finished=ack.finished,
@@ -283,8 +295,9 @@ class FederatedServer:
                     )
                     self.federation.update_progress(
                         rec.client_id, reply.current_mb, reply.current_epoch,
-                        reply.loss, finished=True,
+                        reply.loss, finished=False,
                     )
+                    self.federation.mark_dropped(rec.client_id, addr)
 
             list(pool.map(push, replies))
             self.global_iterations = iteration + 1
@@ -314,6 +327,8 @@ class FederatedServer:
                 )
         self._finalize()
         pool.shutdown(wait=False)
+        for _addr, channel, _stub in stubs.values():
+            channel.close()
 
     def _finalize(self) -> None:
         """Write the aggregated global model (betas only — the server has no

@@ -71,6 +71,7 @@ class AVITM:
         verbose: bool = False,
         seed: int = 0,
         fused_decoder: bool | str = "auto",
+        compute_dtype: str = "float32",
     ):
         assert isinstance(input_size, int) and input_size > 0, \
             "input_size must by type int > 0."
@@ -113,6 +114,11 @@ class AVITM:
         self.verbose = verbose
         self.seed = seed
         self.fused_decoder = fused_decoder
+        # Compute dtype for the network's matmuls ("bfloat16" feeds the MXU
+        # at twice the f32 rate; parameters and BatchNorm statistics stay
+        # float32 — standard mixed precision). ELBO-parity tests run f32.
+        assert compute_dtype in ("float32", "bfloat16")
+        self.compute_dtype = compute_dtype
 
         self.best_loss_train = float("inf")
         self.model_dir = None
@@ -143,6 +149,10 @@ class AVITM:
         self._infer_fns: dict[int, Any] = {}
 
     # ---- subclass hooks (CTM overrides) ------------------------------------
+    def _module_dtype(self):
+        """jnp dtype for the network's matmul compute (params stay f32)."""
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
+
     def _resolve_fused(self) -> bool:
         """'auto' enables the Pallas fused decode+loss kernel where it pays:
         on TPU, prodLDA, vocabulary large enough that the [B, V] word-dist
@@ -172,6 +182,7 @@ class AVITM:
             topic_prior_variance=self.topic_prior_variance,
             inference_type="bow",
             fused_decoder=self._resolve_fused(),
+            dtype=self._module_dtype(),
         )
 
     def _contextual_size(self) -> int:

@@ -52,6 +52,7 @@ class CTM(AVITM):
         verbose: bool = False,
         seed: int = 0,
         fused_decoder: bool | str = "auto",
+        compute_dtype: str = "float32",
     ):
         assert contextual_size > 0, "contextual_size must be > 0"
         assert inference_type in ("zeroshot", "combined")
@@ -81,6 +82,7 @@ class CTM(AVITM):
             verbose=verbose,
             seed=seed,
             fused_decoder=fused_decoder,
+            compute_dtype=compute_dtype,
         )
 
     def _build_module(self) -> DecoderNetwork:
@@ -98,6 +100,7 @@ class CTM(AVITM):
             contextual_size=self.contextual_size,
             label_size=self.label_size,
             fused_decoder=self._resolve_fused(),
+            dtype=self._module_dtype(),
         )
 
     def _contextual_size(self) -> int:

@@ -211,3 +211,92 @@ def test_grpc_federation_single_client(tmp_path):
     assert server.global_iterations == client.stepper.current_mb
     server.stop()
     client.shutdown()
+
+
+@pytest.mark.slow
+def test_client_rejoin_after_drop(tmp_path):
+    """Elastic recovery: a client that dies mid-training is dropped
+    fail-soft; the same client id rejoining on a NEW port re-enters the
+    round (the reference is fail-stop — SURVEY.md §5).
+
+    Client 1's corpus is sized so its epochs exceed max_iters: the round
+    loop provably outlives the drop/rejoin window, and the federation ends
+    at the max_iters cap with the rejoined client fully trained."""
+    import time
+
+    model_kwargs = dict(
+        n_components=3, hidden_sizes=(8, 8), batch_size=8, num_epochs=2,
+        seed=0,
+    )
+    server = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=model_kwargs,
+        max_iters=5000, save_dir=str(tmp_path / "server"),
+    )
+    server_addr = server.start("[::]:0")
+
+    rng = np.random.default_rng(0)
+    words = [f"word{i:03d}" for i in range(90)]
+    corpus_a = RawCorpus(documents=[
+        " ".join(rng.choice(words, size=25)) for _ in range(2500)
+    ])
+    corpus_b = RawCorpus(documents=[
+        " ".join(rng.choice(words, size=25)) for _ in range(400)
+    ])
+
+    cl_a = Client(
+        client_id=1, corpus=corpus_a, server_address=server_addr,
+        max_features=80, save_dir=str(tmp_path / "client1"),
+    )
+    cl_b = Client(
+        client_id=2, corpus=corpus_b, server_address=server_addr,
+        max_features=80, save_dir=str(tmp_path / "client2"),
+    )
+    t_a = threading.Thread(target=cl_a.run, daemon=True)
+    t_b = threading.Thread(target=cl_b.run, daemon=True)
+    t_a.start()
+    t_b.start()
+
+    # wait until training is underway, then crash client 2's serving side
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        recs = {c.client_id: c for c in server.federation.get_clients()}
+        if 2 in recs and recs[2].current_mb > 0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("training never started")
+    # the drop path must actually be exercised: client 2 (400 docs / batch 8
+    # / 2 epochs = 100 rounds) cannot have finished legitimately yet
+    assert not recs[2].finished
+    cl_b._grpc_server.stop(0)
+
+    # server must drop client 2 fail-soft
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        recs = {c.client_id: c for c in server.federation.get_clients()}
+        if recs[2].finished:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("client 2 was never dropped")
+
+    # same client id rejoins with fresh state on a fresh port
+    cl_b2 = Client(
+        client_id=2, corpus=corpus_b, server_address=server_addr,
+        max_features=80, save_dir=str(tmp_path / "client2b"),
+    )
+    t_b2 = threading.Thread(target=cl_b2.run, daemon=True)
+    t_b2.start()
+
+    assert server.wait_done(timeout=540), "federation did not finish"
+    t_b2.join(timeout=60)
+
+    # the rejoined client trained to completion and produced artifacts
+    assert cl_b2.stopped.is_set()
+    assert cl_b2.results is not None
+    assert cl_b2.stepper.current_epoch == model_kwargs["num_epochs"]
+    rec2 = {c.client_id: c for c in server.federation.get_clients()}[2]
+    assert rec2.current_mb > 0
+    server.stop()
+    cl_a.shutdown()
+    cl_b2.shutdown()

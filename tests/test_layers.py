@@ -106,3 +106,46 @@ def test_torch_dense_matches_torch_linear(rng):
     }
     y = dense.apply(variables, x)
     np.testing.assert_allclose(np.asarray(y), t_out, rtol=1e-5, atol=1e-6)
+
+
+class TestBfloat16Compute:
+    """compute_dtype='bfloat16' runs the matmuls in bf16 with f32 params and
+    BatchNorm stats — must train finite and land near the f32 trajectory."""
+
+    def _fit(self, compute_dtype):
+        import numpy as np
+
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(7)
+        V = 120
+        X = rng.integers(0, 3, size=(24, V)).astype(np.float32)
+        data = BowDataset(X=X, idx2token={i: f"wd{i}" for i in range(V)})
+        model = AVITM(
+            input_size=V, n_components=4, hidden_sizes=(16, 16),
+            batch_size=8, num_epochs=2, seed=0, fused_decoder=False,
+            compute_dtype=compute_dtype,
+        )
+        model.fit(data)
+        return model
+
+    def test_bf16_trains_finite_with_f32_state(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        model = self._fit("bfloat16")
+        assert np.isfinite(np.asarray(model.params["beta"])).all()
+        # parameters and BN stats stay float32
+        assert model.params["beta"].dtype == jnp.float32
+        bn = model.batch_stats["beta_batchnorm"]
+        assert bn["running_mean"].dtype == jnp.float32
+
+    def test_bf16_near_f32_trajectory(self):
+        import numpy as np
+
+        beta_bf16 = np.asarray(self._fit("bfloat16").params["beta"])
+        beta_f32 = np.asarray(self._fit("float32").params["beta"])
+        # loose: bf16 matmuls round, but two epochs shouldn't diverge wildly
+        corr = np.corrcoef(beta_bf16.ravel(), beta_f32.ravel())[0, 1]
+        assert corr > 0.98
