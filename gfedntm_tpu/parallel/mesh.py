@@ -30,6 +30,42 @@ def make_client_mesh(
     return mesh, c_pad
 
 
+def distributed_client_mesh(
+    n_clients: int,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    axis_name: str = "clients",
+) -> tuple[Mesh, int]:
+    """Multi-host client mesh: every host contributes its local devices and
+    the client axis spans the whole job, so FedAvg's ``psum`` rides ICI
+    within a slice and DCN across slices — the multi-host analogue of the
+    reference's docker-compose-per-node topology with NO per-step RPC.
+
+    Call once per process, before any other JAX work. With no arguments it
+    assumes the environment is already configured for
+    ``jax.distributed.initialize`` auto-detection (TPU pods); pass
+    ``coordinator_address``/``num_processes``/``process_id`` explicitly
+    elsewhere. Single-process fallback: behaves like
+    :func:`make_client_mesh`.
+    """
+    if num_processes is not None and num_processes > 1 or (
+        coordinator_address is not None
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif jax.process_count() == 1 and coordinator_address is None:
+        # Auto-detected pod environments initialize with no arguments.
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError):
+            pass  # not a distributed environment: local devices only
+    return make_client_mesh(n_clients, jax.devices(), axis_name)
+
+
 def stack_and_pad(arrays: list[np.ndarray], c_pad: int) -> np.ndarray:
     """Stack per-client arrays along a new leading axis, padding ragged doc
     counts with zero rows and missing clients with zero blocks."""
