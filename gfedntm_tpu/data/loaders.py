@@ -61,6 +61,44 @@ def load_parquet_corpus(
     return RawCorpus(documents=docs, embeddings=embeddings)
 
 
+def load_parquet_partitions(
+    path: str,
+    categories: list[str],
+    text_column: str = "all_rawtext",
+    fos_column: str = "fos",
+    embeddings_column: str = "embeddings",
+) -> list[RawCorpus]:
+    """One read of the parquet, partitioned into one :class:`RawCorpus` per
+    FOS category — avoids re-reading a multi-GB file once per client the
+    way per-category :func:`load_parquet_corpus` calls would."""
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    if text_column not in df.columns:
+        candidates = [c for c in df.columns if df[c].dtype == object]
+        if not candidates:
+            raise ValueError(f"no text column found in {path}")
+        text_column = candidates[0]
+    out = []
+    for category in categories:
+        part = df[df[fos_column] == category]
+        embeddings = None
+        if embeddings_column in part.columns:
+            embeddings = np.stack(
+                [
+                    np.asarray(e, dtype=np.float32)
+                    for e in part[embeddings_column]
+                ]
+            ) if len(part) else None
+        out.append(
+            RawCorpus(
+                documents=part[text_column].astype(str).tolist(),
+                embeddings=embeddings,
+            )
+        )
+    return out
+
+
 def load_20newsgroups(
     data_home: str | None = None, subset: str = "train"
 ) -> RawCorpus:

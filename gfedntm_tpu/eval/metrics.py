@@ -52,10 +52,6 @@ def convert_topic_word_to_init_size(
     return out
 
 
-def _doc_word_sets(corpus_tokens: list[list[str]]) -> list[set[str]]:
-    return [set(doc) for doc in corpus_tokens]
-
-
 def npmi_coherence(
     topics: list[list[str]],
     corpus_tokens: list[list[str]],
@@ -63,30 +59,34 @@ def npmi_coherence(
     eps: float = 1e-12,
 ) -> float:
     """Mean pairwise NPMI of each topic's top words over a reference corpus
-    (document-level co-occurrence, the standard c_npmi regime)."""
-    doc_sets = _doc_word_sets(corpus_tokens)
-    n_docs = len(doc_sets)
+    (document-level co-occurrence, the standard c_npmi regime).
+
+    One corpus pass builds doc-id sets for the topic words only; each word
+    pair is then a set intersection — O(n_docs) total scans instead of one
+    scan per pair (which crawls at 10k+ docs × K·topn² pairs)."""
+    n_docs = len(corpus_tokens)
     if n_docs == 0:
         return 0.0
 
-    # document frequencies
-    df: dict[str, int] = {}
-    for s in doc_sets:
-        for w in s:
-            df[w] = df.get(w, 0) + 1
+    needed = {w for topic in topics for w in topic[:topn]}
+    doc_ids: dict[str, set[int]] = {w: set() for w in needed}
+    for d, doc in enumerate(corpus_tokens):
+        for w in needed.intersection(doc):
+            doc_ids[w].add(d)
 
     scores = []
     for topic in topics:
         words = topic[:topn]
         for i in range(len(words)):
             for j in range(i + 1, len(words)):
-                wi, wj = words[i], words[j]
-                p_i = df.get(wi, 0) / n_docs
-                p_j = df.get(wj, 0) / n_docs
-                co = sum(1 for s in doc_sets if wi in s and wj in s) / n_docs
-                if p_i == 0 or p_j == 0 or co == 0:
+                ids_i = doc_ids[words[i]]
+                ids_j = doc_ids[words[j]]
+                co = len(ids_i & ids_j) / n_docs
+                if not ids_i or not ids_j or co == 0:
                     scores.append(-1.0)
                     continue
+                p_i = len(ids_i) / n_docs
+                p_j = len(ids_j) / n_docs
                 pmi = np.log(co / (p_i * p_j))
                 scores.append(float(pmi / (-np.log(co + eps))))
     return float(np.mean(scores)) if scores else 0.0

@@ -71,9 +71,19 @@ class FederatedStepper:
     (``federated_model.py:98-131`` -> :func:`build_share_mask`).
     """
 
-    def __init__(self, model: AVITM, grads_to_share: tuple[str, ...] = SHARE_ALL):
+    def __init__(
+        self,
+        model: AVITM,
+        grads_to_share: tuple[str, ...] = SHARE_ALL,
+        epoch_snapshot_dir: str | None = None,
+    ):
         self.model = model
         self.grads_to_share = tuple(grads_to_share)
+        # When set, a model snapshot (variables + config) is written at every
+        # epoch end during federated training — the reference does this for
+        # CTM (``federated_ctm.py:150-159``); here any stepped model may
+        # opt in.
+        self.epoch_snapshot_dir = epoch_snapshot_dir
         self.share_mask = build_share_mask(
             {"params": model.params, "batch_stats": model.batch_stats},
             self.grads_to_share,
@@ -196,6 +206,11 @@ class FederatedStepper:
                 self.model.best_components = self.best_components
             self.train_loss = 0.0
             self.samples_processed = 0.0
+            if self.epoch_snapshot_dir is not None:
+                # Per-epoch model snapshot (federated_ctm.py:150-159), tagged
+                # with the epoch that just completed.
+                self.model.nn_epoch = self.current_epoch
+                self.model.save(self.epoch_snapshot_dir)
             self.current_epoch += 1
             self._new_epoch_schedule()
             if self.current_epoch >= self.model.num_epochs:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from typing import Any
 
@@ -196,8 +197,16 @@ class Client:
                 X=X, idx2token=self.global_vocab.id2token
             )
 
+        # CTM federations snapshot the model at every epoch end, matching
+        # the reference (``federated_ctm.py:150-159``); AVITM does not.
+        snapshot_dir = (
+            os.path.join(self.save_dir, "epoch_snapshots")
+            if hyper["family"] == "ctm" and self.save_dir is not None
+            else None
+        )
         self.stepper = FederatedStepper(
-            model, grads_to_share=tuple(hyper["grads_to_share"])
+            model, grads_to_share=tuple(hyper["grads_to_share"]),
+            epoch_snapshot_dir=snapshot_dir,
         )
         self.stepper.pre_fit(self.dataset)
 

@@ -94,6 +94,11 @@ class FederatedServer:
         self._setup_reply: pb.GlobalSetup | None = None
         self._train_lock = threading.Lock()
         self._train_thread: threading.Thread | None = None
+        # _stopping is set BEFORE the stop-broadcast client snapshot so a
+        # ReadyForTraining that lands in the shutdown window (after the
+        # snapshot, before training_done) is turned away with code=1 instead
+        # of blocking forever on a stop that will never be sent.
+        self._stopping = threading.Event()
         self.training_done = threading.Event()
         self._grpc_server = None
 
@@ -178,9 +183,16 @@ class FederatedServer:
         A client (re)joining after the federation already finished gets
         ``code=1`` so it can finalize instead of waiting for polls that will
         never come."""
-        if self.training_done.is_set():
+        if self._stopping.is_set() or self.training_done.is_set():
             return pb.Ack(code=1, detail="federation already finished")
         self.federation.connect_ready(request.client_id, request.address)
+        # Re-check after registering: if the training loop began shutting
+        # down concurrently, this client may have missed the stop-broadcast
+        # snapshot — tell it to finalize on its own. (If it made the
+        # snapshot it gets both the broadcast and code=1; finalization is
+        # idempotent.)
+        if self._stopping.is_set() or self.training_done.is_set():
+            return pb.Ack(code=1, detail="federation already finished")
         with self._train_lock:
             if (
                 self._train_thread is None
@@ -222,10 +234,11 @@ class FederatedServer:
         except Exception:  # pragma: no cover - defensive
             self.logger.exception("federated training loop failed")
         finally:
+            self._stopping.set()
             self.training_done.set()
 
     def _training_loop(self) -> None:
-        stubs: dict[int, tuple[str, rpc.ServiceStub]] = {}
+        stubs: dict[int, tuple[str, Any, rpc.ServiceStub]] = {}
         pool = ThreadPoolExecutor(max_workers=self.poll_workers)
         self.logger.info(
             "starting federated training: total weight %.0f",
@@ -310,7 +323,10 @@ class FederatedServer:
                 )
 
         # 4. stop broadcast + server-side artifact (server.py:523-551);
-        # every ready client gets the broadcast, stub created if need be
+        # every ready client gets the broadcast, stub created if need be.
+        # _stopping goes up first: any ReadyForTraining from here on is
+        # answered code=1 rather than being left waiting for polls.
+        self._stopping.set()
         stop = pb.Aggregate(stop=True)
         for rec in self.federation.get_clients():
             if not rec.ready_for_training:

@@ -121,6 +121,7 @@ class AVITM:
         self.compute_dtype = compute_dtype
 
         self.best_loss_train = float("inf")
+        self.epoch_losses: list[float] = []
         self.model_dir = None
         self.train_data: BowDataset | None = None
         self.validation_data: BowDataset | None = None
@@ -159,11 +160,20 @@ class AVITM:
         intermediates dominate the loss' HBM traffic."""
         fused = getattr(self, "fused_decoder", False)
         if fused == "auto":
+            # Backend probing must never make model *construction* fail: a
+            # transient TPU-init error (single-tenant chip briefly held,
+            # tunnel flake) just means "not TPU right now".
+            try:
+                backend = jax.default_backend()
+            except RuntimeError:
+                backend = "unavailable"
             # Threshold picks the regime where the [B, V] intermediates
             # dominate loss bandwidth; conservative until the compiled
             # (non-interpret) kernel has soaked on hardware more widely.
+            # "axon" is a TPU chip behind a tunnel plugin (platform name
+            # differs, hardware does not).
             return (
-                jax.default_backend() == "tpu"
+                backend in ("tpu", "axon")
                 and self.model_type.lower() == "prodlda"
                 and self.input_size >= 16384
             )
@@ -246,6 +256,7 @@ class AVITM:
             else None
         )
         n_train = len(train_dataset)
+        self.epoch_losses = []
 
         for epoch in range(self.num_epochs):
             self.nn_epoch = epoch
@@ -258,6 +269,7 @@ class AVITM:
                 )
             )
             train_loss = float(jnp.sum(losses)) / n_train
+            self.epoch_losses.append(train_loss)
             self.best_components = np.asarray(self.params["beta"])
 
             if validation_dataset is not None:

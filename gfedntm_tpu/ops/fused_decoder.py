@@ -289,7 +289,9 @@ def prodlda_recon_loss(
     well-defined but meaningless — callers zero them via their sample mask.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # "axon" is the TPU chip behind the tunnel plugin — compiled Pallas,
+        # not interpret mode (which is the CPU-emulation path).
+        interpret = jax.default_backend() not in ("tpu", "axon")
     if mask is None:
         mask = jnp.ones((theta.shape[0],), jnp.float32)
     return _fused_forward(

@@ -57,8 +57,11 @@ def distributed_client_mesh(
             num_processes=num_processes,
             process_id=process_id,
         )
-    elif jax.process_count() == 1 and coordinator_address is None:
-        # Auto-detected pod environments initialize with no arguments.
+    else:
+        # Auto-detected pod environments initialize with no arguments. This
+        # must run BEFORE any backend query (jax.process_count() initializes
+        # the local backend, after which initialize() raises and the job
+        # silently degrades to local-devices-only).
         try:
             jax.distributed.initialize()
         except (RuntimeError, ValueError):

@@ -178,31 +178,97 @@ def combinedtm_5client(
     return res
 
 
+# The reference ships a tiny Semantic Scholar CS fixture in-repo
+# (334 docs, 5 fieldsOfStudy categories, precomputed 192-d embeddings) —
+# the runnable stand-in for the full S2 corpus of docker-compose.yaml:21-157.
+S2CS_TINY_PARQUET = "/root/reference/static/datasets/s2cs_tiny.parquet"
+
+
 def noniid_fos_5client(
-    parquet_path: str, fos_categories: list[str],
-    scale: float = 1.0, seed: int = 0,
+    parquet_path: str | None = None,
+    fos_categories: list[str] | None = None,
+    scale: float = 1.0,
+    seed: int = 0,
+    text_column: str = "lemmas",
+    fos_column: str = "fieldsOfStudy",
+    n_components: int = 50,
+    compute_metrics: bool = True,
 ) -> PresetResult:
     """Config 5: non-IID FOS-partitioned real corpus, 5 clients (the
     collab_vs_non_collab regime); one client per category of the parquet's
-    ``fos`` column."""
-    from gfedntm_tpu.data.loaders import load_parquet_corpus
+    FOS column. Defaults to the reference's in-repo ``s2cs_tiny`` fixture
+    (read-only); categories default to the 5 largest in the file.
 
-    if len(fos_categories) != 5:
+    ``compute_metrics`` scores the aggregated global model with NPMI
+    coherence (vs the pooled corpus), topic diversity, and inverted RBO —
+    the ``collab_vs_non_collab/train.py:22-101`` metric set, computed
+    natively."""
+    import os
+
+    from gfedntm_tpu.data.loaders import load_parquet_partitions
+
+    if fos_categories is not None and len(fos_categories) != 5:
         raise ValueError("the baseline config uses exactly 5 categories")
-    clients = [
-        load_parquet_corpus(parquet_path, fos=f) for f in fos_categories
-    ]
+    if parquet_path is None:
+        parquet_path = S2CS_TINY_PARQUET
+    if not os.path.exists(parquet_path):
+        raise FileNotFoundError(
+            f"non-IID preset needs a FOS-partitioned parquet; {parquet_path} "
+            "not found (this framework never downloads data)"
+        )
+    if fos_categories is None:
+        import pandas as pd
+
+        # column-projected read: the full S2 corpus this stands in for is
+        # multi-GB with an embeddings column
+        counts = (
+            pd.read_parquet(parquet_path, columns=[fos_column])[fos_column]
+            .dropna()
+            .value_counts()
+        )
+        fos_categories = list(counts.index[:5])
+        if len(fos_categories) != 5:
+            raise ValueError(
+                f"the baseline config needs 5 FOS categories; "
+                f"{parquet_path} has {len(fos_categories)}"
+            )
+    clients = load_parquet_partitions(
+        parquet_path, fos_categories, text_column=text_column,
+        fos_column=fos_column,
+    )
     if scale < 1.0:
         clients = [
-            RawCorpus(documents=c.documents[: max(50, int(len(c.documents) * scale))])
+            RawCorpus(documents=c.documents[: max(20, int(len(c.documents) * scale))])
             for c in clients
         ]
-    return _run_federation(
+    res = _run_federation(
         clients, "avitm",
-        dict(n_components=50, hidden_sizes=(50, 50), batch_size=64,
+        dict(n_components=n_components, hidden_sizes=(50, 50), batch_size=64,
              seed=seed),
         num_epochs=max(2, int(100 * scale)),
     )
+    res.summary["fos_categories"] = fos_categories
+    if compute_metrics:
+        from gfedntm_tpu.eval.metrics import (
+            inverted_rbo,
+            npmi_coherence,
+            topic_diversity,
+        )
+
+        global_model = res.trainer.make_global_model(res.result)
+        # any client dataset carries the global id2token
+        global_model.train_data = res.extras["consensus"].datasets[0]
+        topics = global_model.get_topics(10)
+        corpus_tokens = [
+            doc.lower().split() for c in clients for doc in c.documents
+        ]
+        res.summary["metrics"] = {
+            "npmi": npmi_coherence(topics, corpus_tokens, topn=10),
+            "topic_diversity": topic_diversity(topics, topn=10),
+            "inverted_rbo": inverted_rbo(topics, topn=10),
+        }
+        res.extras["topics"] = topics
+    return res
 
 
 PRESETS: dict[str, Callable[..., PresetResult]] = {

@@ -1,0 +1,143 @@
+"""1-client federation ≡ centralized training (SURVEY.md §7.2 step 4).
+
+Two levels of equivalence are pinned:
+
+1. **Exact:** a 1-client :class:`FederatedTrainer` run — the full SPMD
+   machinery (shard_map over the client mesh, vmapped client block,
+   degenerate weighted ``psum``, padding/masking) — reproduces a plain
+   centralized loop driven by :func:`grad_step` with the *same* batch
+   schedule and RNG folding. The federation adds nothing for one client.
+
+2. **Documented divergence vs** :meth:`AVITM.fit`: bitwise equality with the
+   centralized ``fit`` loop is intentionally NOT possible because the RNG
+   streams differ by design —
+   - ``fit`` draws a fresh key per epoch via ``_next_rng()`` (sequential
+     ``jax.random.split``) and folds it by the *in-epoch* step index
+     (``train/steps.py: build_train_epoch``), with epoch schedules from the
+     model's own numpy Generator;
+   - the federated program folds ONE run key by the *absolute* step index
+     and the client id (resume-stable RNG, ``federated/trainer.py``), with
+     schedules from ``make_run_schedule(seed*1000+c)``.
+   Same generative procedure, different streams. The test asserts the
+   trajectories agree in value (same data, same init, same step count) to a
+   loose tolerance while the exact test above carries the real guarantee.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.traverse_util import flatten_dict
+
+from gfedntm_tpu.data.datasets import BowDataset, make_run_schedule
+from gfedntm_tpu.federated.trainer import FederatedTrainer
+from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.train.steps import build_train_step
+
+# The inference net's f_mu/f_sigma heads feed affine-free BatchNorm, which
+# subtracts the batch mean — so their *biases* are loss-invariant directions
+# whose true gradient is exactly zero. Adam divides float-rounding noise by
+# float-rounding noise there, producing O(1) updates along directions that
+# cannot affect any output. Two numerically-identical trajectories therefore
+# agree on every leaf except these two, and on every loss bit-for-bit. (The
+# reference has the same free parameters: Linear->BatchNorm1d(affine=False),
+# inference_network.py:62-85.)
+_BN_FREE_LEAVES = {"inf_net/f_mu/bias", "inf_net/f_sigma/bias"}
+
+
+def _make_dataset(docs=52, vocab=60, seed=3):
+    rng = np.random.default_rng(seed)
+    return BowDataset(
+        X=rng.integers(0, 4, size=(docs, vocab)).astype(np.float32),
+        idx2token={i: f"wd{i}" for i in range(vocab)},
+    )
+
+
+def _make_model(vocab, epochs, seed=0):
+    return AVITM(
+        input_size=vocab, n_components=4, hidden_sizes=(16, 16),
+        batch_size=8, num_epochs=epochs, lr=2e-3, momentum=0.99, seed=seed,
+    )
+
+
+def test_one_client_federation_equals_centralized_loop():
+    """The SPMD program at C=1 ≡ sequential grad_step with the same
+    schedule + RNG stream: per-step losses and final params match."""
+    seed, epochs = 0, 2
+    d = _make_dataset()
+    template = _make_model(d.vocab_size, epochs, seed=seed)
+    trainer = FederatedTrainer(template, n_clients=1, seed=seed)
+    result = trainer.fit([d])
+
+    # Manual centralized loop with the trainer's schedule and RNG folding.
+    model = _make_model(d.vocab_size, epochs, seed=seed)  # same init
+    step_fn = build_train_step(
+        model.module, model.tx, model.family, model._beta_weight()
+    )
+    steps = result.losses.shape[0]
+    sched = make_run_schedule(len(d), model.batch_size, steps, seed=seed * 1000)
+    data = {"x_bow": jnp.asarray(d.X)}
+    run_key = jax.random.PRNGKey(seed + 17)
+    params, batch_stats, opt_state = model.params, model.batch_stats, model.opt_state
+    manual_losses = []
+    for i in range(steps):
+        step_rng = jax.random.fold_in(jax.random.fold_in(run_key, i), 0)
+        params, batch_stats, opt_state, loss = step_fn(
+            params, batch_stats, opt_state, data,
+            jnp.asarray(sched.indices[i]), jnp.asarray(sched.mask[i]),
+            step_rng,
+        )
+        manual_losses.append(float(loss))
+
+    # Per-step losses agree to float precision (empirically bit-identical on
+    # most steps).
+    np.testing.assert_allclose(
+        result.losses[:, 0], np.array(manual_losses), rtol=1e-6
+    )
+    # Parameters agree leaf-by-leaf (the degenerate weighted psum is w*p/w —
+    # float-rounding only), except the two BN-free bias directions (see
+    # _BN_FREE_LEAVES note above), which are loss-invariant.
+    fed_params = jax.tree.map(lambda l: np.asarray(l[0]), result.client_params)
+    flat_fed = flatten_dict(fed_params, sep="/")
+    flat_manual = flatten_dict(jax.tree.map(np.asarray, params), sep="/")
+    assert flat_fed.keys() == flat_manual.keys()
+    for key in flat_fed:
+        if key in _BN_FREE_LEAVES:
+            assert np.all(np.isfinite(flat_fed[key]))
+            continue
+        np.testing.assert_allclose(
+            flat_fed[key], flat_manual[key], rtol=2e-4, atol=5e-6,
+            err_msg=key,
+        )
+
+
+def test_one_client_federation_tracks_avitm_fit():
+    """Documented-divergence check vs AVITM.fit: same data/init/steps,
+    different RNG streams (see module docstring) — trajectories agree in
+    value, not bitwise."""
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+
+    seed, epochs = 0, 4
+    corpus = generate_synthetic_corpus(
+        vocab_size=60, n_topics=4, n_docs=80, nwords=(30, 60), n_nodes=1,
+        frozen_topics=2, seed=7, materialize_docs=False,
+    )
+    idx2token = {i: f"wd{i}" for i in range(60)}
+    d = BowDataset(X=corpus.nodes[0].bow, idx2token=idx2token)
+
+    template = _make_model(d.vocab_size, epochs, seed=seed)
+    trainer = FederatedTrainer(template, n_clients=1, seed=seed)
+    result = trainer.fit([d])
+    fed_epoch_losses = np.array(result.epoch_losses[0])
+
+    central = _make_model(d.vocab_size, epochs, seed=seed)
+    central.fit(BowDataset(X=corpus.nodes[0].bow, idx2token=idx2token))
+
+    assert fed_epoch_losses.shape == (epochs,)
+    assert np.all(np.isfinite(fed_epoch_losses))
+    # both runs learn: loss decreases over training
+    assert fed_epoch_losses[-1] < fed_epoch_losses[0]
+    assert central.epoch_losses[-1] < central.epoch_losses[0]
+    # same data/init/step-count, different RNG streams: final per-epoch
+    # losses agree in value (not bitwise)
+    final_central = central.epoch_losses[-1]
+    assert abs(fed_epoch_losses[-1] - final_central) / final_central < 0.10

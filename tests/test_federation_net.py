@@ -188,6 +188,28 @@ def test_grpc_federation_stop_before_first_epoch(tmp_path):
     client.shutdown()
 
 
+def test_ready_for_training_during_shutdown_window():
+    """A ReadyForTraining landing in the shutdown window — after the
+    stop-broadcast snapshot (``_stopping`` set) but before
+    ``training_done`` — must get code=1, not be registered to wait for
+    polls that will never come."""
+    server = FederatedServer(
+        min_clients=1, family="avitm",
+        model_kwargs=dict(
+            n_components=3, hidden_sizes=(8, 8), batch_size=8, num_epochs=1,
+            seed=0,
+        ),
+    )
+    server._stopping.set()
+    assert not server.training_done.is_set()
+    ack = server.ReadyForTraining(
+        pb.JoinRequest(client_id=7, address="localhost:1"), None
+    )
+    assert ack.code == 1
+    assert server._train_thread is None
+    assert len(server.federation) == 0  # turned away before registration
+
+
 @pytest.mark.slow
 def test_grpc_federation_single_client(tmp_path):
     server = FederatedServer(

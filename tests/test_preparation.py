@@ -18,6 +18,7 @@ from gfedntm_tpu.data.preparation import (
 )
 from gfedntm_tpu.data.preproc import (
     PreprocConfig,
+    load_wordlist,
     parse_equivalences,
     preprocess_corpus,
 )
@@ -148,3 +149,86 @@ def test_preprocess_min_lemas_drops_docs():
 
 def test_parse_equivalences():
     assert parse_equivalences(["a:b", "bad", "x : y "]) == {"a": "b", "x": "y"}
+
+
+# ---- vendored wordlists + real-corpus preprocessing end-to-end -------------
+
+_WORDLIST_DIR = __import__("os").path.join(
+    __import__("os").path.dirname(__import__("os").path.dirname(
+        __import__("os").path.abspath(__file__))), "wordlists")
+_S2CS = "/root/reference/static/datasets/s2cs_tiny.parquet"
+
+
+def test_vendored_wordlists_complete_and_well_formed():
+    """All 14 reference wordlist JSONs are vendored (12 preprocessing +
+    2 static) and parse under the reference schema."""
+    import os
+
+    expected = {
+        "AI_equivalences.json", "AI_stopwords.json",
+        "S2CS_equivalences.json", "S2CS_stopwords.json",
+        "S2_equivalences.json", "S2_stopwords.json",
+        "academic_equivalences.json", "academic_stopwords.json",
+        "cancer_equivalences.json", "cancer_stopwords.json",
+        "cordis_equivalences.json", "cordis_stopwords.json",
+        "english_generic.json", "federated_equiv.json",
+        "federated_stop.json", "wiki_categories.json",
+    }
+    present = {f for f in os.listdir(_WORDLIST_DIR) if f.endswith(".json")}
+    assert expected <= present
+    static = set(os.listdir(os.path.join(_WORDLIST_DIR, "static")))
+    assert {"S2_equivalences.json", "S2_stopwords.json"} <= static
+    for name in sorted(expected):
+        words = load_wordlist(os.path.join(_WORDLIST_DIR, name))
+        assert isinstance(words, list) and len(words) > 0
+        assert all(isinstance(w, str) for w in words)
+    # equivalence lists parse into mappings
+    eq = parse_equivalences(
+        load_wordlist(os.path.join(_WORDLIST_DIR, "S2CS_equivalences.json"))
+    )
+    assert len(eq) > 0
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists(_S2CS),
+    reason="reference s2cs_tiny fixture absent",
+)
+def test_preproc_pipeline_end_to_end_on_s2cs():
+    """text_preproc.py-equivalent flow on the real fixture: S2CS wordlists ->
+    preprocess_corpus -> vocabulary -> a short training run."""
+    import os
+
+    import pandas as pd
+
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.data.vocab import Vocabulary, vectorize
+    from gfedntm_tpu.models.avitm import AVITM
+
+    docs = pd.read_parquet(_S2CS)["lemmas"].astype(str).tolist()
+    cfg = PreprocConfig(
+        min_lemas=5, no_below=5, no_above=0.6, keep_n=2000,
+        stopwords=load_wordlist(
+            os.path.join(_WORDLIST_DIR, "S2CS_stopwords.json")
+        ),
+        equivalences=load_wordlist(
+            os.path.join(_WORDLIST_DIR, "S2CS_equivalences.json")
+        ),
+    )
+    res = preprocess_corpus(docs, cfg)
+    assert len(res.docs) > 100
+    assert 50 < len(res.vocabulary) <= 2000
+    # stopwords are gone from the vocabulary
+    assert not (set(cfg.stopwords) & set(res.vocabulary))
+
+    vocab = Vocabulary(tuple(res.vocabulary))
+    X = vectorize([" ".join(d) for d in res.docs], vocab)
+    assert X.shape == (len(res.docs), len(vocab))
+    model = AVITM(
+        input_size=len(vocab), n_components=5, hidden_sizes=(16, 16),
+        batch_size=32, num_epochs=2, seed=0,
+    )
+    model.fit(BowDataset(X=X, idx2token=vocab.id2token))
+    assert np.all(np.isfinite(model.epoch_losses))
+    topics = model.get_topics(5)
+    assert len(topics) == 5
+    assert all(w in set(res.vocabulary) for t in topics for w in t)

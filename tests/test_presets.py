@@ -50,6 +50,34 @@ def test_noniid_preset_validates_categories():
         presets.noniid_fos_5client("/nonexistent.parquet", ["a", "b"])
 
 
+def test_noniid_preset_raises_cleanly_without_data():
+    with pytest.raises(FileNotFoundError, match="never downloads"):
+        presets.noniid_fos_5client("/nonexistent.parquet")
+
+
+_HAS_S2CS = __import__("os").path.exists(presets.S2CS_TINY_PARQUET)
+
+
+@pytest.mark.skipif(not _HAS_S2CS, reason="reference s2cs_tiny fixture absent")
+def test_noniid_fos_5client_real_corpus_end_to_end():
+    """The full config-5 path on the reference's real-corpus fixture:
+    FOS partition -> vocabulary consensus -> SPMD federated fit ->
+    NPMI/diversity/RBO on the aggregated global model."""
+    res = presets.noniid_fos_5client(scale=0.3, n_components=10)
+    assert res.summary["n_clients"] == 5
+    assert len(res.summary["fos_categories"]) == 5
+    assert np.isfinite(res.summary["final_mean_loss"])
+    m = res.summary["metrics"]
+    assert -1.0 <= m["npmi"] <= 1.0
+    assert 0.0 < m["topic_diversity"] <= 1.0
+    assert 0.0 <= m["inverted_rbo"] <= 1.0
+    topics = res.extras["topics"]
+    assert len(topics) == 10 and all(len(t) == 10 for t in topics)
+    # topics are real corpus words, not ids
+    vocab_words = {w for t in topics for w in t}
+    assert all(not w.isdigit() for w in vocab_words)
+
+
 def test_hashing_embedder_deterministic_unit_norm():
     embed = presets.hashing_embedder(32)
     e1 = embed(["hello world", "foo bar baz"])

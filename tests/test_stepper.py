@@ -175,3 +175,37 @@ def test_ctm_stepper():
         status = s.delta_update_fit(snap)
     assert status.finished and status.current_epoch == 1
     assert np.isfinite(s.epoch_losses[0])
+
+
+def test_epoch_snapshot_hook_saves_every_epoch(tmp_path):
+    """``epoch_snapshot_dir`` writes one model snapshot per completed epoch
+    during federated stepping (``federated_ctm.py:150-159``)."""
+    rng = np.random.default_rng(0)
+    vocab, ctx, epochs = 40, 12, 3
+    d = CTMDataset(
+        X=rng.integers(0, 3, size=(16, vocab)).astype(np.float32),
+        idx2token={i: f"wd{i}" for i in range(vocab)},
+        X_ctx=rng.normal(size=(16, ctx)).astype(np.float32),
+    )
+    model = ZeroShotTM(
+        input_size=vocab, contextual_size=ctx, n_components=3,
+        hidden_sizes=(8, 8), batch_size=8, num_epochs=epochs, seed=0,
+    )
+    snap_dir = tmp_path / "snapshots"
+    s = FederatedCTM(model, epoch_snapshot_dir=str(snap_dir))
+    s.pre_fit(d)
+    while not s.finished:
+        s.delta_update_fit(s.train_mb_delta())
+    for epoch in range(epochs):
+        assert (snap_dir / f"epoch_{epoch}.npz").exists()
+        assert (snap_dir / f"epoch_{epoch}.json").exists()
+    # snapshots restore into a fresh model
+    fresh = ZeroShotTM(
+        input_size=vocab, contextual_size=ctx, n_components=3,
+        hidden_sizes=(8, 8), batch_size=8, num_epochs=epochs, seed=1,
+    )
+    fresh.load(str(snap_dir), epochs - 1)
+    np.testing.assert_allclose(
+        np.asarray(fresh.params["beta"]), np.asarray(model.params["beta"]),
+        rtol=1e-6,
+    )
