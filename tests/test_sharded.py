@@ -79,18 +79,73 @@ class TestFitSharded:
         topics = model.get_topics(5)
         assert len(topics) == 4
 
-    def test_rejects_ctm(self):
-        from gfedntm_tpu.models.ctm import ZeroShotTM
-
-        model = ZeroShotTM(
-            input_size=64, contextual_size=8, n_components=3,
-            hidden_sizes=(8, 8), batch_size=8, num_epochs=1,
-            fused_decoder=False,
+    def test_validation_early_stopping_and_checkpoint(self, tmp_path):
+        """Sharded fit supports the full fit() surface: validation epochs,
+        early stopping (patience exhausted on noise), checkpointing."""
+        model, data = make_model_and_data(docs=48)
+        model.num_epochs = 12
+        rng = np.random.default_rng(1)
+        val = BowDataset(
+            X=rng.integers(0, 3, size=(16, 96)).astype(np.float32),
+            idx2token=data.idx2token,
         )
-        with pytest.raises(NotImplementedError):
-            fit_sharded(model, None, dp=1, mp=1)
+        fit_sharded(
+            model, data, validation_dataset=val, dp=2, mp=2,
+            save_dir=str(tmp_path), patience=2,
+        )
+        # random data: val loss plateaus -> early stop before 12 epochs
+        assert len(model.epoch_losses) < 12
+        # checkpoint written on the best-val epoch
+        assert any(tmp_path.glob("epoch_*.npz"))
 
-    def test_rejects_fused_multi_device(self):
-        model, data = make_model_and_data(fused_decoder=True)
-        with pytest.raises(NotImplementedError, match="fused"):
-            fit_sharded(model, data, dp=1, mp=2)
+    def _make_ctm(self, V=96, docs=32, seed=0, combined=False, **kw):
+        from gfedntm_tpu.data.datasets import CTMDataset
+        from gfedntm_tpu.models.ctm import CombinedTM, ZeroShotTM
+
+        rng = np.random.default_rng(seed)
+        X = rng.integers(0, 3, size=(docs, V)).astype(np.float32)
+        ctx = rng.normal(size=(docs, 16)).astype(np.float32)
+        data = CTMDataset(
+            X=X, idx2token={i: f"wd{i}" for i in range(V)}, X_ctx=ctx
+        )
+        cls = CombinedTM if combined else ZeroShotTM
+        kw.setdefault("fused_decoder", False)
+        model = cls(
+            input_size=V, contextual_size=16, n_components=4,
+            hidden_sizes=(16, 16), batch_size=8, num_epochs=2, seed=seed,
+            **kw,
+        )
+        return model, data
+
+    @pytest.mark.parametrize("combined", [False, True])
+    def test_ctm_parity_with_unsharded_fit(self, combined):
+        """CTM (zeroshot + combined) shards: parity vs single-device fit."""
+        model_ref, data = self._make_ctm(combined=combined)
+        model_ref.fit(data)
+
+        model_sh, data2 = self._make_ctm(combined=combined)
+        fit_sharded(model_sh, data2, dp=2, mp=2)
+
+        np.testing.assert_allclose(
+            np.asarray(model_sh.params["beta"]),
+            np.asarray(model_ref.params["beta"]),
+            rtol=2e-4, atol=2e-4,
+        )
+        if combined:
+            # adapt_bert's V axis is sharded over the model axis
+            spec = model_sh.params["inf_net"]["adapt_bert"]["kernel"].sharding.spec
+            assert tuple(spec)[:2][-1] == "model" or spec == P(None, "model")
+
+    def test_fused_multi_device_auto_falls_back(self):
+        """A fused-decoder model on a multi-device mesh trains via the plain
+        XLA path (documented auto-fallback) and matches the unfused run."""
+        model_ref, data = make_model_and_data(fused_decoder=False)
+        model_ref.fit(data)
+
+        model_fused, data2 = make_model_and_data(fused_decoder=True)
+        fit_sharded(model_fused, data2, dp=2, mp=2)
+        np.testing.assert_allclose(
+            np.asarray(model_fused.params["beta"]),
+            np.asarray(model_ref.params["beta"]),
+            rtol=2e-4, atol=2e-4,
+        )
