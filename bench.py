@@ -159,12 +159,90 @@ def run(backend: str) -> dict:
     }
 
 
+def bench_fused_largev(backend: str, v_list=(16384, 100_000)) -> dict:
+    """Soak the compiled Pallas fused decode+loss kernel at large V: on-device
+    parity vs the unfused XLA oracle (values + grads) and fwd+bwd step time
+    for both, per V. This is the regime the kernel exists for (the reference
+    preprocesses to V up to 100k, ``text_preproc.py:49``); the main bench's
+    V=5000 federation sits below the auto-enable threshold."""
+    import jax
+    import jax.numpy as jnp
+
+    from gfedntm_tpu.ops.fused_decoder import (
+        prodlda_recon_loss,
+        prodlda_recon_loss_reference,
+    )
+
+    interpret = backend == "cpu"  # CPU fallback: interpret mode (tiny V only)
+    out = {}
+    B, K = 64, 50
+    for V in v_list if not interpret else (2048,):
+        rng = np.random.default_rng(0)
+        theta = jnp.asarray(
+            rng.dirichlet(np.ones(K), size=B).astype(np.float32)
+        )
+        beta = jnp.asarray(rng.normal(size=(K, V)).astype(np.float32))
+        x = jnp.asarray(
+            rng.integers(0, 3, size=(B, V)).astype(np.float32)
+        )
+        mask = jnp.ones((B,), jnp.float32)
+        rm, rv = jnp.zeros((V,)), jnp.ones((V,))
+
+        def loss_fused(theta, beta):
+            rl, _, _ = prodlda_recon_loss(
+                theta, beta, x, rm, rv, mask, True, interpret=interpret
+            )
+            return jnp.sum(rl * mask)
+
+        def loss_ref(theta, beta):
+            rl, _, _ = prodlda_recon_loss_reference(
+                theta, beta, x, rm, rv, mask, True
+            )
+            return jnp.sum(rl * mask)
+
+        f_fused = jax.jit(jax.value_and_grad(loss_fused, argnums=(0, 1)))
+        f_ref = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1)))
+
+        lf, gf = f_fused(theta, beta)
+        lr, gr = f_ref(theta, beta)
+        jax.block_until_ready((lf, gf, lr, gr))
+        loss_rel = abs(float(lf) - float(lr)) / max(abs(float(lr)), 1e-9)
+        grad_rel = max(
+            float(jnp.max(jnp.abs(a - b)))
+            / max(float(jnp.max(jnp.abs(b))), 1e-9)
+            for a, b in zip(gf, gr)
+        )
+
+        def timeit(fn, n=10):
+            fn(theta, beta)  # warm
+            t0 = time.perf_counter()
+            for _ in range(n):
+                res = fn(theta, beta)
+            jax.block_until_ready(res)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        out[f"V{V}"] = {
+            "fused_ms": round(timeit(f_fused), 3),
+            "unfused_ms": round(timeit(f_ref), 3),
+            "loss_rel_err": float(f"{loss_rel:.2e}"),
+            "grad_rel_err": float(f"{grad_rel:.2e}"),
+            "parity": bool(loss_rel < 1e-4 and grad_rel < 1e-3),
+        }
+    return out
+
+
 def main() -> None:
     forced_cpu = "--cpu" in sys.argv
     backend = "cpu" if forced_cpu else _probe_backend()
 
     try:
         summary = run(backend)
+        try:
+            summary["fused_largev"] = bench_fused_largev(
+                summary.get("backend", backend)
+            )
+        except Exception as exc:  # noqa: BLE001 - variant must not kill bench
+            summary["fused_largev_error"] = repr(exc)
     except Exception as exc:  # noqa: BLE001 - any accel failure -> CPU rerun
         if backend == "cpu":
             raise

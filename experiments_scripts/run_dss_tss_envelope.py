@@ -1,0 +1,64 @@
+"""Reproduce the BASELINE.md quality envelope (reference regime).
+
+Runs the DSS/TSS simulation at the reference's published evaluation point:
+eta=0.01, V=5000, K=50, 5 nodes, 10k train + 1k inference docs/node
+(``experiments/dss_tss/config/eta_variable/config.json``), whose committed
+envelope is centralized TSS 8.679 +/- 0.042 vs non-collaborative 7.571 vs
+random 3.564 (BASELINE.md / ``results/eta_variable/results.pickle``).
+
+Usage: python experiments_scripts/run_dss_tss_envelope.py [iters] [out_dir]
+
+Writes ``results.json`` (+ ``results.pickle``) under ``out_dir`` (default
+``results/dss_tss_eta001``). Runs on whatever backend jax selects; pass
+FORCE_CPU=1 to pin CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "results/dss_tss_eta001"
+
+    import jax
+
+    if os.environ.get("FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from gfedntm_tpu.experiments.dss_tss import SimulationConfig, run_simulation
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = SimulationConfig(
+        experiment=1, eta_list=(0.01,), iters=iters, seed=0,
+    )
+    t0 = time.perf_counter()
+    out = run_simulation(cfg, results_dir=out_dir)
+    elapsed = time.perf_counter() - t0
+    cols = out["columns"]
+    print(
+        f"backend={jax.default_backend()} iters={iters} "
+        f"elapsed={elapsed:.0f}s\n"
+        f"centralized TSS {cols['centralized_betas_mean'][0]:.3f} "
+        f"(ref 8.679+/-0.042)\n"
+        f"non-collab  TSS {cols['non_colab_betas_mean'][0]:.3f} "
+        f"(ref 7.571+/-0.048)\n"
+        f"random      TSS {cols['baseline_betas_mean'][0]:.3f} "
+        f"(ref 3.564+/-0.098)\n"
+        f"centralized DSS {cols['centralized_thetas_mean'][0]:.1f} "
+        f"(ref 2555.5)\n"
+        f"non-collab  DSS {cols['non_colab_thetas_mean'][0]:.1f} "
+        f"(ref 3066.7)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Soak the Pallas fused decode+loss kernel compiled on TPU at large V.
+
+VERDICT r1 item 2: run the kernel compiled (non-interpret) at
+V in {16384, 50k, 100k}, assert parity vs ``prodlda_recon_loss_reference``
+on-device, measure fused vs unfused step time, and derive the auto-enable
+threshold from data instead of faith (``models/avitm.py:_resolve_fused``).
+
+Usage: python experiments_scripts/soak_fused_kernel.py [out_json]
+Writes a JSON report (default ``results/fused_kernel_soak.json``) with the
+timing table and a recommended threshold = the smallest tested V where the
+fused path wins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1 else "results/fused_kernel_soak.json"
+    )
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import bench_fused_largev
+
+    backend = jax.default_backend()
+    table = bench_fused_largev(backend, v_list=(16384, 50_000, 100_000))
+    wins = [
+        int(k[1:]) for k, row in table.items()
+        if row["parity"] and row["fused_ms"] < row["unfused_ms"]
+    ]
+    report = {
+        "backend": backend,
+        "table": table,
+        "all_parity": all(r["parity"] for r in table.values()),
+        "recommended_threshold": min(wins) if wins else None,
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
