@@ -1,0 +1,135 @@
+"""Regenerate the paper's figures from saved experiment artifacts.
+
+Native equivalent of the reference's ``notebooks/graphs_paper/``:
+
+- ``DSS_TSS``: errorbar panels of TSS (betas) and DSS (thetas) per arm
+  (centralized / non-collaborative / random) against the sweep variable
+  (eta, log-x; and/or number of frozen topics), read from the
+  ``results.json`` files written by
+  :func:`gfedntm_tpu.experiments.dss_tss.run_simulation`.
+- ``Federated``: per-client + server topic summary read from the ``.npz``
+  model artifacts written at federation end (betas heatmap + top words),
+  schema of ``gfedntm_tpu/utils/serialization.py``.
+
+Usage:
+  python experiments_scripts/plot_paper_figures.py dss_tss OUT.png \
+      --eta results/dss_tss_eta001/results.json [--frozen .../results.json]
+  python experiments_scripts/plot_paper_figures.py federated OUT.png \
+      MODEL1.npz [MODEL2.npz ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+ARMS = ("centralized", "non_colab", "baseline")
+LABELS = {"centralized": "Centralized", "non_colab": "Non-collaborative",
+          "baseline": "Random baseline"}
+COLORS = {"centralized": "tab:green", "non_colab": "tab:blue",
+          "baseline": "tab:red"}
+
+
+def _panel(ax, results: dict, stat: str, logx: bool) -> None:
+    index = results["index"]
+    cols = results["columns"]
+    for arm in ARMS:
+        mean_key, std_key = f"{arm}_{stat}_mean", f"{arm}_{stat}_std"
+        if mean_key not in cols:
+            continue
+        if stat == "thetas" and arm == "baseline":
+            continue  # reference omits the random arm from DSS panels
+        ax.errorbar(
+            index, cols[mean_key], yerr=cols[std_key], fmt="x-",
+            label=LABELS[arm], color=COLORS[arm], ecolor="gray",
+            capsize=2, lw=1,
+        )
+    if logx:
+        ax.set_xscale("log")
+    ax.set_xlabel(results.get("index_name", ""))
+    ax.set_ylabel(
+        "Topic similarity score" if stat == "betas"
+        else "Doc similarity score"
+    )
+    ax.grid(True, linestyle=":")
+
+
+def plot_dss_tss(out: str, eta_json: str | None, frozen_json: str | None):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    sweeps = [
+        (name, json.load(open(path)))
+        for name, path in (("eta", eta_json), ("frozen", frozen_json))
+        if path
+    ]
+    if not sweeps:
+        raise SystemExit("need at least one of --eta / --frozen")
+    fig, axs = plt.subplots(
+        nrows=len(sweeps), ncols=2, figsize=(8, 2.8 * len(sweeps)),
+        squeeze=False,
+    )
+    for row, (name, results) in enumerate(sweeps):
+        _panel(axs[row][0], results, "betas", logx=name == "eta")
+        _panel(axs[row][1], results, "thetas", logx=name == "eta")
+    axs[0][0].legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out, dpi=300, bbox_inches="tight")
+    print(f"wrote {out}")
+
+
+def plot_federated(out: str, model_paths: list[str]):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axs = plt.subplots(
+        nrows=1, ncols=len(model_paths), figsize=(3 * len(model_paths), 3),
+        squeeze=False,
+    )
+    for i, path in enumerate(model_paths):
+        data = np.load(path, allow_pickle=True)
+        betas = np.asarray(data["betas"], dtype=np.float32)
+        ax = axs[0][i]
+        ax.imshow(betas, aspect="auto", cmap="viridis")
+        ax.set_title(path.rsplit("/", 1)[-1], fontsize=8)
+        ax.set_xlabel("vocabulary")
+        ax.set_ylabel("topic")
+        if "topics" in data and data["topics"] is not None:
+            topics = data["topics"]
+            try:
+                first = ", ".join(list(topics[0])[:4])
+                ax.text(
+                    0.02, -0.35, f"t0: {first}", transform=ax.transAxes,
+                    fontsize=6,
+                )
+            except (TypeError, IndexError):
+                pass
+    fig.tight_layout()
+    fig.savefig(out, dpi=300, bbox_inches="tight")
+    print(f"wrote {out}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("figure", choices=["dss_tss", "federated"])
+    p.add_argument("out")
+    p.add_argument("models", nargs="*", help="npz artifacts (federated)")
+    p.add_argument("--eta", help="eta-sweep results.json")
+    p.add_argument("--frozen", help="frozen-sweep results.json")
+    args = p.parse_args()
+    if args.figure == "dss_tss":
+        plot_dss_tss(args.out, args.eta, args.frozen)
+    else:
+        if not args.models:
+            raise SystemExit("federated figure needs npz model paths")
+        plot_federated(args.out, args.models)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Client-mesh construction tests (parallel/mesh.py), incl. the multi-host
+``distributed_client_mesh`` branch logic that can't run a real pod here:
+the initialize-before-backend-query ordering and the single-process
+fallback are pinned with monkeypatched ``jax.distributed``."""
+
+import jax
+import numpy as np
+import pytest
+
+from gfedntm_tpu.parallel.mesh import (
+    distributed_client_mesh,
+    make_client_mesh,
+    stack_and_pad,
+)
+
+
+def test_make_client_mesh_pads_to_device_multiple():
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh, c_pad = make_client_mesh(n_dev + 1, devices)
+    assert mesh.devices.size == n_dev
+    assert c_pad % n_dev == 0 and c_pad >= n_dev + 1
+
+
+def test_make_client_mesh_fewer_clients_than_devices():
+    mesh, c_pad = make_client_mesh(2, jax.devices())
+    assert mesh.devices.size == min(2, len(jax.devices()))
+    assert c_pad == 2
+
+
+def test_stack_and_pad_zero_blocks():
+    a = [np.ones((3, 4), np.float32), np.ones((5, 4), np.float32)]
+    out = stack_and_pad(a, 4)
+    assert out.shape == (4, 5, 4)
+    assert out[0, 3:].sum() == 0  # ragged doc rows zero-padded
+    assert out[2:].sum() == 0  # missing clients are zero blocks
+
+
+def test_distributed_mesh_auto_detect_tries_initialize_first(monkeypatch):
+    """The auto-detect branch must call jax.distributed.initialize BEFORE
+    any backend query (process_count initializes the local backend, after
+    which initialize raises and the job silently degrades — ADVICE r1)."""
+    calls = []
+
+    def fake_initialize(**kwargs):
+        calls.append("initialize")
+        raise RuntimeError("not a distributed environment")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+
+    def fail_process_count():
+        raise AssertionError("process_count queried before initialize")
+
+    if not calls:
+        monkeypatch.setattr(jax, "process_count", fail_process_count)
+    mesh, c_pad = distributed_client_mesh(3)
+    assert calls == ["initialize"]  # attempted, failure swallowed
+    assert mesh.devices.size >= 1  # fell back to local devices
+    assert c_pad >= 3
+
+
+def test_distributed_mesh_explicit_args_forwarded(monkeypatch):
+    seen = {}
+
+    def fake_initialize(coordinator_address=None, num_processes=None,
+                        process_id=None):
+        seen.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+        )
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    mesh, _ = distributed_client_mesh(
+        2, coordinator_address="host:1234", num_processes=1, process_id=0
+    )
+    assert seen == {
+        "coordinator_address": "host:1234", "num_processes": 1,
+        "process_id": 0,
+    }
+    assert mesh.devices.size >= 1
