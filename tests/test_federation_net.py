@@ -188,6 +188,48 @@ def test_grpc_federation_stop_before_first_epoch(tmp_path):
     client.shutdown()
 
 
+@pytest.mark.slow
+def test_grpc_ctm_federation_with_epoch_snapshots(tmp_path):
+    """CTM over the network path: consensus ships contextual hyperparams,
+    clients train a ZeroShotTM, and — matching ``federated_ctm.py:150-159``
+    — every completed epoch writes a model snapshot under the client's
+    save_dir."""
+    epochs = 2
+    server = FederatedServer(
+        min_clients=1, family="ctm",
+        model_kwargs=dict(
+            n_components=3, hidden_sizes=(8, 8), batch_size=8,
+            num_epochs=epochs, contextual_size=12, inference_type="zeroshot",
+            seed=0,
+        ),
+        max_iters=200, save_dir=str(tmp_path / "server"),
+    )
+    addr = server.start("[::]:0")
+
+    corpus = _make_corpora(1, docs=18)[0]
+    rng = np.random.default_rng(3)
+    corpus = RawCorpus(
+        documents=corpus.documents,
+        embeddings=rng.normal(size=(len(corpus), 12)).astype(np.float32),
+    )
+    client = Client(
+        client_id=1, corpus=corpus, server_address=addr, max_features=60,
+        save_dir=str(tmp_path / "c1"),
+    )
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    assert server.wait_done(timeout=300)
+    t.join(timeout=60)
+
+    assert client.stepper.finished
+    snap_dir = tmp_path / "c1" / "epoch_snapshots"
+    for epoch in range(epochs):
+        assert (snap_dir / f"epoch_{epoch}.npz").exists(), epoch
+    assert (tmp_path / "c1" / "model.npz").exists()
+    server.stop()
+    client.shutdown()
+
+
 def test_ready_for_training_during_shutdown_window():
     """A ReadyForTraining landing in the shutdown window — after the
     stop-broadcast snapshot (``_stopping`` set) but before
