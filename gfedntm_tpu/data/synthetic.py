@@ -84,18 +84,30 @@ def generate_synthetic_corpus(
         doc_topics = rng.dirichlet(np.array(prior_frozen + prior_nofrozen), n_docs)
         prior_nofrozen = _rotate(prior_nofrozen, own)
 
-        # Step 3: documents — vectorized equivalent of lines 62-79.
+        # Step 3: documents — fully vectorized equivalent of lines 62-79.
+        # Per-doc topic counts in one batched multinomial, then per topic the
+        # words of ALL docs at once by inverse-CDF sampling (a multinomial is
+        # the histogram of iid categorical draws — same distribution as the
+        # reference's per-doc word loop, at O(total_words·log V) instead of
+        # O(doc·topic·V) multinomial calls).
         doc_lens = rng.integers(nwords[0], nwords[1], size=n_docs)
+        topic_counts = rng.multinomial(doc_lens, doc_topics)  # [n_docs, K]
         bow = np.zeros((n_docs, vocab_size), dtype=np.float32)
+        doc_ids_all = np.arange(n_docs)
+        for k in range(n_topics):
+            c_k = topic_counts[:, k]
+            total = int(c_k.sum())
+            if total == 0:
+                continue
+            cdf = np.cumsum(topic_vectors[k])
+            words = np.searchsorted(cdf, rng.random(total), side="right")
+            words = np.minimum(words, vocab_size - 1)  # float-rounding guard
+            np.add.at(bow, (np.repeat(doc_ids_all, c_k), words), 1.0)
         docs = []
-        for d in range(n_docs):
-            topic_counts = rng.multinomial(doc_lens[d], doc_topics[d])
-            for k in np.nonzero(topic_counts)[0]:
-                bow[d] += rng.multinomial(topic_counts[k], topic_vectors[k])
-            if materialize_docs:
-                word_ids = np.repeat(
-                    np.arange(vocab_size), bow[d].astype(np.int64)
-                )
+        if materialize_docs:
+            word_range = np.arange(vocab_size)
+            for d in range(n_docs):
+                word_ids = np.repeat(word_range, bow[d].astype(np.int64))
                 docs.append(" ".join(f"wd{w}" for w in word_ids))
         nodes.append(SyntheticNode(bow=bow, documents=docs, doc_topics=doc_topics))
 

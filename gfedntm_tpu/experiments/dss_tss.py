@@ -50,15 +50,15 @@ class SimulationConfig:
     vocab_size: int = 5000
     n_topics: int = 50
     beta: float = 0.01          # eta: topic-word Dirichlet prior
-    alpha: float = 0.02         # doc-topic Dirichlet prior (frozen part)
+    alpha: float = 0.1          # doc-topic Dirichlet prior (config.json)
     n_docs: int = 10000         # training docs per node
     n_docs_global_inf: int = 1000   # held-out inference docs per node
     n_nodes: int = 5
-    frozen_topics: int = 40
+    frozen_topics: int = 5      # config.json (eta sweep regime)
     nwords: tuple[int, int] = (150, 250)
     experiment: int = 1         # 0: sweep frozen topics; 1: sweep eta
-    frozen_topics_list: tuple[int, ...] = (10, 20, 30, 40, 48)
-    eta_list: tuple[float, ...] = (1e-4, 1e-3, 1e-2, 1e-1)
+    frozen_topics_list: tuple[int, ...] = (5, 10, 15, 20, 25, 30, 35, 40)
+    eta_list: tuple[float, ...] = (1e-2, 0.02, 0.03, 0.04, 0.08, 1.0)
     iters: int = 20
     # model hyperparameters (reference train_avitm: hidden (100,100), 100 ep)
     hidden_sizes: tuple[int, ...] = (100, 100)
@@ -125,8 +125,18 @@ def _score_model(
     topic_vectors: np.ndarray,
     inf_doc_topics: np.ndarray,
 ) -> tuple[float, float]:
-    """TSS on reprojected betas + DSS on inferred thetas for ``inf_docs``."""
+    """TSS on reprojected betas + DSS on inferred thetas for ``inf_docs``.
+
+    Deliberate reference-replication note: the reference experiment applies
+    ``softmax`` ON TOP of ``get_topic_word_distribution()`` — which is
+    already row-softmaxed (``run_simulation.py:428-429`` over
+    ``avitm.py:539-551``) — so its published TSS envelope (8.679 +/- 0.042,
+    BASELINE.md) is computed on *double-softmaxed* (near-uniform) betas.
+    The second softmax is replicated here so scores are comparable to the
+    committed reference artifacts."""
     betas = model.get_topic_word_distribution()
+    e = np.exp(betas - betas.max(axis=1, keepdims=True))
+    betas = e / e.sum(axis=1, keepdims=True)  # ref's second softmax
     betas_full = convert_topic_word_to_init_size(
         cfg.vocab_size, betas, id2token
     )
@@ -145,7 +155,13 @@ def run_iter_simulation(
     """One simulation iteration (`run_simulation.py:361-512`): generate,
     train all three arms, score. Returns
     ``{arm: {"betas": TSS, "thetas": DSS}}``."""
-    rng = np.random.default_rng(seed)
+    # Independent stream for the baseline arm: the corpus generator is
+    # seeded with ``seed`` and its FIRST draw is the ground-truth
+    # topic_vectors, so a same-seeded generator here would "randomly" draw
+    # the exact ground truth (TSS = K). The reference avoids this via the
+    # global np.random stream position; here an offset seed does it
+    # deterministically.
+    rng = np.random.default_rng(seed + 990_001)
     docs_per_node = cfg.n_docs + cfg.n_docs_global_inf
     corpus = generate_synthetic_corpus(
         vocab_size=cfg.vocab_size,
@@ -172,13 +188,28 @@ def run_iter_simulation(
 
     result: dict[str, dict[str, float]] = {}
 
-    # Baseline arm: Dirichlet-random betas/thetas (`run_simulation.py:396-400,505-512`).
+    # Baseline arm (`run_simulation.py:396-400,510-516`): betas are a fresh
+    # Dirichlet(eta) draw; thetas are a fresh ``just_inf`` draw of
+    # doc-topics from the SAME rotating node priors the corpus used
+    # (generateSynthetic(True, False, ...)) — not a flat-alpha Dirichlet.
     random_betas = rng.dirichlet(
         np.full(cfg.vocab_size, cfg.beta), cfg.n_topics
     )
-    random_thetas = rng.dirichlet(
-        np.full(cfg.n_topics, cfg.alpha), len(inf_doc_topics)
+    prior_frozen = [cfg.alpha] * cfg.frozen_topics
+    own = (cfg.n_topics - cfg.frozen_topics) // max(cfg.n_nodes, 1)
+    prior_nofrozen = [cfg.alpha] * own + [cfg.alpha / 10000.0] * (
+        cfg.n_topics - cfg.frozen_topics - own
     )
+    thetas_bas = []
+    for _node in range(cfg.n_nodes):
+        thetas_bas.append(
+            rng.dirichlet(
+                np.array(prior_frozen + prior_nofrozen),
+                cfg.n_docs_global_inf,
+            )
+        )
+        prior_nofrozen = prior_nofrozen[own:] + prior_nofrozen[:own]
+    random_thetas = np.concatenate(thetas_bas)
     result["baseline"] = {
         "betas": topic_similarity_score(random_betas, topic_vectors),
         "thetas": document_similarity_score(random_thetas, inf_doc_topics),
