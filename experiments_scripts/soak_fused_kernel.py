@@ -29,15 +29,25 @@ def main() -> None:
 
     backend = jax.default_backend()
     table = bench_fused_largev(backend, v_list=(16384, 50_000, 100_000))
-    wins = [
-        int(k[1:]) for k, row in table.items()
-        if row["parity"] and row["fused_ms"] < row["unfused_ms"]
+
+    def _parse(key: str) -> tuple[int, int]:
+        v, b = key[1:].split("_B")
+        return int(v), int(b)
+
+    # The auto threshold keys off V alone (models/avitm.py:_resolve_fused),
+    # so derive it from the reference's production batch size (64,
+    # dft_params.cf:16): smallest tested V where the fused path wins there.
+    wins_b64 = [
+        _parse(k)[0] for k, row in table.items()
+        if _parse(k)[1] == 64 and row["parity"]
+        and row["fused_ms"] < row["unfused_ms"]
     ]
     report = {
         "backend": backend,
         "table": table,
         "all_parity": all(r["parity"] for r in table.values()),
-        "recommended_threshold": min(wins) if wins else None,
+        "recommended_threshold": min(wins_b64) if wins_b64 else None,
+        "threshold_rule": "min V with fused win at B=64 (reference batch)",
     }
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     with open(out_path, "w") as f:

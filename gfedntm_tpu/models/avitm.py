@@ -168,16 +168,44 @@ class AVITM:
             except RuntimeError:
                 backend = "unavailable"
             # Threshold picks the regime where the [B, V] intermediates
-            # dominate loss bandwidth; conservative until the compiled
-            # (non-interpret) kernel has soaked on hardware more widely.
-            # "axon" is a TPU chip behind a tunnel plugin (platform name
-            # differs, hardware does not).
-            return (
+            # dominate loss bandwidth; set from the round-3 on-chip soak
+            # (results/fused_kernel_soak.json). "axon" is a TPU chip behind
+            # a tunnel plugin (platform name differs, hardware does not).
+            if not (
                 backend in ("tpu", "axon")
                 and self.model_type.lower() == "prodlda"
                 and self.input_size >= 16384
-            )
+            ):
+                return False
+            # Fail-safe: never enable a kernel this process cannot compile
+            # (one cached probe per backend; see ops.fused_decoder).
+            from gfedntm_tpu.ops.fused_decoder import kernel_health
+
+            ok, err = kernel_health(backend)
+            if not ok:
+                self.logger.warning(
+                    "Pallas fused decoder unavailable on backend %r (%s); "
+                    "using the unfused XLA loss.", backend, err,
+                )
+            return ok
         return bool(fused)
+
+    def _disable_fused(self, err: Exception) -> None:
+        """Rebuild the module and epoch programs with the fused Pallas
+        decoder off after a compile failure (fail-safe for `"auto"`)."""
+        self.logger.warning(
+            "Fused Pallas decoder failed at compile/run time (%r); "
+            "falling back to the unfused XLA loss.", err,
+        )
+        self.fused_decoder = False
+        self.module = self._build_module()
+        self._train_epoch_fn = build_train_epoch(
+            self.module, self.tx, self.family, self._beta_weight()
+        )
+        self._eval_epoch_fn = build_eval_epoch(
+            self.module, self.family, self._beta_weight()
+        )
+        self._infer_fns = {}
 
     def _build_module(self) -> DecoderNetwork:
         return DecoderNetwork(
@@ -261,13 +289,30 @@ class AVITM:
         for epoch in range(self.num_epochs):
             self.nn_epoch = epoch
             sched = make_epoch_schedule(n_train, self.batch_size, self._np_rng)
-            self.params, self.batch_stats, self.opt_state, losses = (
-                self._train_epoch_fn(
-                    self.params, self.batch_stats, self.opt_state, data,
-                    jnp.asarray(sched.indices), jnp.asarray(sched.mask),
-                    self._next_rng(),
-                )
+            epoch_args = (
+                data, jnp.asarray(sched.indices), jnp.asarray(sched.mask),
+                self._next_rng(),
             )
+            try:
+                self.params, self.batch_stats, self.opt_state, losses = (
+                    self._train_epoch_fn(
+                        self.params, self.batch_stats, self.opt_state,
+                        *epoch_args,
+                    )
+                )
+            except Exception as err:
+                # The fused Pallas path must never crash a run the unfused
+                # XLA loss could complete (compile errors surface here, at
+                # the first traced execution). Anything else re-raises.
+                if not getattr(self.module, "fused_decoder", False):
+                    raise
+                self._disable_fused(err)
+                self.params, self.batch_stats, self.opt_state, losses = (
+                    self._train_epoch_fn(
+                        self.params, self.batch_stats, self.opt_state,
+                        *epoch_args,
+                    )
+                )
             train_loss = float(jnp.sum(losses)) / n_train
             self.epoch_losses.append(train_loss)
             self.best_components = np.asarray(self.params["beta"])

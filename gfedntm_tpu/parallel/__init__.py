@@ -1,2 +1,18 @@
 from gfedntm_tpu.parallel import mesh as mesh
 from gfedntm_tpu.parallel.mesh import make_client_mesh, stack_and_pad
+from gfedntm_tpu.parallel.sharded import (
+    fit_sharded,
+    make_dp_mp_mesh,
+    shard_data,
+    shard_tree,
+)
+
+__all__ = [
+    "mesh",
+    "make_client_mesh",
+    "stack_and_pad",
+    "fit_sharded",
+    "make_dp_mp_mesh",
+    "shard_data",
+    "shard_tree",
+]

@@ -34,13 +34,24 @@ def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
 
 
 def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
-                      mask, rngs):
+                      mask, rngs, vshard=None):
     """Training loss via the Pallas fused decode+reconstruction kernel
     (ops/fused_decoder.py): the [B, V] word distribution never exists; the
     decoder BatchNorm's running stats are updated here from the kernel's
     batch statistics with MaskedBatchNorm's torch semantics (momentum 0.1,
-    unbiased running variance)."""
-    from gfedntm_tpu.ops.fused_decoder import prodlda_recon_loss
+    unbiased running variance).
+
+    ``vshard=(mesh, data_axis_or_None, model_axis)`` composes the kernel
+    with a GSPMD-sharded model (VERDICT r2 task 5): the loss runs inside a
+    *nested* ``shard_map`` over the mesh, each device streaming its local V
+    shard through the kernel, with only [B, 1]-sized online-softmax merges
+    crossing the model axis (see ``prodlda_recon_loss_vsharded``). The
+    encoder stays on the plain GSPMD path outside the shard_map — XLA
+    already inserts its V-axis collectives."""
+    from gfedntm_tpu.ops.fused_decoder import (
+        prodlda_recon_loss,
+        prodlda_recon_loss_vsharded,
+    )
 
     out, mutated = module.apply(
         {"params": params, "batch_stats": batch_stats},
@@ -55,10 +66,37 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
     )
     m = mask.astype(jnp.float32)
     bn = batch_stats["beta_batchnorm"]
-    rl, b_mean, b_var = prodlda_recon_loss(
-        out.theta, params["beta"], batch["x_bow"],
-        bn["running_mean"], bn["running_var"], m, True,
-    )
+    if vshard is None:
+        rl, b_mean, b_var = prodlda_recon_loss(
+            out.theta, params["beta"], batch["x_bow"],
+            bn["running_mean"], bn["running_var"], m, True,
+        )
+    else:
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        mesh, data_axis, model_axis = vshard
+        rl, b_mean, b_var = jax.shard_map(
+            partial(
+                prodlda_recon_loss_vsharded,
+                model_axis=model_axis, data_axis=data_axis, training=True,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(data_axis, None),           # theta [B, K]
+                P(None, model_axis),          # beta [K, V]
+                P(data_axis, model_axis),     # x_bow [B, V]
+                P(model_axis),                # running mean [V]
+                P(model_axis),                # running var [V]
+                P(data_axis),                 # mask [B]
+            ),
+            out_specs=(P(data_axis), P(model_axis), P(model_axis)),
+            check_vma=False,
+        )(
+            out.theta, params["beta"], batch["x_bow"],
+            bn["running_mean"], bn["running_var"], m,
+        )
     kl = gaussian_kl(
         out.prior_mean, out.prior_variance, out.posterior_mean,
         out.posterior_variance, out.posterior_log_variance,
@@ -89,7 +127,7 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
 
 
 def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
-                rngs, train: bool):
+                rngs, train: bool, vshard=None):
     """Forward + reference loss on one (padded, masked) batch."""
     if (
         train
@@ -98,7 +136,7 @@ def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
     ):
         return _fused_batch_loss(
             module, family, beta_weight, params, batch_stats, batch, mask,
-            rngs,
+            rngs, vshard=vshard,
         )
     out, mutated = module.apply(
         {"params": params, "batch_stats": batch_stats},
@@ -144,7 +182,7 @@ def _batch_loss(module, family, beta_weight, params, batch_stats, batch, mask,
 
 
 def grad_step(module, tx, family, beta_weight, params, batch_stats, opt_state,
-              batch, mask, rngs):
+              batch, mask, rngs, vshard=None):
     """One forward/backward/optimizer update — the single implementation of
     the training-step semantics shared by the epoch scan, the one-minibatch
     federation step, and the SPMD federated program."""
@@ -152,7 +190,7 @@ def grad_step(module, tx, family, beta_weight, params, batch_stats, opt_state,
     def loss_fn(p):
         return _batch_loss(
             module, family, beta_weight, p, batch_stats, batch, mask, rngs,
-            train=True,
+            train=True, vshard=vshard,
         )
 
     (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -166,6 +204,7 @@ def build_train_epoch(
     tx: optax.GradientTransformation,
     family: str = "avitm",
     beta_weight: float = 1.0,
+    vshard=None,
 ):
     """Returns jitted ``(params, batch_stats, opt_state, data, indices, masks,
     rng) -> (params, batch_stats, opt_state, losses[S])``.
@@ -187,7 +226,7 @@ def build_train_epoch(
             batch = _gather_batch(data, idx)
             new_params, new_bs, new_opt, loss = grad_step(
                 module, tx, family, beta_weight, params, batch_stats,
-                opt_state, batch, mask, rngs,
+                opt_state, batch, mask, rngs, vshard=vshard,
             )
             return (new_params, new_bs, new_opt), loss
 

