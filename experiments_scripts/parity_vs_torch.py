@@ -1,0 +1,189 @@
+"""Head-to-head quality + wall-clock parity vs the reference implementation.
+
+VERDICT r2 task 4 (the BASELINE.json north star minus the unavailable GPU):
+train on the SAME corpus with the SAME vectorization —
+
+- ``torch_centralized``: the reference's own PyTorch AVITM
+  (`/root/reference/src/models/base/pytorchavitm/avitm_network/avitm.py`,
+  imported and run, not copied), CPU (torch has no TPU path);
+- ``tpu_centralized``: this framework's AVITM, same hyperparameters;
+- ``tpu_federated``: this framework's 5-client federated run, clients
+  partitioned by ``fieldsOfStudy`` (the docker-compose regime,
+  `/root/reference/docker-compose.yaml:21-157`).
+
+Corpus: the reference's in-repo ``s2cs_tiny.parquet`` (334 Semantic Scholar
+CS abstracts, 5 FOS categories — the runnable stand-in it ships for the full
+S2 corpus). Both centralized arms consume the *identical* BoW matrix and
+vocabulary from this framework's ``prepare_dataset`` (25%/seed-42 split,
+sklearn-parity vectorizer), so every difference is the trainer, not the
+prep. All arms are scored by the same native metric implementations
+(NPMI coherence vs the pooled corpus, topic diversity, inverted RBO —
+the ``collab_vs_non_collab/train.py:22-101`` metric set).
+
+Usage: python experiments_scripts/parity_vs_torch.py [out_json]
+Writes ``results/parity_vs_torch/metrics.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_ROOT = "/root/reference"
+sys.path.insert(0, REPO_ROOT)
+
+PARQUET = "/root/reference/static/datasets/s2cs_tiny.parquet"
+TOPN_NPMI = 10
+
+
+def load_pooled_corpus():
+    import pandas as pd
+
+    df = pd.read_parquet(PARQUET, columns=["lemmas", "fieldsOfStudy"])
+    df = df.dropna(subset=["lemmas"])
+    return list(df["lemmas"]), df
+
+
+def score(topics, corpus_tokens):
+    from gfedntm_tpu.eval.metrics import (
+        inverted_rbo,
+        npmi_coherence,
+        topic_diversity,
+    )
+
+    return {
+        "npmi": round(npmi_coherence(topics, corpus_tokens, topn=TOPN_NPMI), 4),
+        "topic_diversity": round(topic_diversity(topics), 4),
+        "inverted_rbo": round(inverted_rbo(topics), 4),
+    }
+
+
+def run_torch_arm(train_data, val_data, id2token, k, epochs):
+    sys.path.insert(0, REFERENCE_ROOT)
+    import numpy as np
+
+    if not hasattr(np, "Inf"):  # reference targets numpy<2
+        np.Inf = np.inf
+    from src.models.base.pytorchavitm.avitm_network.avitm import AVITM as TorchAVITM
+    from src.models.base.pytorchavitm.datasets.bow_dataset import BOWDataset
+
+    t_train = BOWDataset(np.asarray(train_data.X, np.float32), id2token)
+    t_val = BOWDataset(np.asarray(val_data.X, np.float32), id2token)
+    model = TorchAVITM(
+        logger=logging.getLogger("torch_arm"), input_size=t_train.X.shape[1],
+        n_components=k, model_type="prodLDA", hidden_sizes=(50, 50),
+        activation="softplus", dropout=0.2, learn_priors=True, batch_size=64,
+        lr=2e-3, momentum=0.99, solver="adam", num_epochs=epochs,
+        reduce_on_plateau=False, topic_prior_mean=0.0,
+        topic_prior_variance=None, num_samples=20,
+        num_data_loader_workers=0, verbose=False,
+    )
+    t0 = time.perf_counter()
+    model.fit(t_train, t_val)
+    wall = time.perf_counter() - t0
+    topics = [list(t) for t in model.get_topics(TOPN_NPMI)]
+    best = getattr(model, "best_loss_train", None)
+    return topics, wall, (float(best) if best is not None else None)
+
+
+def run_tpu_centralized_arm(train_data, val_data, k, epochs):
+    from gfedntm_tpu.models.avitm import AVITM
+
+    model = AVITM(
+        input_size=train_data.X.shape[1], n_components=k,
+        hidden_sizes=(50, 50), batch_size=64, num_epochs=epochs, lr=2e-3,
+        momentum=0.99, seed=0, verbose=False,
+    )
+    t0 = time.perf_counter()
+    model.fit(train_data, val_data)
+    wall = time.perf_counter() - t0
+    return model.get_topics(TOPN_NPMI), wall, float(min(model.epoch_losses))
+
+
+def run_tpu_federated_arm(k, epochs_scale):
+    from gfedntm_tpu.presets import noniid_fos_5client
+
+    t0 = time.perf_counter()
+    res = noniid_fos_5client(
+        scale=epochs_scale, n_components=k, compute_metrics=False,
+    )
+    wall = time.perf_counter() - t0
+    global_model = res.trainer.make_global_model(res.result)
+    global_model.train_data = res.extras["consensus"].datasets[0]
+    return global_model.get_topics(TOPN_NPMI), wall, res
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1
+        else os.path.join(REPO_ROOT, "results/parity_vs_torch/metrics.json")
+    )
+    logging.basicConfig(level=logging.WARNING)
+
+    from gfedntm_tpu.data.preparation import prepare_dataset
+
+    import jax
+
+    docs, _ = load_pooled_corpus()
+    corpus_tokens = [d.split() for d in docs]
+    train_data, val_data, input_size, id2token, _, _ = prepare_dataset(docs)
+
+    epochs = 100  # reference default (dft_params.cf / train_avitm)
+    report = {
+        "corpus": {
+            "path": PARQUET,
+            "n_docs": len(docs),
+            "vocab": input_size,
+            "prep": "shared prepare_dataset (25%/seed-42 split); both "
+                    "centralized arms consume the identical BoW matrix",
+        },
+        "backend": jax.default_backend(),
+        "epochs": epochs,
+        "arms": {},
+    }
+    for k in (10, 50):
+        topics_t, wall_t, loss_t = run_torch_arm(
+            train_data, val_data, id2token, k, epochs
+        )
+        arm_t = {
+            "wall_s": round(wall_t, 2),
+            "best_train_loss": round(loss_t, 2) if loss_t else None,
+            "device": "cpu-1core", **score(topics_t, corpus_tokens),
+        }
+
+        topics_j, wall_j, loss_j = run_tpu_centralized_arm(
+            train_data, val_data, k, epochs
+        )
+        arm_j = {
+            "wall_s": round(wall_j, 2), "best_train_loss": round(loss_j, 2),
+            "device": report["backend"], **score(topics_j, corpus_tokens),
+        }
+
+        topics_f, wall_f, _ = run_tpu_federated_arm(k, 1.0)
+        arm_f = {
+            "wall_s": round(wall_f, 2),
+            "device": report["backend"],
+            "note": "5 clients partitioned by fieldsOfStudy; wall includes "
+                    "consensus + staging + compile",
+            **score(topics_f, corpus_tokens),
+        }
+
+        report["arms"][f"k{k}"] = {
+            "torch_centralized": arm_t,
+            "tpu_centralized": arm_j,
+            "tpu_federated": arm_f,
+            "wall_speedup_tpu_vs_torch": round(wall_t / max(wall_j, 1e-9), 2),
+        }
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,8 +26,11 @@ sys.path.insert(
 
 
 def main() -> None:
-    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 5
     out_dir = sys.argv[2] if len(sys.argv) > 2 else "results/dss_tss_eta001"
+    frozen_dir = (
+        sys.argv[3] if len(sys.argv) > 3 else "results/dss_tss_frozen40"
+    )
 
     import jax
 
@@ -48,15 +51,30 @@ def main() -> None:
         f"backend={jax.default_backend()} iters={iters} "
         f"elapsed={elapsed:.0f}s\n"
         f"centralized TSS {cols['centralized_betas_mean'][0]:.3f} "
-        f"(ref 8.679+/-0.042)\n"
+        f"+/- {cols['centralized_betas_std'][0]:.3f} (ref 8.679+/-0.042)\n"
         f"non-collab  TSS {cols['non_colab_betas_mean'][0]:.3f} "
-        f"(ref 7.571+/-0.048)\n"
+        f"+/- {cols['non_colab_betas_std'][0]:.3f} (ref 7.571+/-0.048)\n"
         f"random      TSS {cols['baseline_betas_mean'][0]:.3f} "
-        f"(ref 3.564+/-0.098)\n"
+        f"+/- {cols['baseline_betas_std'][0]:.3f} (ref 3.564+/-0.098)\n"
         f"centralized DSS {cols['centralized_thetas_mean'][0]:.1f} "
         f"(ref 2555.5)\n"
         f"non-collab  DSS {cols['non_colab_thetas_mean'][0]:.1f} "
         f"(ref 3066.7)"
+    )
+
+    # One frozen-sweep point (the reference's committed frozen_variable
+    # regime at 40 frozen topics: centralized TSS 8.664 +/- 0.037 vs
+    # non-collab 8.475 +/- 0.046, results/frozen_variable/results.pickle).
+    fcfg = SimulationConfig(
+        experiment=0, frozen_topics_list=(40,), iters=iters, seed=0,
+    )
+    fout = run_simulation(fcfg, results_dir=frozen_dir)
+    fcols = fout["columns"]
+    print(
+        f"frozen=40 centralized TSS {fcols['centralized_betas_mean'][0]:.3f} "
+        f"+/- {fcols['centralized_betas_std'][0]:.3f} (ref 8.664+/-0.037)\n"
+        f"frozen=40 non-collab  TSS {fcols['non_colab_betas_mean'][0]:.3f} "
+        f"+/- {fcols['non_colab_betas_std'][0]:.3f} (ref 8.475+/-0.046)"
     )
 
 

@@ -1,0 +1,129 @@
+"""Measured compute baseline: the reference's own PyTorch AVITM.
+
+VERDICT r2 task 2: the round-1/2 bench compared only against the
+reference's >=3 s-sleep orchestration floor (21.3 docs/s for 5 clients) —
+"beating a sleep is not matching-or-beating on perf". This script runs the
+reference implementation itself (`/root/reference/src/models/base/
+pytorchavitm/avitm_network/avitm.py:323-443`, imported, not copied) on the
+*same* synthetic regime as `bench.py` and records measured docs/s, so
+`vs_torch_cpu` in the bench is a ratio of two measurements on this host.
+
+Regime match (bench.py `run()`): V=5000, K=50, hidden (50,50), batch 64,
+Adam(lr 2e-3, beta1=0.99), 5x2000 docs trained centrally (the reference's
+federated path adds the gRPC/sleep orchestration on top of exactly this
+compute, so centralized torch is its compute-only best case).
+
+Timing is `_train_epoch` only — the same boundary the bench's steady-state
+fit measures (no MC doc-topic inference pass on either side).
+
+Usage: python experiments_scripts/torch_baseline.py [out_json] [epochs]
+Writes ``results/torch_baseline.json`` (default).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def run_torch_baseline(epochs: int = 3, out_path: str | None = None) -> dict:
+    sys.path.insert(0, REFERENCE_ROOT)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+    import torch
+    from torch.utils.data import DataLoader
+
+    # The reference targets numpy<2 (`np.Inf` in pytorchtools.py:26); shim
+    # the removed alias so the unmodified reference runs under numpy 2.
+    if not hasattr(np, "Inf"):
+        np.Inf = np.inf
+
+    from src.models.base.pytorchavitm.avitm_network.avitm import AVITM
+    from src.models.base.pytorchavitm.datasets.bow_dataset import BOWDataset
+
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+
+    n_clients, vocab, k, batch = 5, 5000, 50, 64
+    docs_per_node = 2000
+    corpus = generate_synthetic_corpus(
+        vocab_size=vocab, n_topics=k, n_docs=docs_per_node,
+        nwords=(150, 250), n_nodes=n_clients, frozen_topics=5, seed=0,
+        materialize_docs=False,
+    )
+    X = np.concatenate([node.bow for node in corpus.nodes]).astype(np.float32)
+    idx2token = {i: f"wd{i}" for i in range(vocab)}
+    dataset = BOWDataset(X, idx2token)
+
+    logger = logging.getLogger("torch_baseline")
+    model = AVITM(
+        logger=logger, input_size=vocab, n_components=k,
+        model_type="prodLDA", hidden_sizes=(50, 50), activation="softplus",
+        dropout=0.2, learn_priors=True, batch_size=batch, lr=2e-3,
+        momentum=0.99, solver="adam", num_epochs=epochs,
+        reduce_on_plateau=False, topic_prior_mean=0.0,
+        topic_prior_variance=None, num_samples=20,
+        num_data_loader_workers=0, verbose=False,
+    )
+    # fit()'s own loader config (avitm.py:371-375) minus the worker pool —
+    # on this 1-core host mp.cpu_count() workers only add IPC overhead.
+    loader = DataLoader(dataset, batch_size=batch, shuffle=True,
+                        num_workers=0)
+
+    # Warm epoch (allocator, thread pools), then timed epochs.
+    model._train_epoch(loader)
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        sp, loss = model._train_epoch(loader)
+        losses.append(float(loss))
+    elapsed = time.perf_counter() - t0
+
+    docs = epochs * X.shape[0]
+    report = {
+        "impl": "reference torch AVITM (imported from /root/reference)",
+        "source": "src/models/base/pytorchavitm/avitm_network/avitm.py:323-443",
+        "docs_per_s": round(docs / elapsed, 1),
+        "epoch_s": round(elapsed / epochs, 2),
+        "step_ms": round(elapsed / (epochs * np.ceil(X.shape[0] / batch)) * 1e3, 2),
+        "epochs_timed": epochs,
+        "final_train_loss": losses[-1],
+        "device": "cpu",
+        "torch_version": torch.__version__,
+        "torch_threads": torch.get_num_threads(),
+        "host_cores": len(os.sched_getaffinity(0)),
+        "regime": {
+            "n_docs": int(X.shape[0]), "vocab": vocab, "k": k,
+            "batch": batch, "hidden": [50, 50], "lr": 2e-3,
+            "beta1": 0.99,
+        },
+        "note": (
+            "centralized fit = the reference's compute-only best case; its "
+            "federated loop adds >=3 s/client/step orchestration on top "
+            "(server.py:417-420,472)"
+        ),
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1 else "results/torch_baseline.json"
+    )
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    report = run_torch_baseline(epochs=epochs, out_path=out_path)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,7 @@ class FederatedTrainer:
             self.grads_to_share,
         )
         self._programs: dict[float, Any] = {}
+        self._staged: tuple[list, dict] | None = None
 
     def _get_program(self, total_weight: float):
         # Keyed by total_weight only (the one value baked into the program);
@@ -211,6 +212,64 @@ class FederatedTrainer:
                 family=t.family, beta_weight=t._beta_weight(),
             )
         return self._programs[total_weight]
+
+    def _stage_data(self, datasets: list[BowDataset], metrics=None) -> dict:
+        """Stack, pad, and transfer the client corpora to device — cached
+        across ``fit`` calls on the same dataset objects.
+
+        Staging is the expensive host phase (numpy-stacking C_pad corpora +
+        one large host->device transfer); for the bench regime it is ~50x
+        the cost of the compiled training program itself, so repeated fits
+        must not pay it twice. The cache keys on dataset identity + shape;
+        callers that mutate a dataset's arrays in place between fits should
+        pass a fresh ``BowDataset`` (or clear ``_staged``) to restage.
+        """
+        t = self.template
+        # Identity-keyed cache: the cached entry holds strong references to
+        # the dataset objects themselves, so a dead dataset's id can never
+        # be recycled by a new same-shape dataset while the cache lives
+        # (`is`-comparison, not bare id()).
+        if self._staged is not None:
+            cached_datasets, cached_data = self._staged
+            same_objects = len(cached_datasets) == len(datasets) and all(
+                a is b for a, b in zip(cached_datasets, datasets)
+            )
+            # Re-derive the staged x_bow shape from the LIVE datasets: a
+            # caller that reassigned `d.X` on a cached dataset object (e.g.
+            # a re-vectorized corpus) must restage, not train on stale
+            # device arrays through clamped gather indices.
+            if same_objects:
+                expect = (
+                    self.c_pad,
+                    max(int(np.shape(d.X)[0]) for d in datasets),
+                    int(np.shape(datasets[0].X)[1]),
+                )
+                if tuple(cached_data["x_bow"].shape) == expect:
+                    return cached_data
+        from gfedntm_tpu.utils.observability import phase_timer
+
+        with phase_timer(metrics, "stage_data"):
+            data_arrays = {
+                "x_bow": [np.asarray(d.X, np.float32) for d in datasets]
+            }
+            if getattr(datasets[0], "X_ctx", None) is not None:
+                data_arrays["x_ctx"] = [
+                    np.asarray(d.X_ctx, np.float32) for d in datasets
+                ]
+            if (
+                getattr(datasets[0], "labels", None) is not None
+                and t._label_size() > 0
+            ):
+                data_arrays["labels"] = [
+                    np.asarray(d.labels, np.float32) for d in datasets
+                ]
+            data = {
+                k: jnp.asarray(stack_and_pad(v, self.c_pad))
+                for k, v in data_arrays.items()
+            }
+            jax.block_until_ready(data)
+        self._staged = (list(datasets), data)
+        return data
 
     def fit(
         self,
@@ -233,33 +292,28 @@ class FederatedTrainer:
         total_steps = int(min(steps_per_epoch.max() * t.num_epochs, self.max_iters))
 
         # Per-client schedules (independent epoch cycling).
-        idx_list, mask_list = [], []
-        for c, d in enumerate(datasets):
-            sched = make_run_schedule(
-                len(d), B, total_steps, seed=self.seed * 1000 + c
-            )
-            idx_list.append(sched.indices)
-            mask_list.append(sched.mask)
-        # pad to C_pad with zero-weight no-op clients
-        for _ in range(self.c_pad - C):
-            idx_list.append(np.zeros_like(idx_list[0]))
-            mask_list.append(np.zeros_like(mask_list[0]))
-        indices = np.stack(idx_list, axis=1)  # [S, C_pad, B]
-        masks = np.stack(mask_list, axis=1)
+        from gfedntm_tpu.utils.observability import phase_timer
+
+        with phase_timer(metrics, "build_schedules"):
+            idx_list, mask_list = [], []
+            for c, d in enumerate(datasets):
+                sched = make_run_schedule(
+                    len(d), B, total_steps, seed=self.seed * 1000 + c
+                )
+                idx_list.append(sched.indices)
+                mask_list.append(sched.mask)
+            # pad to C_pad with zero-weight no-op clients
+            for _ in range(self.c_pad - C):
+                idx_list.append(np.zeros_like(idx_list[0]))
+                mask_list.append(np.zeros_like(mask_list[0]))
+            indices = np.stack(idx_list, axis=1)  # [S, C_pad, B]
+            masks = np.stack(mask_list, axis=1)
 
         weights = np.zeros(self.c_pad, np.float32)
         weights[:C] = n_samples
         client_ids = np.arange(self.c_pad, dtype=np.int32)
 
-        data_arrays = {"x_bow": [np.asarray(d.X, np.float32) for d in datasets]}
-        if hasattr(datasets[0], "X_ctx") and getattr(datasets[0], "X_ctx", None) is not None:
-            data_arrays["x_ctx"] = [np.asarray(d.X_ctx, np.float32) for d in datasets]
-        if getattr(datasets[0], "labels", None) is not None and t._label_size() > 0:
-            data_arrays["labels"] = [np.asarray(d.labels, np.float32) for d in datasets]
-        data = {
-            k: jnp.asarray(stack_and_pad(v, self.c_pad))
-            for k, v in data_arrays.items()
-        }
+        data = self._stage_data(datasets, metrics)
 
         # Identical init for every client (server.py:303-311 semantics).
         params = _broadcast_client_axis(t.params, self.c_pad)
@@ -312,14 +366,15 @@ class FederatedTrainer:
             run = self._get_program(total_weight)
             # RNG folding is per absolute step (scan xs carries step indices),
             # so resumed runs reproduce the unresumed ones exactly.
-            params, batch_stats, opt_state, seg_losses = run(
-                params, batch_stats, opt_state, data, weights_j, ids_j,
-                jnp.asarray(indices[step:step + n]),
-                jnp.asarray(masks[step:step + n]),
-                jnp.arange(step, step + n),
-                rng,
-            )
-            loss_chunks.append(np.asarray(seg_losses))
+            with phase_timer(metrics, "program_segment", steps=n):
+                params, batch_stats, opt_state, seg_losses = run(
+                    params, batch_stats, opt_state, data, weights_j, ids_j,
+                    jnp.asarray(indices[step:step + n]),
+                    jnp.asarray(masks[step:step + n]),
+                    jnp.arange(step, step + n),
+                    rng,
+                )
+                loss_chunks.append(np.asarray(seg_losses))
             step += n
             if metrics is not None:
                 metrics.log(
