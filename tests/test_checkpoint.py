@@ -35,6 +35,7 @@ def _template(V=32):
     )
 
 
+@pytest.mark.slow
 def test_federated_resume_bitwise(tmp_path):
     datasets = _datasets()
 
@@ -95,6 +96,7 @@ def test_reduce_on_plateau_semantics():
     assert sched.step(9.5) == 0.5  # counter reset
 
 
+@pytest.mark.slow
 def test_injected_lr_is_mutable_and_used():
     model = AVITM(
         input_size=16, n_components=3, hidden_sizes=(8,), batch_size=8,

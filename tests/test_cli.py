@@ -53,6 +53,7 @@ def test_load_config_cli_overrides():
     assert cfg.train.batch_size == 16
 
 
+@pytest.mark.slow
 def test_simulate_end_to_end(tiny_archive, tmp_path, capsys):
     rc = main([
         "--source", tiny_archive,

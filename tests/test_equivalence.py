@@ -26,6 +26,7 @@ Two levels of equivalence are pinned:
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from flax.traverse_util import flatten_dict
 
 from gfedntm_tpu.data.datasets import BowDataset, make_run_schedule
@@ -59,6 +60,7 @@ def _make_model(vocab, epochs, seed=0):
     )
 
 
+@pytest.mark.slow
 def test_one_client_federation_equals_centralized_loop():
     """The SPMD program at C=1 ≡ sequential grad_step with the same
     schedule + RNG stream: per-step losses and final params match."""
@@ -110,6 +112,7 @@ def test_one_client_federation_equals_centralized_loop():
         )
 
 
+@pytest.mark.slow
 def test_one_client_federation_tracks_avitm_fit():
     """Documented-divergence check vs AVITM.fit: same data/init/steps,
     different RNG streams (see module docstring) — trajectories agree in

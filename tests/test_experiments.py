@@ -52,6 +52,7 @@ def synthetic_docs(n_docs=30, vocab=80, seed=0):
     return corpus.nodes[0].documents
 
 
+@pytest.mark.slow
 class TestDssTssSimulation:
     def test_run_iter_has_all_arms_and_finite_scores(self):
         res = run_iter_simulation(tiny_sim_config(), seed=0)
@@ -101,6 +102,7 @@ class TestDssTssSimulation:
 
 
 class TestTMWrapper:
+    @pytest.mark.slow
     def test_train_and_evaluate_avitm(self, tmp_path):
         docs = synthetic_docs()
         wrapper = TMWrapper(tmp_path)
@@ -118,6 +120,7 @@ class TestTMWrapper:
         assert -1.0 <= metrics["npmi"] <= 1.0
         assert 0.0 <= metrics["inverted_rbo"] <= 1.0
 
+    @pytest.mark.slow
     def test_existing_model_dir_backed_up(self, tmp_path):
         docs = synthetic_docs(n_docs=20)
         wrapper = TMWrapper(tmp_path)
@@ -132,6 +135,7 @@ class TestTMWrapper:
         with pytest.raises(ValueError, match="embeddings"):
             wrapper.train_model("ctm", ["a b c"] * 8, model_type="zeroshot")
 
+    @pytest.mark.slow
     def test_train_zeroshot_ctm(self, tmp_path):
         docs = synthetic_docs(n_docs=24)
         emb = np.random.default_rng(0).normal(
@@ -147,6 +151,7 @@ class TestTMWrapper:
         assert len(model.get_topics(5)) == 3
 
 
+@pytest.mark.slow
 class TestCollabExperiment:
     def test_runs_both_arms_and_saves(self, tmp_path):
         partitions = {

@@ -34,6 +34,7 @@ def _template(num_epochs=2, dropout=0.2, batch_size=16, seed=0):
     )
 
 
+@pytest.mark.slow
 def test_share_all_makes_params_identical_across_clients():
     dsets, _ = _datasets(3)
     ft = FederatedTrainer(_template(), n_clients=3)
@@ -45,6 +46,7 @@ def test_share_all_makes_params_identical_across_clients():
                 np.testing.assert_allclose(arr[0], arr[c], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_share_minimal_keeps_encoders_local():
     dsets, _ = _datasets(2)
     ft = FederatedTrainer(_template(), n_clients=2, grads_to_share=SHARE_MINIMAL)
@@ -55,6 +57,7 @@ def test_share_minimal_keeps_encoders_local():
     assert not np.allclose(enc[0], enc[1]), "encoders must stay client-local"
 
 
+@pytest.mark.slow
 def test_federated_run_is_deterministic():
     dsets, _ = _datasets(2)
     r1 = FederatedTrainer(_template(), n_clients=2, seed=5).fit(dsets)
@@ -74,6 +77,7 @@ def test_losses_decrease_over_epochs():
         assert per_client[-1] < per_client[0]
 
 
+@pytest.mark.slow
 def test_one_step_exchange_matches_manual_average():
     """The psum-weighted exchange must equal a hand-computed weighted average
     of independently-stepped clients (server.py:476-487 semantics)."""
@@ -134,6 +138,7 @@ def test_unequal_client_sizes_cycle_epochs():
     assert len(res.epoch_losses[1]) == 8  # small client cycled 8 epochs
 
 
+@pytest.mark.slow
 def test_more_clients_than_devices_pads_and_runs():
     dsets, _ = _datasets(3, n_docs=20)
     # force a 2-device mesh with 3 clients -> c_pad = 4

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 import torch
 
 from gfedntm_tpu.models.layers import MaskedBatchNorm, TorchDense
@@ -108,6 +109,7 @@ def test_torch_dense_matches_torch_linear(rng):
     np.testing.assert_allclose(np.asarray(y), t_out, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 class TestBfloat16Compute:
     """compute_dtype='bfloat16' runs the matmuls in bf16 with f32 params and
     BatchNorm stats — must train finite and land near the f32 trajectory."""

@@ -13,6 +13,7 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 import torch
 from torch import nn
@@ -130,6 +131,7 @@ def test_forward_and_loss_parity(rng):
     np.testing.assert_allclose(float(loss_f), float(loss_t), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_adam_step_parity(rng):
     tm, fm, variables = make_models()
     x = rng.integers(0, 4, size=(12, V)).astype(np.float32)

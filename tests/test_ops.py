@@ -143,6 +143,7 @@ def test_jit_compatible():
     np.testing.assert_allclose(rl, rl_r, rtol=2e-5, atol=2e-4)
 
 
+@pytest.mark.slow
 class TestFusedTrainingPath:
     """The fused kernel dropped into the real training step must reproduce
     the unfused trajectory (same rng folds, same BN running-stat updates)."""
@@ -370,6 +371,7 @@ class TestVShardedFused:
         (None, (4,), ("model",)),
         ("data", (2, 2), ("data", "model")),
     ])
+    @pytest.mark.slow
     def test_gradient_parity(self, data_axis, shape, names):
         from functools import partial
 

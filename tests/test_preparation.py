@@ -193,6 +193,7 @@ def test_vendored_wordlists_complete_and_well_formed():
     not __import__("os").path.exists(_S2CS),
     reason="reference s2cs_tiny fixture absent",
 )
+@pytest.mark.slow
 def test_preproc_pipeline_end_to_end_on_s2cs():
     """text_preproc.py-equivalent flow on the real fixture: S2CS wordlists ->
     preprocess_corpus -> vocabulary -> a short training run."""

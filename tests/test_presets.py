@@ -17,6 +17,7 @@ def test_registry_covers_the_five_baseline_configs():
     }
 
 
+@pytest.mark.slow
 def test_prodlda_1client_synthetic():
     res = presets.prodlda_1client_synthetic(scale=0.02)
     assert res.summary["n_clients"] == 1
@@ -25,6 +26,7 @@ def test_prodlda_1client_synthetic():
     assert gt.topic_vectors.shape[0] == 10
 
 
+@pytest.mark.slow
 def test_neurallda_2client_iid():
     res = presets.neurallda_2client_iid(scale=0.02)
     assert res.summary["n_clients"] == 2
@@ -33,6 +35,7 @@ def test_neurallda_2client_iid():
     assert res.trainer.template.model_type == "LDA"
 
 
+@pytest.mark.slow
 def test_combinedtm_5client():
     res = presets.combinedtm_5client(scale=0.02)
     assert res.summary["n_clients"] == 5
@@ -59,6 +62,7 @@ _HAS_S2CS = __import__("os").path.exists(presets.S2CS_TINY_PARQUET)
 
 
 @pytest.mark.skipif(not _HAS_S2CS, reason="reference s2cs_tiny fixture absent")
+@pytest.mark.slow
 def test_noniid_fos_5client_real_corpus_end_to_end():
     """The full config-5 path on the reference's real-corpus fixture:
     FOS partition -> vocabulary consensus -> SPMD federated fit ->

@@ -39,6 +39,7 @@ class TestLeafSpec:
 
 class TestFitSharded:
     @pytest.mark.parametrize("dp,mp", [(1, 1), (2, 2), (1, 4), (4, 1)])
+    @pytest.mark.slow
     def test_parity_with_unsharded_fit(self, dp, mp):
         model_ref, data = make_model_and_data()
         model_ref.fit(data)
@@ -79,6 +80,7 @@ class TestFitSharded:
         topics = model.get_topics(5)
         assert len(topics) == 4
 
+    @pytest.mark.slow
     def test_validation_early_stopping_and_checkpoint(self, tmp_path):
         """Sharded fit supports the full fit() surface: validation epochs,
         early stopping (patience exhausted on noise), checkpointing."""
@@ -118,6 +120,7 @@ class TestFitSharded:
         return model, data
 
     @pytest.mark.parametrize("combined", [False, True])
+    @pytest.mark.slow
     def test_ctm_parity_with_unsharded_fit(self, combined):
         """CTM (zeroshot + combined) shards: parity vs single-device fit."""
         model_ref, data = self._make_ctm(combined=combined)
@@ -137,6 +140,7 @@ class TestFitSharded:
             assert tuple(spec)[:2][-1] == "model" or spec == P(None, "model")
 
     @pytest.mark.parametrize("dp,mp", [(1, 4), (2, 2), (1, 8)])
+    @pytest.mark.slow
     def test_fused_composes_with_sharding(self, dp, mp):
         """VERDICT r2 task 5: a fused-decoder model on a multi-device mesh
         keeps the fused loss — it runs inside a nested shard_map streaming

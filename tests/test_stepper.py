@@ -49,6 +49,7 @@ def _make_steppers(datasets, num_epochs=2, cls=FederatedAVITM, model_fn=None):
     return steppers
 
 
+@pytest.mark.slow
 def test_two_client_protocol_runs_to_completion():
     datasets = _make_datasets()
     steppers = _make_steppers(datasets, num_epochs=2)
