@@ -23,8 +23,12 @@ from the batch statistics but still produce (finite) outputs; their loss rows
 are zeroed by the caller's ``sample_mask``.
 
 Exposed as :func:`prodlda_recon_loss` with a custom VJP so it drops into the
-training loss; gradients recompute z in plain JAX (the same rematerialization
-trade XLA makes under `jax.checkpoint`).
+training loss. The backward streams too: two more V-tile Pallas passes
+(softmax row-dot accumulation, then per-tile ``gz`` -> ``g_beta`` blocks +
+``g_theta`` accumulation) recomputing z per tile from the saved softmax
+stats — no [B, V] array reaches HBM in either direction. (The one XLA
+backward left is the rows-sharded branch of the V-sharded VJP, whose
+cross-device batch-statistic sums cannot interleave with the tile stream.)
 
 Interpret mode (`interpret=True`, the default off-TPU) runs the same kernels
 on CPU for tests.
@@ -444,15 +448,11 @@ def _pad_bwd_inputs(theta, beta, x_bow, mean, var, m_glob, l_glob):
     )
 
 
-def _pallas_rowdot(
-    theta, beta, x_bow, mean, var, m_glob, l_glob, *, eps, floor, interpret,
-):
-    """Backward pass 1 as a standalone op (the V-sharded path psums its
-    result over the model axis before pass 2). Returns the unpadded
-    [B, 1] row-dot."""
-    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = _pad_bwd_inputs(
-        theta, beta, x_bow, mean, var, m_glob, l_glob
-    )
+def _pallas_rowdot(pads, *, eps, floor, interpret):
+    """Backward pass 1 from pre-padded inputs (``_pad_bwd_inputs``); the
+    V-sharded path psums its result over the model axis before pass 2.
+    Returns the unpadded [B, 1] row-dot."""
+    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = pads
     b, k, v, b_pad, k_pad, tile_v, v_pad = geom
     n_tiles = v_pad // tile_v
     dims = jnp.array([v], jnp.int32)
@@ -479,15 +479,10 @@ def _pallas_rowdot(
     return rd[:b]
 
 
-def _pallas_grads(
-    theta, beta, x_bow, mean, var, m_glob, l_glob, rd, mask, g_rl, *,
-    training, eps, floor, interpret,
-):
-    """Backward pass 2 as a standalone op. Returns
+def _pallas_grads(pads, rd, mask, g_rl, *, training, eps, floor, interpret):
+    """Backward pass 2 from pre-padded inputs. Returns
     ``(g_theta [B, K], g_beta [K, V])`` (local shard under V-sharding)."""
-    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = _pad_bwd_inputs(
-        theta, beta, x_bow, mean, var, m_glob, l_glob
-    )
+    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = pads
     b, k, v, b_pad, k_pad, tile_v, v_pad = geom
     n_tiles = v_pad // tile_v
     dims = jnp.array([v], jnp.int32)
@@ -540,13 +535,12 @@ def _pallas_bwd(
     training, eps, floor, interpret,
 ):
     """Streaming backward: two more V-tile passes, no [B, V] HBM arrays.
-    Returns ``(g_theta [B, K], g_beta [K, V])``."""
-    rd = _pallas_rowdot(
-        theta, beta, x_bow, mean, var, m_glob, l_glob,
-        eps=eps, floor=floor, interpret=interpret,
-    )
+    Inputs are padded ONCE and shared by both passes. Returns
+    ``(g_theta [B, K], g_beta [K, V])``."""
+    pads = _pad_bwd_inputs(theta, beta, x_bow, mean, var, m_glob, l_glob)
+    rd = _pallas_rowdot(pads, eps=eps, floor=floor, interpret=interpret)
     return _pallas_grads(
-        theta, beta, x_bow, mean, var, m_glob, l_glob, rd, mask, g_rl,
+        pads, rd, mask, g_rl,
         training=training, eps=eps, floor=floor, interpret=interpret,
     )
 
@@ -808,14 +802,14 @@ def _vsharded_vjp_bwd(
     # Rows replicated across the model axis: stream the backward through
     # the same Pallas passes as the single-device VJP, with ONE [B, 1]
     # psum between them (the softmax row-dot runs over the full V axis).
-    rd_local = _pallas_rowdot(
-        theta, beta_local, x_local, mean, var, m_glob, l_glob,
-        eps=eps, floor=floor, interpret=interp,
+    pads = _pad_bwd_inputs(
+        theta, beta_local, x_local, mean, var, m_glob, l_glob
     )
+    rd_local = _pallas_rowdot(pads, eps=eps, floor=floor, interpret=interp)
     rd = jax.lax.psum(rd_local, model_axis)
     g_theta, g_beta = _pallas_grads(
-        theta, beta_local, x_local, mean, var, m_glob, l_glob, rd, mask,
-        g_rl, training=training, eps=eps, floor=floor, interpret=interp,
+        pads, rd, mask, g_rl,
+        training=training, eps=eps, floor=floor, interpret=interp,
     )
     # theta is REPLICATED along the model axis, and shard_map's transpose of
     # a replicated input SUMS the per-device cotangents — i.e. the transpose
@@ -863,13 +857,27 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
         theta = jax.random.uniform(key, (b, k))
         beta = jax.random.normal(key, (k, v))
         x = jnp.ones((b, v), jnp.float32)
-        rl, _, _ = jax.jit(
-            lambda t, bt, xx: prodlda_recon_loss(
-                t, bt, xx, jnp.zeros(v), jnp.ones(v), None, True
+
+        def probe_loss(t, bt):
+            rl, _, _ = prodlda_recon_loss(
+                t, bt, x, jnp.zeros(v), jnp.ones(v), None, True
             )
-        )(theta, beta, x)
-        ok = bool(jnp.all(jnp.isfinite(rl)))
-        result = (ok, "" if ok else "non-finite probe loss")
+            return jnp.sum(rl)
+
+        # Probe forward AND backward: the VJP lowers two additional Pallas
+        # kernels (row-dot accumulator, per-tile grads with in-kernel
+        # transposes) that the forward never exercises — a backend that
+        # lowers only the forward would otherwise crash at the first
+        # training step, the exact failure class this probe exists for.
+        loss, (gt, gb) = jax.jit(
+            jax.value_and_grad(probe_loss, argnums=(0, 1))
+        )(theta, beta)
+        ok = bool(
+            jnp.isfinite(loss)
+            and jnp.all(jnp.isfinite(gt))
+            and jnp.all(jnp.isfinite(gb))
+        )
+        result = (ok, "" if ok else "non-finite probe loss/grads")
     except Exception as err:  # Mosaic lowering, platform, tunnel — any
         result = (False, repr(err))
     _KERNEL_HEALTH[backend] = result
