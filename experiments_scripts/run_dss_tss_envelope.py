@@ -39,7 +39,10 @@ def main() -> None:
 
     from gfedntm_tpu.experiments.dss_tss import SimulationConfig, run_simulation
 
-    logging.basicConfig(level=logging.INFO)
+    # force=True: jax's import-time warning already configured the root
+    # logger at WARNING, which would silently swallow the simulation's
+    # per-arm INFO progress lines.
+    logging.basicConfig(level=logging.INFO, force=True)
     cfg = SimulationConfig(
         experiment=1, eta_list=(0.01,), iters=iters, seed=0,
     )

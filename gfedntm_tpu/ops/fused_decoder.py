@@ -23,12 +23,18 @@ from the batch statistics but still produce (finite) outputs; their loss rows
 are zeroed by the caller's ``sample_mask``.
 
 Exposed as :func:`prodlda_recon_loss` with a custom VJP so it drops into the
-training loss. The backward streams too: two more V-tile Pallas passes
-(softmax row-dot accumulation, then per-tile ``gz`` -> ``g_beta`` blocks +
-``g_theta`` accumulation) recomputing z per tile from the saved softmax
-stats — no [B, V] array reaches HBM in either direction. (The one XLA
-backward left is the rows-sharded branch of the V-sharded VJP, whose
-cross-device batch-statistic sums cannot interleave with the tile stream.)
+training loss. The backward streams too — as ONE more V-tile Pallas pass:
+the softmax-backward row reduction ``rd = sum_v x * p/(p+floor)`` is
+accumulated for free inside the forward loss pass (x and p are already in
+VMEM there), so the backward only recomputes per-tile ``gz`` from the saved
+softmax stats and emits the ``g_beta`` blocks / ``g_theta`` accumulator.
+Padded operands are built once per step and shared between the forward and
+backward through the VJP residuals — at V=100k the per-step re-padding
+copies that the earlier four-pass version paid were themselves ~40% of the
+kernel's useful HBM traffic. No [B, V] array reaches HBM in either
+direction. (The one XLA backward left is the rows-sharded branch of the
+V-sharded VJP, whose cross-device batch-statistic sums cannot interleave
+with the tile stream.)
 
 Interpret mode (`interpret=True`, the default off-TPU) runs the same kernels
 on CPU for tests.
@@ -139,7 +145,7 @@ def _stats_kernel(
 
 
 # ---------------------------------------------------------------------------
-# Pass 2: -sum(x * log(softmax + floor)) reduction
+# Pass 2: -sum(x * log(softmax + floor)) reduction + backward row-dot
 # ---------------------------------------------------------------------------
 def _loss_kernel(
     dims_ref,        # SMEM [1]
@@ -151,17 +157,24 @@ def _loss_kernel(
     m_ref,           # VMEM [B_pad, 1] global max
     l_ref,           # VMEM [B_pad, 1] global denominator
     out_ref,         # out VMEM [B_pad, 1] accumulated loss
+    rd_ref,          # out VMEM [B_pad, 1] accumulated row-dot sum(x*p/(p+f))
     *,
     eps: float,
     floor: float,
     tile_v: int,
 ):
+    """Loss pass. Also accumulates the softmax-backward row reduction
+    ``rd = sum_v x * p/(p+floor)`` (bounded form; see _bwd): x and p are
+    already resident in VMEM here, so the backward's first streaming pass
+    comes for free — one extra multiply+reduce per tile, zero extra HBM
+    traffic."""
     v_actual = dims_ref[0]
     j = pl.program_id(0)
 
     @pl.when(j == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
+        rd_ref[:] = jnp.zeros_like(rd_ref)
 
     b_pad = theta_ref.shape[0]
     z = jnp.dot(
@@ -180,6 +193,9 @@ def _loss_kernel(
     keep = jnp.logical_and(col_ok, row_valid)
     contrib = jnp.where(keep, x_ref[:] * jnp.log(p + floor), 0.0)
     out_ref[:] += -jnp.sum(contrib, axis=1, keepdims=True)
+
+    xr = jnp.where(col_ok, x_ref[:] * (p / (p + floor)), 0.0)
+    rd_ref[:] += jnp.sum(xr, axis=1, keepdims=True)
 
 
 def _pad_geometry(b: int, k: int, v: int):
@@ -205,35 +221,57 @@ def _specs(b_pad: int, k_pad: int, tile_v: int):
     return theta_spec, beta_spec, vrow_spec, bfix_spec
 
 
-def _pass1(
-    theta, beta, x_bow, run_mean, run_var, mask, *, training, eps, floor,
-    interpret,
-):
-    """Streaming pass 1: per-column batch statistics + per-row merged
-    online-softmax (max, denominator). Returns unpadded
-    ``(dims, mean [V], var [V], m [B, 1], s [B, 1])``."""
+# ---------------------------------------------------------------------------
+# Padded-operand plumbing: every array the kernels touch is padded ONCE per
+# step (here) and the padded buffers are shared by pass 1, pass 2 and — via
+# the VJP residuals — the backward pass.
+# ---------------------------------------------------------------------------
+def _pad_core(theta, beta, x_bow):
+    """Pad the three big operands. Returns ``(geom, theta_p, beta_p, x_p)``
+    with ``geom = (b, k, v, b_pad, k_pad, tile_v, v_pad)`` (static ints)."""
     b, k = theta.shape
     _, v = beta.shape
     b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v)
-    n_tiles = v_pad // tile_v
-
+    geom = (b, k, v, b_pad, k_pad, tile_v, v_pad)
     theta_p = jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta)
     beta_p = jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta)
-    mask_p = (
+    x_p = jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow)
+    return geom, theta_p, beta_p, x_p
+
+
+def _pad_mask(geom, mask):
+    b, _, _, b_pad, _, _, _ = geom
+    return (
         jnp.zeros((b_pad, 1), jnp.float32)
         .at[:b, 0]
         .set(mask.astype(jnp.float32))
     )
+
+
+def _pad_running(geom, run_mean, run_var):
+    _, _, v, _, _, _, v_pad = geom
     rmean_p = jnp.zeros((1, v_pad), jnp.float32).at[0, :v].set(run_mean)
     rvar_p = jnp.ones((1, v_pad), jnp.float32).at[0, :v].set(run_var)
-    dims = jnp.array([v], jnp.int32)
+    return rmean_p, rvar_p
 
+
+def _pass1_p(
+    geom, theta_p, beta_p, mask_p, rmean_p, rvar_p, *, training, eps,
+    interpret,
+):
+    """Streaming pass 1 over padded operands: per-column batch statistics +
+    per-row merged online-softmax (max, denominator). Returns PADDED
+    ``(mean [1, v_pad], var [1, v_pad], m [b_pad, 1], s [b_pad, 1])`` —
+    padding rows carry the (-inf max, 0 denominator) sentinel."""
+    _, _, v, b_pad, k_pad, tile_v, v_pad = geom
+    n_tiles = v_pad // tile_v
+    dims = jnp.array([v], jnp.int32)
     theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
 
     # m/s use bfix_spec (the full (b_pad, 1) array, constant index_map): the
     # sequential TPU grid keeps them resident in VMEM across tiles, so they
     # arrive here already merged — no [B, n_tiles] partials array.
-    mean, var, m_run, s_run = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _stats_kernel, training=training, eps=eps, tile_v=tile_v
         ),
@@ -251,32 +289,25 @@ def _pass1(
         ],
         interpret=interpret,
     )(dims, theta_p, beta_p, mask_p, rmean_p, rvar_p)
-    return dims, mean[0, :v], var[0, :v], m_run[:b], s_run[:b]
 
 
-def _pass2(
-    theta, beta, x_bow, mean, var, m_glob, l_glob, *, eps, floor, interpret,
+def _pass2_p(
+    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p, *, eps, floor,
+    interpret,
 ):
-    """Streaming pass 2: ``-sum(x * log(softmax + floor))`` over the local
-    V columns given the (possibly cross-device-merged) softmax stats.
-    Returns the unpadded [B] loss partial."""
-    b, k = theta.shape
-    _, v = beta.shape
-    b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v)
+    """Streaming pass 2 over padded operands: the
+    ``-sum(x * log(softmax + floor))`` reduction given the (possibly
+    cross-device-merged) softmax stats, plus the backward row-dot
+    accumulator. Returns PADDED ``(loss [b_pad, 1], rd [b_pad, 1])``."""
+    _, _, v, b_pad, k_pad, tile_v, v_pad = geom
     n_tiles = v_pad // tile_v
-
-    theta_p = jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta)
-    beta_p = jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta)
-    x_p = jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow)
-    mean_p = jnp.zeros((1, v_pad), jnp.float32).at[0, :v].set(mean)
-    var_p = jnp.ones((1, v_pad), jnp.float32).at[0, :v].set(var)
-    m_p = jnp.full((b_pad, 1), _NEG_INF, jnp.float32).at[:b].set(m_glob)
-    l_p = jnp.zeros((b_pad, 1), jnp.float32).at[:b].set(l_glob)
     dims = jnp.array([v], jnp.int32)
-
     theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
+    x_spec = pl.BlockSpec(
+        (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
 
-    loss = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _loss_kernel, eps=eps, floor=floor, tile_v=tile_v
         ),
@@ -284,23 +315,17 @@ def _pass2(
             num_scalar_prefetch=1,
             grid=(n_tiles,),
             in_specs=[
-                theta_spec,
-                beta_spec,
-                pl.BlockSpec(
-                    (b_pad, tile_v), lambda j, dims: (0, j),
-                    memory_space=pltpu.VMEM,
-                ),
-                vrow_spec,
-                vrow_spec,
-                bfix_spec,
-                bfix_spec,
+                theta_spec, beta_spec, x_spec, vrow_spec, vrow_spec,
+                bfix_spec, bfix_spec,
             ],
-            out_specs=bfix_spec,
+            out_specs=[bfix_spec, bfix_spec],
         ),
-        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(dims, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p)
-    return loss[:b, 0]
 
 
 def _fused_forward(
@@ -316,59 +341,27 @@ def _fused_forward(
     floor: float,
     interpret: bool,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    _, mean, var, m_glob, l_glob = _pass1(
-        theta, beta, x_bow, run_mean, run_var, mask,
-        training=training, eps=eps, floor=floor, interpret=interpret,
+    geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow)
+    b, _, v = geom[0], geom[1], geom[2]
+    mask_p = _pad_mask(geom, mask)
+    rmean_p, rvar_p = _pad_running(geom, run_mean, run_var)
+    mean_p, var_p, m_p, s_p = _pass1_p(
+        geom, theta_p, beta_p, mask_p, rmean_p, rvar_p,
+        training=training, eps=eps, interpret=interpret,
     )
-    loss = _pass2(
-        theta, beta, x_bow, mean, var, m_glob, l_glob,
+    loss_p, _ = _pass2_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, s_p,
         eps=eps, floor=floor, interpret=interpret,
     )
-    return loss, mean, var
+    return loss_p[:b, 0], mean_p[0, :v], var_p[0, :v]
 
 
 # ---------------------------------------------------------------------------
-# Backward passes (streaming, VERDICT r3: keep the bwd off the [B, V] HBM
-# path too — XLA's remat of z/n/p materializes ~3 [B, V] intermediates)
+# Backward pass (streaming, VERDICT r3: keep the bwd off the [B, V] HBM
+# path too — XLA's remat of z/n/p materializes ~3 [B, V] intermediates).
+# The row-dot reduction was already accumulated by the forward loss pass;
+# only the per-tile gz -> (g_beta block, g_theta accumulator) pass remains.
 # ---------------------------------------------------------------------------
-def _rowdot_kernel(
-    dims_ref,        # SMEM [1]: (V_actual,)
-    theta_ref,       # VMEM [B_pad, K]
-    beta_ref,        # VMEM [K, TILE_V]
-    x_ref,           # VMEM [B_pad, TILE_V]
-    mean_ref,        # VMEM [1, TILE_V]
-    var_ref,         # VMEM [1, TILE_V]
-    m_ref,           # VMEM [B_pad, 1] global softmax max
-    l_ref,           # VMEM [B_pad, 1] global softmax denominator
-    rd_ref,          # out VMEM [B_pad, 1] accumulated row-dot sum(x*p/(p+f))
-    *,
-    eps: float,
-    floor: float,
-    tile_v: int,
-):
-    """Backward pass 1: the softmax-backward row reduction
-    ``rd = sum_v x * p/(p+floor)`` (bounded form; see _bwd), accumulated
-    across tiles in a VMEM-resident (B, 1) block."""
-    v_actual = dims_ref[0]
-    j = pl.program_id(0)
-
-    @pl.when(j == 0)
-    def _init():
-        rd_ref[:] = jnp.zeros_like(rd_ref)
-
-    b_pad = theta_ref.shape[0]
-    z = jnp.dot(theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32)
-    n = (z - mean_ref[:]) * jax.lax.rsqrt(var_ref[:] + eps)
-    row_valid = l_ref[:] > 1e-20
-    safe_m = jnp.where(row_valid, m_ref[:], 0.0)
-    safe_l = jnp.where(row_valid, l_ref[:], 1.0)
-    p = jnp.exp(jnp.minimum(n - safe_m, 0.0)) / safe_l
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
-    col_ok = (col_ids + j * tile_v) < v_actual
-    xr = jnp.where(col_ok, x_ref[:] * (p / (p + floor)), 0.0)
-    rd_ref[:] += jnp.sum(xr, axis=1, keepdims=True)
-
-
 def _grads_kernel(
     dims_ref,        # SMEM [1]
     theta_ref,       # VMEM [B_pad, K]
@@ -378,7 +371,7 @@ def _grads_kernel(
     var_ref,         # VMEM [1, TILE_V]
     m_ref,           # VMEM [B_pad, 1]
     l_ref,           # VMEM [B_pad, 1]
-    rd_ref,          # VMEM [B_pad, 1] row-dot from pass 1
+    rd_ref,          # VMEM [B_pad, 1] row-dot from the forward loss pass
     g_ref,           # VMEM [B_pad, 1] cotangent * row mask
     mask_ref,        # VMEM [B_pad, 1]
     gbeta_ref,       # out VMEM [K, TILE_V] per-tile g_beta block
@@ -389,7 +382,7 @@ def _grads_kernel(
     floor: float,
     tile_v: int,
 ):
-    """Backward pass 2: per-tile ``gz``, emitting the tile's ``g_beta``
+    """Backward pass: per-tile ``gz``, emitting the tile's ``g_beta``
     block and accumulating ``g_theta``. Padded columns produce garbage gz
     that multiplies beta's zero padding — exact no-ops in g_theta — and
     land only in g_beta columns the caller slices away."""
@@ -432,71 +425,16 @@ def _grads_kernel(
     )
 
 
-def _pad_bwd_inputs(theta, beta, x_bow, mean, var, m_glob, l_glob):
-    b, k = theta.shape
-    _, v = beta.shape
-    b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v)
-    return (
-        (b, k, v, b_pad, k_pad, tile_v, v_pad),
-        jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta),
-        jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta),
-        jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow),
-        jnp.zeros((1, v_pad), jnp.float32).at[0, :v].set(mean),
-        jnp.ones((1, v_pad), jnp.float32).at[0, :v].set(var),
-        jnp.full((b_pad, 1), _NEG_INF, jnp.float32).at[:b].set(m_glob),
-        jnp.zeros((b_pad, 1), jnp.float32).at[:b].set(l_glob),
-    )
-
-
-def _pallas_rowdot(pads, *, eps, floor, interpret):
-    """Backward pass 1 from pre-padded inputs (``_pad_bwd_inputs``); the
-    V-sharded path psums its result over the model axis before pass 2.
-    Returns the unpadded [B, 1] row-dot."""
-    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = pads
+def _grads_p(
+    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p, rd_p, g_p, mask_p,
+    *, training, eps, floor, interpret,
+):
+    """Backward pass over the padded operands saved by the forward. Returns
+    the UNPADDED ``(g_theta [B, K], g_beta [K, V])`` (local shard under
+    V-sharding)."""
     b, k, v, b_pad, k_pad, tile_v, v_pad = geom
     n_tiles = v_pad // tile_v
     dims = jnp.array([v], jnp.int32)
-    theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
-    x_spec = pl.BlockSpec(
-        (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
-    )
-    rd = pl.pallas_call(
-        functools.partial(
-            _rowdot_kernel, eps=eps, floor=floor, tile_v=tile_v
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(n_tiles,),
-            in_specs=[
-                theta_spec, beta_spec, x_spec, vrow_spec, vrow_spec,
-                bfix_spec, bfix_spec,
-            ],
-            out_specs=bfix_spec,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
-        interpret=interpret,
-    )(dims, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p)
-    return rd[:b]
-
-
-def _pallas_grads(pads, rd, mask, g_rl, *, training, eps, floor, interpret):
-    """Backward pass 2 from pre-padded inputs. Returns
-    ``(g_theta [B, K], g_beta [K, V])`` (local shard under V-sharding)."""
-    geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p = pads
-    b, k, v, b_pad, k_pad, tile_v, v_pad = geom
-    n_tiles = v_pad // tile_v
-    dims = jnp.array([v], jnp.int32)
-    mask_p = (
-        jnp.zeros((b_pad, 1), jnp.float32)
-        .at[:b, 0]
-        .set(mask.astype(jnp.float32))
-    )
-    g_p = (
-        jnp.zeros((b_pad, 1), jnp.float32)
-        .at[:b, 0]
-        .set(g_rl * mask.astype(jnp.float32))
-    )
-    rd_p = jnp.zeros((b_pad, 1), jnp.float32).at[:b].set(rd)
     theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
     x_spec = pl.BlockSpec(
         (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
@@ -530,18 +468,13 @@ def _pallas_grads(pads, rd, mask, g_rl, *, training, eps, floor, interpret):
     return g_theta[:b, :k], g_beta[:k, :v]
 
 
-def _pallas_bwd(
-    theta, beta, x_bow, mean, var, m_glob, l_glob, mask, g_rl, *,
-    training, eps, floor, interpret,
-):
-    """Streaming backward: two more V-tile passes, no [B, V] HBM arrays.
-    Inputs are padded ONCE and shared by both passes. Returns
-    ``(g_theta [B, K], g_beta [K, V])``."""
-    pads = _pad_bwd_inputs(theta, beta, x_bow, mean, var, m_glob, l_glob)
-    rd = _pallas_rowdot(pads, eps=eps, floor=floor, interpret=interpret)
-    return _pallas_grads(
-        pads, rd, mask, g_rl,
-        training=training, eps=eps, floor=floor, interpret=interpret,
+def _pad_cotangent(geom, g_rl, mask):
+    b = geom[0]
+    b_pad = geom[3]
+    return (
+        jnp.zeros((b_pad, 1), jnp.float32)
+        .at[:b, 0]
+        .set(g_rl * mask.astype(jnp.float32))
     )
 
 
@@ -589,31 +522,45 @@ def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
     interp = _resolve_interpret(interpret)
     if mask is None:
         mask = jnp.ones((theta.shape[0],), jnp.float32)
-    _, mean, var, m_glob, l_glob = _pass1(
-        theta, beta, x_bow, run_mean, run_var, mask,
-        training=training, eps=eps, floor=floor, interpret=interp,
+    geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow)
+    b, _, v = geom[0], geom[1], geom[2]
+    mask_p = _pad_mask(geom, mask)
+    rmean_p, rvar_p = _pad_running(geom, run_mean, run_var)
+    mean_p, var_p, m_p, l_p = _pass1_p(
+        geom, theta_p, beta_p, mask_p, rmean_p, rvar_p,
+        training=training, eps=eps, interpret=interp,
     )
-    rl = _pass2(
-        theta, beta, x_bow, mean, var, m_glob, l_glob,
+    loss_p, rd_p = _pass2_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p,
         eps=eps, floor=floor, interpret=interp,
     )
-    return (rl, mean, var), (
-        theta, beta, x_bow, mean, var, m_glob, l_glob, mask,
+    # Residuals keep the PADDED operands so the backward re-pads nothing.
+    # theta/beta (unpadded) ride along only to carry the static (b, k, v)
+    # geometry into _bwd — they are live training-step buffers either way.
+    return (loss_p[:b, 0], mean_p[0, :v], var_p[0, :v]), (
+        theta, beta, theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p, l_p,
+        rd_p, mask,
     )
 
 
 def _bwd(training, eps, floor, interpret, residuals, cotangents):
-    """Streaming Pallas backward (two V-tile passes; see _rowdot_kernel /
-    _grads_kernel): no [B, V] array ever reaches HBM, the same property the
-    forward has. The softmax+floor backward uses the numerically bounded
-    form ``p*gp = -g * x * p/(p+floor)`` (errors scale with x, not x/p);
-    the saved (m, l) softmax stats reproduce exactly the p the forward
-    computed. Padding rows carry zero cotangent via the mask."""
-    theta, beta, x_bow, mean, var, m_glob, l_glob, mask = residuals
+    """Streaming Pallas backward — a single V-tile pass (see _grads_kernel):
+    the row-dot reduction already rode along with the forward loss pass, and
+    no [B, V] array ever reaches HBM, the same property the forward has.
+    The softmax+floor backward uses the numerically bounded form
+    ``p*gp = -g * x * p/(p+floor)`` (errors scale with x, not x/p); the
+    saved (m, l) softmax stats reproduce exactly the p the forward computed.
+    Padding rows carry zero cotangent via the mask."""
+    (theta, beta, theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p, l_p,
+     rd_p, mask) = residuals
+    b, k = theta.shape
+    v = beta.shape[1]
+    geom = (b, k, v) + _pad_geometry(b, k, v)
     g_rl = cotangents[0]  # stats outputs are gradient-free
-    g_theta, g_beta = _pallas_bwd(
-        theta, beta, x_bow, mean, var, m_glob, l_glob, mask, g_rl,
-        training=training, eps=eps, floor=floor,
+    g_p = _pad_cotangent(geom, g_rl, mask)
+    g_theta, g_beta = _grads_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p, rd_p, g_p,
+        mask_p, training=training, eps=eps, floor=floor,
         interpret=_resolve_interpret(interpret),
     )
     return g_theta, g_beta, None, None, None, None
@@ -656,9 +603,10 @@ def prodlda_recon_loss_vsharded(
     when the row mean depends on other devices' rows).
 
     Gradients are the analytic backward of the reference loss with the same
-    collectives transposed: the softmax row-dot and ``g_theta`` ``psum``
-    over ``model_axis``; the BN-statistic corrections ``psum`` over
-    ``data_axis``. ``g_beta``/``g_x`` stay shard-local.
+    collectives transposed: the softmax row-dot (accumulated by the forward
+    loss pass) and ``g_theta`` ``psum`` over ``model_axis``; the
+    BN-statistic corrections ``psum`` over ``data_axis``. ``g_beta``/``g_x``
+    stay shard-local.
 
     Returns ``(rl [B], batch_mean [V_local], batch_var [V_local])`` exactly
     like :func:`prodlda_recon_loss` (rl is the full-V loss, replicated
@@ -671,82 +619,127 @@ def prodlda_recon_loss_vsharded(
     )
 
 
+def _vsharded_replicated_fwd(
+    theta, beta_local, x_local, run_mean_local, run_var_local, mask,
+    model_axis, training, eps, floor, interp,
+):
+    """Forward for the rows-replicated branch (batch replicated across the
+    model axis): pad once, stream the local shard through the single-device
+    kernels, merge the per-shard softmax partials across the V shards.
+    Returns padded intermediates for the VJP alongside the outputs."""
+    geom, theta_p, beta_p, x_p = _pad_core(theta, beta_local, x_local)
+    b = geom[0]
+    mask_p = _pad_mask(geom, mask)
+    rmean_p, rvar_p = _pad_running(geom, run_mean_local, run_var_local)
+    mean_p, var_p, m_loc, s_loc = _pass1_p(
+        geom, theta_p, beta_p, mask_p, rmean_p, rvar_p,
+        training=training, eps=eps, interpret=interp,
+    )
+    # Online-softmax merge across the V shards. Padding rows hold the
+    # (-inf, 0) sentinel on every device, so merging them is consistent.
+    m_glob = jax.lax.pmax(m_loc, model_axis)
+    l_glob = jax.lax.psum(
+        s_loc * jnp.exp(jnp.minimum(m_loc - m_glob, 0.0)), model_axis
+    )
+    loss_p, rd_p = _pass2_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_glob, l_glob,
+        eps=eps, floor=floor, interpret=interp,
+    )
+    rl = jax.lax.psum(loss_p[:b, 0], model_axis)
+    return rl, mean_p, var_p, m_glob, l_glob, rd_p, (
+        theta_p, beta_p, x_p, mask_p,
+    )
+
+
+def _vsharded_data_sharded_fwd(
+    theta, beta_local, x_local, mask, model_axis, data_axis, eps, floor,
+):
+    """Forward for the rows-sharded TRAINING branch (XLA, not Pallas): the
+    masked batch statistics need cross-device row sums, which cannot
+    interleave with the tile stream. sum(z) has a rank-K shortcut (no z
+    materialization); sum(z^2) needs one streaming pass, done here in tiled
+    XLA (z tiles stay in registers/VMEM after fusion) — and z being
+    materialized anyway, the loss reduction also stays in XLA."""
+    m_col = mask.astype(jnp.float32)[:, None]
+    cnt = jax.lax.psum(jnp.sum(m_col), data_axis)
+    cnt = jnp.maximum(cnt, 1.0)
+    colsum = (m_col * theta).sum(axis=0) @ beta_local           # [V_local]
+    z_local = theta @ beta_local
+    colsumsq = jnp.sum(jnp.square(z_local) * m_col, axis=0)
+    colsum = jax.lax.psum(colsum, data_axis)
+    colsumsq = jax.lax.psum(colsumsq, data_axis)
+    mean = colsum / cnt
+    var = jnp.maximum(colsumsq / cnt - jnp.square(mean), 0.0)
+    # Softmax partials from the normalized local z (XLA path: z is already
+    # materialized for the sumsq above).
+    n = (z_local - mean[None, :]) * jax.lax.rsqrt(var + eps)[None, :]
+    n = jnp.where(mask[:, None] > 0.0, n, _NEG_INF)
+    m_loc = jnp.max(n, axis=1, keepdims=True)
+    safe = jnp.maximum(m_loc, _NEG_INF * 0.5)
+    s_loc = jnp.sum(
+        jnp.where(mask[:, None] > 0.0, jnp.exp(n - safe), 0.0),
+        axis=1, keepdims=True,
+    )
+    m_glob = jax.lax.pmax(m_loc, model_axis)
+    l_glob = jax.lax.psum(
+        s_loc * jnp.exp(jnp.minimum(m_loc - m_glob, 0.0)), model_axis
+    )
+    row_valid = l_glob > 1e-20
+    safe_m = jnp.where(row_valid, m_glob, 0.0)
+    safe_l = jnp.where(row_valid, l_glob, 1.0)
+    p = jnp.exp(jnp.minimum(n - safe_m, 0.0)) / safe_l
+    rl_local = -jnp.sum(
+        jnp.where(row_valid, x_local * jnp.log(p + floor), 0.0), axis=1
+    )
+    rl = jax.lax.psum(rl_local, model_axis)
+    return rl, mean, var, m_glob, l_glob
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def _vsharded_impl(
     theta, beta_local, x_local, run_mean_local, run_var_local, mask,
     model_axis, data_axis, training, eps, floor, interpret,
 ):
-    rl, mean, var, _, _ = _vsharded_fwd_math(
-        theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-        model_axis, data_axis, training, eps, floor, interpret,
-    )
-    return rl, mean, var
-
-
-def _vsharded_fwd_math(
-    theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-    model_axis, data_axis, training, eps, floor, interpret,
-):
-    b = theta.shape[0]
+    interp = _resolve_interpret(interpret)
     v_local = beta_local.shape[1]
     if training and data_axis is not None:
-        # Rows are sharded too: masked batch statistics need the global row
-        # count and per-column sums. sum(z) has a rank-K shortcut (no z
-        # materialization); sum(z^2) needs one streaming pass, done here in
-        # tiled XLA (z tiles stay in registers/VMEM after fusion).
-        m_col = mask.astype(jnp.float32)[:, None]
-        cnt = jax.lax.psum(jnp.sum(m_col), data_axis)
-        cnt = jnp.maximum(cnt, 1.0)
-        colsum = (m_col * theta).sum(axis=0) @ beta_local       # [V_local]
-        z_local = theta @ beta_local
-        colsumsq = jnp.sum(jnp.square(z_local) * m_col, axis=0)
-        colsum = jax.lax.psum(colsum, data_axis)
-        colsumsq = jax.lax.psum(colsumsq, data_axis)
-        mean = colsum / cnt
-        var = jnp.maximum(colsumsq / cnt - jnp.square(mean), 0.0)
-        # Softmax partials from the normalized local z (XLA path: z is
-        # already materialized for the sumsq above).
-        n = (z_local - mean[None, :]) * jax.lax.rsqrt(var + eps)[None, :]
-        n = jnp.where(mask[:, None] > 0.0, n, _NEG_INF)
-        m_loc = jnp.max(n, axis=1, keepdims=True)
-        safe = jnp.maximum(m_loc, _NEG_INF * 0.5)
-        s_loc = jnp.sum(
-            jnp.where(mask[:, None] > 0.0, jnp.exp(n - safe), 0.0),
-            axis=1, keepdims=True,
+        rl, mean, var, _, _ = _vsharded_data_sharded_fwd(
+            theta, beta_local, x_local, mask, model_axis, data_axis, eps,
+            floor,
         )
-    else:
-        # Rows replicated across the model axis: the single-device pass-1
-        # kernel already produces exact local-shard stats + softmax partials.
-        _, mean, var, m_loc, s_loc = _pass1(
-            theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-            training=training, eps=eps, floor=floor,
-            interpret=_resolve_interpret(interpret),
-        )
-
-    # Online-softmax merge across the V shards.
-    m_glob = jax.lax.pmax(m_loc, model_axis)
-    l_glob = jax.lax.psum(
-        s_loc * jnp.exp(jnp.minimum(m_loc - m_glob, 0.0)), model_axis
+        return rl, mean, var
+    rl, mean_p, var_p, _, _, _, _ = _vsharded_replicated_fwd(
+        theta, beta_local, x_local, run_mean_local, run_var_local, mask,
+        model_axis, training, eps, floor, interp,
     )
-
-    rl_partial = _pass2(
-        theta, beta_local, x_local, mean, var, m_glob, l_glob,
-        eps=eps, floor=floor, interpret=_resolve_interpret(interpret),
-    )
-    rl = jax.lax.psum(rl_partial, model_axis)
-    return rl[:b], mean, var, m_glob, l_glob
+    return rl, mean_p[0, :v_local], var_p[0, :v_local]
 
 
 def _vsharded_vjp_fwd(
     theta, beta_local, x_local, run_mean_local, run_var_local, mask,
     model_axis, data_axis, training, eps, floor, interpret,
 ):
-    rl, mean, var, m_glob, l_glob = _vsharded_fwd_math(
+    interp = _resolve_interpret(interpret)
+    v_local = beta_local.shape[1]
+    if training and data_axis is not None:
+        # Rows-sharded branch: XLA forward (see _vsharded_data_sharded_fwd)
+        # and an XLA backward; residuals stay unpadded.
+        rl, mean, var, m_glob, l_glob = _vsharded_data_sharded_fwd(
+            theta, beta_local, x_local, mask, model_axis, data_axis, eps,
+            floor,
+        )
+        return (rl, mean, var), (
+            theta, beta_local, x_local, mean, var, m_glob, l_glob, mask,
+        )
+    rl, mean_p, var_p, m_glob, l_glob, rd_p, pads = _vsharded_replicated_fwd(
         theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-        model_axis, data_axis, training, eps, floor, interpret,
+        model_axis, training, eps, floor, interp,
     )
-    return (rl, mean, var), (
-        theta, beta_local, x_local, mean, var, m_glob, l_glob, mask,
+    theta_p, beta_p, x_p, mask_p = pads
+    # theta/beta_local (unpadded) ride along to carry the static geometry.
+    return (rl, mean_p[0, :v_local], var_p[0, :v_local]), (
+        theta, beta_local, theta_p, beta_p, x_p, mask_p, mean_p, var_p,
+        m_glob, l_glob, rd_p, mask,
     )
 
 
@@ -754,7 +747,6 @@ def _vsharded_vjp_bwd(
     model_axis, data_axis, training, eps, floor, interpret, residuals,
     cotangents,
 ):
-    theta, beta_local, x_local, mean, var, m_glob, l_glob, mask = residuals
     # shard_map transpose convention (check_vma=False): the cotangent of an
     # output that is REPLICATED along an axis arrives divided by that axis'
     # size (rl is replicated over `model_axis` after its psum; it is sharded
@@ -770,6 +762,9 @@ def _vsharded_vjp_bwd(
         # sums interleaved with the per-tile math, which the streaming
         # kernels cannot host — keep this branch in XLA (it materializes
         # z for the forward's sumsq anyway).
+        theta, beta_local, x_local, mean, var, m_glob, l_glob, mask = (
+            residuals
+        )
         m = mask.astype(jnp.float32)[:, None]
         inv_std = jax.lax.rsqrt(var + eps)                  # [V_local]
         z = theta @ beta_local
@@ -799,17 +794,20 @@ def _vsharded_vjp_bwd(
         g_beta = theta.T @ gz
         return g_theta, g_beta, None, None, None, None
 
-    # Rows replicated across the model axis: stream the backward through
-    # the same Pallas passes as the single-device VJP, with ONE [B, 1]
-    # psum between them (the softmax row-dot runs over the full V axis).
-    pads = _pad_bwd_inputs(
-        theta, beta_local, x_local, mean, var, m_glob, l_glob
-    )
-    rd_local = _pallas_rowdot(pads, eps=eps, floor=floor, interpret=interp)
-    rd = jax.lax.psum(rd_local, model_axis)
-    g_theta, g_beta = _pallas_grads(
-        pads, rd, mask, g_rl,
-        training=training, eps=eps, floor=floor, interpret=interp,
+    # Rows replicated across the model axis: stream the backward through the
+    # same single Pallas pass as the single-device VJP. The row-dot was
+    # accumulated per-shard by the forward loss pass; ONE [B, 1] psum
+    # completes it over the full V axis.
+    (theta, beta_local, theta_p, beta_p, x_p, mask_p, mean_p, var_p,
+     m_glob, l_glob, rd_p, mask) = residuals
+    b, k = theta.shape
+    v = beta_local.shape[1]
+    geom = (b, k, v) + _pad_geometry(b, k, v)
+    rd = jax.lax.psum(rd_p, model_axis)
+    g_p = _pad_cotangent(geom, g_rl, mask)
+    g_theta, g_beta = _grads_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_glob, l_glob, rd, g_p,
+        mask_p, training=training, eps=eps, floor=floor, interpret=interp,
     )
     # theta is REPLICATED along the model axis, and shard_map's transpose of
     # a replicated input SUMS the per-device cotangents — i.e. the transpose
@@ -864,10 +862,10 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
             )
             return jnp.sum(rl)
 
-        # Probe forward AND backward: the VJP lowers two additional Pallas
-        # kernels (row-dot accumulator, per-tile grads with in-kernel
-        # transposes) that the forward never exercises — a backend that
-        # lowers only the forward would otherwise crash at the first
+        # Probe forward AND backward: the VJP lowers additional Pallas
+        # kernels (the mixed-output loss+rowdot pass, the grads pass with
+        # in-kernel transposes) that the primal never exercises — a backend
+        # that lowers only the forward would otherwise crash at the first
         # training step, the exact failure class this probe exists for.
         loss, (gt, gb) = jax.jit(
             jax.value_and_grad(probe_loss, argnums=(0, 1))
