@@ -30,6 +30,21 @@ def main() -> None:
     backend = jax.default_backend()
     table = bench_fused_largev(backend, v_list=(16384, 50_000, 100_000))
 
+    # Tile-width sweep (GFEDNTM_FUSED_TILE_V) on the cases where the default
+    # 2048-wide tile historically only broke even: wider tiles amortize grid
+    # overhead at the cost of more VMEM per step. bench_fused_largev builds
+    # fresh jitted closures per call, so the env knob takes effect per run.
+    tile_sweep: dict[str, dict] = {}
+    sweep_cases = [(50_000, 64), (100_000, 256)]
+    for tile in (4096, 8192):
+        os.environ["GFEDNTM_FUSED_TILE_V"] = str(tile)
+        try:
+            tile_sweep[f"tile{tile}"] = bench_fused_largev(
+                backend, cases=sweep_cases
+            )
+        finally:
+            del os.environ["GFEDNTM_FUSED_TILE_V"]
+
     def _parse(key: str) -> tuple[int, int]:
         v, b = key[1:].split("_B")
         return int(v), int(b)
@@ -45,6 +60,7 @@ def main() -> None:
     report = {
         "backend": backend,
         "table": table,
+        "tile_sweep": tile_sweep,
         "all_parity": all(r["parity"] for r in table.values()),
         "recommended_threshold": min(wins_b64) if wins_b64 else None,
         "threshold_rule": "min V with fused win at B=64 (reference batch)",

@@ -19,6 +19,7 @@ DataFrame matching the reference artifact schema when pandas is available).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import pickle
@@ -254,7 +255,15 @@ def run_simulation(
     Returns ``{"index": [...], "index_name": ..., "columns":
     {"<arm>_<stat>_<mean|std>": [...]}}`` and, when ``results_dir`` is given,
     writes ``results.json`` plus — if pandas is importable — the reference's
-    ``results.pickle`` DataFrame artifact."""
+    ``results.pickle`` DataFrame artifact.
+
+    With ``results_dir`` set, each completed iteration is also checkpointed
+    to ``results_dir/iters/`` and skipped on re-run: a multi-hour sweep
+    interrupted mid-way (the TPU tunnel can hang a device call indefinitely;
+    the caller's watchdog kills and relaunches) resumes at the first
+    unfinished iteration instead of redoing the run. Iteration results are
+    seed-deterministic (``cfg.seed + 1000 * it``), so a resumed sweep equals
+    an uninterrupted one."""
     if cfg.experiment == 0:
         sweep = list(cfg.frozen_topics_list)
         index_name = "Nr frozen topics"
@@ -277,8 +286,49 @@ def run_simulation(
         else:
             point_cfg.beta = float(point)
         per_iter = {arm: {stat: [] for stat in stats} for arm in arms}
+        ckpt_dir = None
+        if results_dir is not None:
+            # Namespace checkpoints by a config digest (everything that
+            # changes iteration results except the per-point overrides and
+            # the iteration count): a re-run with a different seed/regime
+            # lands in a fresh subdirectory instead of silently loading the
+            # old config's numbers.
+            stamp_cfg = {
+                k: v for k, v in sorted(cfg.__dict__.items())
+                if k not in ("iters", "eta_list", "frozen_topics_list",
+                             "model_kwargs")
+            }
+            stamp_cfg["model_kwargs"] = sorted(cfg.model_kwargs.items())
+            digest = hashlib.sha256(
+                repr(stamp_cfg).encode()
+            ).hexdigest()[:12]
+            ckpt_dir = Path(results_dir) / "iters" / digest
+            ckpt_dir.mkdir(parents=True, exist_ok=True)
+            stamp_path = ckpt_dir / "config_stamp.json"
+            if not stamp_path.exists():
+                with open(stamp_path, "w", encoding="utf8") as f:
+                    json.dump(
+                        {k: repr(v) for k, v in stamp_cfg.items()}, f,
+                        indent=2,
+                    )
         for it in range(cfg.iters):
-            res = run_iter_simulation(point_cfg, seed=cfg.seed + 1000 * it)
+            ckpt = (
+                ckpt_dir / f"point{point}_it{it}.json"
+                if ckpt_dir is not None else None
+            )
+            if ckpt is not None and ckpt.exists():
+                with open(ckpt, encoding="utf8") as f:
+                    res = json.load(f)
+                logger.info("simulation: resume point=%s it=%d", point, it)
+            else:
+                res = run_iter_simulation(
+                    point_cfg, seed=cfg.seed + 1000 * it
+                )
+                if ckpt is not None:
+                    tmp = ckpt.with_suffix(".tmp")
+                    with open(tmp, "w", encoding="utf8") as f:
+                        json.dump(res, f)
+                    tmp.rename(ckpt)
             for arm in arms:
                 for stat in stats:
                     per_iter[arm][stat].append(res[arm][stat])

@@ -43,6 +43,7 @@ on CPU for tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -61,12 +62,27 @@ def _pick_tile_v(v: int) -> tuple[int, int]:
     rather than fitting the tile to ``round_up(v, 128)`` — the round-2 picker
     did the latter, and at V=50000 (v_pad=50048, divisible by nothing above
     128) degenerated to 391 sequential 128-wide grid steps. Padding V=50000
-    to 51200 costs 2.4% wasted columns and keeps the MXU on 2048-wide tiles."""
+    to 51200 costs 2.4% wasted columns and keeps the MXU on 2048-wide tiles.
+
+    ``GFEDNTM_FUSED_TILE_V`` (a multiple of 128) overrides the tile width —
+    the tuning knob behind ``soak_fused_kernel.py``'s tile sweep; forward
+    and backward read it through the same path, so their geometries always
+    agree within a process."""
     v = max(v, 128)
-    if v <= 2048:
+    tile_cap = 2048
+    override = os.environ.get("GFEDNTM_FUSED_TILE_V")
+    if override:
+        try:
+            tile_cap = max(128, _round_up(int(override), 128))
+        except ValueError:
+            raise ValueError(
+                "GFEDNTM_FUSED_TILE_V must be an integer (multiple of "
+                f"128); got {override!r}"
+            ) from None
+    if v <= tile_cap:
         v_pad = _round_up(v, 128)
         return v_pad, v_pad
-    return 2048, _round_up(v, 2048)
+    return tile_cap, _round_up(v, tile_cap)
 
 
 # ---------------------------------------------------------------------------
@@ -340,20 +356,26 @@ def _fused_forward(
     eps: float,
     floor: float,
     interpret: bool,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+):
+    """Shared forward for the primal and the VJP: pad once, run both
+    streaming passes. Returns ``(outputs, padded-intermediates)`` — the
+    primal discards the latter, the VJP packs them into its residuals."""
     geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow)
     b, _, v = geom[0], geom[1], geom[2]
     mask_p = _pad_mask(geom, mask)
     rmean_p, rvar_p = _pad_running(geom, run_mean, run_var)
-    mean_p, var_p, m_p, s_p = _pass1_p(
+    mean_p, var_p, m_p, l_p = _pass1_p(
         geom, theta_p, beta_p, mask_p, rmean_p, rvar_p,
         training=training, eps=eps, interpret=interpret,
     )
-    loss_p, _ = _pass2_p(
-        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, s_p,
+    loss_p, rd_p = _pass2_p(
+        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p,
         eps=eps, floor=floor, interpret=interpret,
     )
-    return loss_p[:b, 0], mean_p[0, :v], var_p[0, :v]
+    outputs = (loss_p[:b, 0], mean_p[0, :v], var_p[0, :v])
+    return outputs, (
+        theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p, l_p, rd_p,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -511,10 +533,11 @@ def prodlda_recon_loss(
         interpret = jax.default_backend() not in ("tpu", "axon")
     if mask is None:
         mask = jnp.ones((theta.shape[0],), jnp.float32)
-    return _fused_forward(
+    outputs, _ = _fused_forward(
         theta, beta, x_bow, run_mean, run_var, mask,
         training=training, eps=eps, floor=floor, interpret=interpret,
     )
+    return outputs
 
 
 def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
@@ -522,25 +545,14 @@ def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
     interp = _resolve_interpret(interpret)
     if mask is None:
         mask = jnp.ones((theta.shape[0],), jnp.float32)
-    geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow)
-    b, _, v = geom[0], geom[1], geom[2]
-    mask_p = _pad_mask(geom, mask)
-    rmean_p, rvar_p = _pad_running(geom, run_mean, run_var)
-    mean_p, var_p, m_p, l_p = _pass1_p(
-        geom, theta_p, beta_p, mask_p, rmean_p, rvar_p,
-        training=training, eps=eps, interpret=interp,
-    )
-    loss_p, rd_p = _pass2_p(
-        geom, theta_p, beta_p, x_p, mean_p, var_p, m_p, l_p,
-        eps=eps, floor=floor, interpret=interp,
+    outputs, pads = _fused_forward(
+        theta, beta, x_bow, run_mean, run_var, mask,
+        training=training, eps=eps, floor=floor, interpret=interp,
     )
     # Residuals keep the PADDED operands so the backward re-pads nothing.
     # theta/beta (unpadded) ride along only to carry the static (b, k, v)
     # geometry into _bwd — they are live training-step buffers either way.
-    return (loss_p[:b, 0], mean_p[0, :v], var_p[0, :v]), (
-        theta, beta, theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p, l_p,
-        rd_p, mask,
-    )
+    return outputs, (theta, beta, mask) + pads
 
 
 def _bwd(training, eps, floor, interpret, residuals, cotangents):
@@ -551,8 +563,8 @@ def _bwd(training, eps, floor, interpret, residuals, cotangents):
     ``p*gp = -g * x * p/(p+floor)`` (errors scale with x, not x/p); the
     saved (m, l) softmax stats reproduce exactly the p the forward computed.
     Padding rows carry zero cotangent via the mask."""
-    (theta, beta, theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p, l_p,
-     rd_p, mask) = residuals
+    (theta, beta, mask, theta_p, beta_p, x_p, mask_p, mean_p, var_p, m_p,
+     l_p, rd_p) = residuals
     b, k = theta.shape
     v = beta.shape[1]
     geom = (b, k, v) + _pad_geometry(b, k, v)

@@ -76,6 +76,34 @@ class TestDssTssSimulation:
         saved = json.loads((tmp_path / "results.json").read_text())
         assert saved["columns"].keys() == out["columns"].keys()
 
+    def test_run_simulation_resumes_from_iteration_checkpoints(
+        self, tmp_path, monkeypatch
+    ):
+        """A killed-and-relaunched sweep must skip completed iterations
+        (the TPU tunnel can hang a multi-hour run mid-way; the watchdog
+        relaunches it)."""
+        cfg = tiny_sim_config(iters=2)
+        out1 = run_simulation(cfg, results_dir=tmp_path)
+        # Checkpoints live under a config-digest subdirectory so a changed
+        # config cannot silently reuse another regime's results.
+        ckpts = sorted((tmp_path / "iters").glob("*/point*.json"))
+        assert len(ckpts) == 2
+
+        import gfedntm_tpu.experiments.dss_tss as mod
+
+        def boom(*a, **k):
+            raise AssertionError("iteration re-ran despite checkpoint")
+
+        monkeypatch.setattr(mod, "run_iter_simulation", boom)
+        out2 = run_simulation(cfg, results_dir=tmp_path)
+        assert out2["columns"] == out1["columns"]
+
+        # A different seed must NOT reuse those checkpoints (digest differs)
+        # -> the patched run_iter_simulation fires.
+        cfg2 = tiny_sim_config(iters=2, seed=7)
+        with pytest.raises(AssertionError, match="re-ran"):
+            run_simulation(cfg2, results_dir=tmp_path)
+
     def test_frozen_topics_sweep_uses_frozen_list(self):
         cfg = tiny_sim_config(experiment=0, frozen_topics_list=(0, 2))
         out = run_simulation(cfg)

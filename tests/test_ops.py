@@ -113,6 +113,30 @@ def test_gradient_parity_with_mask():
     np.testing.assert_allclose(gf_b, gr_b, rtol=1e-4, atol=1e-4)
 
 
+def test_gradient_parity_weighted_cotangent():
+    """Non-uniform rl cotangent: the streaming backward folds the row-dot
+    into the forward pass (cotangent-independent by construction) and
+    applies the general cotangent only in the grads pass — a weighted loss
+    pins that the split is correct for g != 1."""
+    theta, beta, x, rm, rv = make_inputs(10, 6, 300)
+    w = jnp.asarray(np.linspace(0.1, 2.0, 10), jnp.float32)
+
+    def loss_fused(th, be):
+        rl, _, _ = prodlda_recon_loss(
+            th, be, x, rm, rv, None, True, 1e-5, 1e-10, True
+        )
+        return jnp.sum(rl * w)
+
+    def loss_ref(th, be):
+        rl, _, _ = prodlda_recon_loss_reference(th, be, x, rm, rv, None, True)
+        return jnp.sum(rl * w)
+
+    gf_t, gf_b = jax.grad(loss_fused, argnums=(0, 1))(theta, beta)
+    gr_t, gr_b = jax.grad(loss_ref, argnums=(0, 1))(theta, beta)
+    np.testing.assert_allclose(gf_t, gr_t, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gf_b, gr_b, rtol=1e-4, atol=1e-4)
+
+
 def test_stats_have_no_gradient_path():
     theta, beta, x, rm, rv = make_inputs(8, 4, 130)
 
