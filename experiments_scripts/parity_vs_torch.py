@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import sys
 import time
@@ -151,7 +152,14 @@ def main() -> None:
         )
         arm_t = {
             "wall_s": round(wall_t, 2),
-            "best_train_loss": round(loss_t, 2) if loss_t else None,
+            # None unless finite: the reference's best_loss_train can stay
+            # at its float('inf') sentinel, and json.dump would emit bare
+            # `Infinity` — invalid JSON for strict consumers.
+            "best_train_loss": (
+                round(loss_t, 2)
+                if loss_t is not None and math.isfinite(loss_t)
+                else None
+            ),
             "device": "cpu-1core", **score(topics_t, corpus_tokens),
         }
 

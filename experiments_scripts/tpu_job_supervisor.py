@@ -1,0 +1,151 @@
+"""TPU job supervisor: waits for the (single-tenant, tunnel-backed) chip
+to answer, then runs a serial job queue, each job under an I/O-stall
+watchdog.
+
+Why this exists: the axon TPU tunnel can hang any device call
+indefinitely — observed as a training process with /proc/<pid>/io counters
+flat for 30+ minutes while its main thread sleeps in the plugin's re-dial
+loop — and a killed client appears to hold the chip's lease for a while.
+Recovery therefore needs (a) kill-on-I/O-stall rather than wall-clock
+timeouts alone (a healthy long run also looks quiet on CPU), (b) probe
+with long backoff before relaunching, and (c) jobs that are cheap to
+relaunch — run_simulation checkpoints per iteration for exactly this
+(gfedntm_tpu/experiments/dss_tss.py).
+
+Usage: python experiments_scripts/tpu_job_supervisor.py  (edit `jobs`).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = "/root/repo"
+LOG = open("/tmp/supervisor.log", "a", buffering=1)
+STALL_S = 600
+PROBE_CMD = [sys.executable, "-c", "import jax; print(jax.default_backend())"]
+
+
+def log(msg):
+    LOG.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
+
+
+def probe_tpu(timeout=150):
+    try:
+        out = subprocess.run(
+            PROBE_CMD, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO,
+        )
+        # "axon" is this image's tunnel plugin name; a standard TPU VM
+        # reports "tpu".
+        return out.returncode == 0 and (
+            "axon" in out.stdout or "tpu" in out.stdout
+        )
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def wait_for_tpu(max_wait_s=3 * 3600):
+    t0 = time.time()
+    attempt = 0
+    while time.time() - t0 < max_wait_s:
+        attempt += 1
+        if probe_tpu():
+            log(f"tunnel up after {time.time() - t0:.0f}s "
+                f"({attempt} probes)")
+            return True
+        log(f"probe {attempt} failed ({time.time() - t0:.0f}s elapsed)")
+        time.sleep(180)
+    return False
+
+
+def _kill_group(proc):
+    """Kill the job's whole process group (see start_new_session below),
+    then reap it."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    proc.wait()
+
+
+def io_bytes(pid):
+    try:
+        with open(f"/proc/{pid}/io") as f:
+            d = dict(
+                line.strip().split(": ") for line in f if ": " in line
+            )
+        return int(d["rchar"]) + int(d["wchar"])
+    except OSError:
+        return None
+
+
+def run_watched(name, cmd, job_timeout, attempts=6):
+    for att in range(1, attempts + 1):
+        log(f"{name}: attempt {att}: {' '.join(cmd)}")
+        with open(f"/tmp/q_{name}.log", "ab") as out:
+            # Own session/process group: a stall kill must also take down
+            # the job's own subprocesses (bench.py probes the backend and
+            # runs its phases in children; a killed parent would otherwise
+            # leave a child holding the single-tenant chip).
+            proc = subprocess.Popen(
+                cmd, stdout=out, stderr=out, cwd=REPO,
+                start_new_session=True,
+            )
+        t0 = time.time()
+        last_io, last_change = io_bytes(proc.pid), time.time()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    log(f"{name}: done in {time.time() - t0:.0f}s")
+                    return True
+                log(f"{name}: rc={rc} after {time.time() - t0:.0f}s")
+                break
+            now = time.time()
+            cur = io_bytes(proc.pid)
+            if cur is not None and cur != last_io:
+                last_io, last_change = cur, now
+            if now - last_change > STALL_S:
+                log(f"{name}: I/O flat {STALL_S}s -> kill (stall)")
+                _kill_group(proc)
+                break
+            if now - t0 > job_timeout:
+                log(f"{name}: exceeded {job_timeout}s -> kill")
+                _kill_group(proc)
+                break
+            time.sleep(20)
+        if att < attempts:
+            if not wait_for_tpu():
+                log(f"{name}: tunnel never recovered; giving up")
+                return False
+    log(f"{name}: FAILED after {attempts} attempts")
+    return False
+
+
+def main():
+    log("=== supervisor start ===")
+    if not wait_for_tpu():
+        log("tunnel never came up; aborting")
+        sys.exit(1)
+    py = sys.executable
+    jobs = [
+        ("envelope",
+         [py, "experiments_scripts/run_dss_tss_envelope.py", "5"],
+         6 * 3600, 10),
+        ("soak", [py, "experiments_scripts/soak_fused_kernel.py"],
+         2400, 4),
+        ("parity", [py, "experiments_scripts/parity_vs_torch.py"],
+         3600, 3),
+        ("noniid", [py, "experiments_scripts/run_noniid_full.py"],
+         3600, 3),
+        ("bench", [py, "bench.py"], 1500, 2),
+    ]
+    results = {}
+    for name, cmd, jt, attempts in jobs:
+        results[name] = run_watched(name, cmd, jt, attempts)
+    log(f"=== supervisor done: {results} ===")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,15 @@ from gfedntm_tpu.models.avitm import AVITM
 logger = logging.getLogger(__name__)
 
 
+def _jax_backend_name() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 - metadata only
+        return "unknown"
+
+
 @dataclass
 class SimulationConfig:
     """Mirror of the reference's ``config.json`` schema
@@ -278,6 +287,7 @@ def run_simulation(
         for arm in arms for stat in stats for agg in ("mean", "std")
     }
     t_start = time.perf_counter()
+    iter_backends: list[str] = []
 
     for point in sweep:
         point_cfg = SimulationConfig(**{**cfg.__dict__})
@@ -324,11 +334,16 @@ def run_simulation(
                 res = run_iter_simulation(
                     point_cfg, seed=cfg.seed + 1000 * it
                 )
+                # Per-iteration provenance: a resumed sweep may aggregate
+                # checkpoints produced on a different backend (each is a
+                # legitimate sample of the same seeded experiment).
+                res["_backend"] = _jax_backend_name()
                 if ckpt is not None:
                     tmp = ckpt.with_suffix(".tmp")
                     with open(tmp, "w", encoding="utf8") as f:
                         json.dump(res, f)
                     tmp.rename(ckpt)
+            iter_backends.append(res.get("_backend", "unknown"))
             for arm in arms:
                 for stat in stats:
                     per_iter[arm][stat].append(res[arm][stat])
@@ -338,12 +353,7 @@ def run_simulation(
                 columns[f"{arm}_{stat}_mean"].append(float(vals.mean()))
                 columns[f"{arm}_{stat}_std"].append(float(vals.std()))
 
-    try:
-        import jax
-
-        backend = jax.default_backend()
-    except Exception:  # noqa: BLE001 - metadata only
-        backend = "unknown"
+    backend = _jax_backend_name()
     out = {
         "index": sweep,
         "index_name": index_name,
@@ -352,6 +362,9 @@ def run_simulation(
         # was produced, not just what the numbers are).
         "meta": {
             "backend": backend,
+            # Which backend actually produced each aggregated iteration
+            # (checkpointed iterations may predate this process).
+            "iter_backends": iter_backends,
             "iters": cfg.iters,
             "seed": cfg.seed,
             "experiment": cfg.experiment,
