@@ -129,6 +129,132 @@ class TMWrapper:
         logger.info("trained %s (%s) in %.1fs", name, model_type, elapsed)
         return model, model_dir
 
+    # ---- hierarchical training (`tm_wrapper.py:278-357`) -------------------
+    def train_htm_submodel(
+        self,
+        version: str,
+        father_model: Any,
+        father_dir: str | Path,
+        corpus: Sequence[str],
+        name: str,
+        expansion_topic: int,
+        thr: float | None = None,
+        model_type: str = "avitm",
+        n_topics: int = 10,
+        model_kwargs: dict[str, Any] | None = None,
+    ) -> tuple[Any, Path, list[str]]:
+        """Train a second-level (child) model under a father model's folder.
+
+        The reference's ``train_htm_submodel`` (`tm_wrapper.py:298-357`)
+        delegates child-corpus construction to the external ``topicmodeler``
+        submodule (not vendored in the reference repo) via
+        ``topicmodeling.py --hierarchical``; the two HTM versions it selects
+        are implemented natively here:
+
+        - **HTM-WS** (word selection): each word occurrence in each document
+          is assigned to its most responsible father topic
+          (``argmax_k theta[d,k] * beta[k,w]``); the child corpus keeps, per
+          document, only the words assigned to ``expansion_topic``.
+          Documents left empty are dropped.
+        - **HTM-DS** (document selection): the child corpus keeps the full
+          text of documents whose father doc-topic weight on
+          ``expansion_topic`` exceeds ``thr`` (default ``1/K_father``).
+
+        The child model trains on the reduced corpus with its own fitted
+        vocabulary and is saved under ``father_dir/name`` with a
+        ``config.json`` recording ``hierarchy_level=1``, the HTM version,
+        the expansion topic and the threshold (reference
+        ``_get_model_config(hierarchy_level=1, ...)``,
+        `tm_wrapper.py:331-341`).
+
+        Returns ``(child_model, child_dir, child_corpus)``.
+        """
+        version = version.upper()
+        if version not in ("HTM-WS", "HTM-DS"):
+            raise ValueError(
+                f"version must be 'HTM-WS' or 'HTM-DS', got {version!r}"
+            )
+        corpus = list(corpus)
+        k_father = father_model.n_components
+
+        # Father posteriors over ITS OWN training vocabulary: re-prepare the
+        # corpus (prepare_dataset is deterministic: 75/25 split seed 42,
+        # CountVectorizer vocab) so beta columns align with token ids.
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.data.vocab import vectorize
+
+        _tr, _va, _size, id2token, _docs, vocab = prepare_dataset(corpus)
+        bow = vectorize(corpus, vocab)
+        data = BowDataset(X=bow, idx2token=id2token)
+        thetas = np.asarray(father_model.get_doc_topic_distribution(data))
+        betas = np.asarray(father_model.get_topic_word_distribution())
+        if betas.shape[1] != bow.shape[1]:
+            raise ValueError(
+                f"corpus re-vectorizes to {bow.shape[1]} tokens but the "
+                f"father model was trained on {betas.shape[1]} — pass the "
+                "father's training corpus"
+            )
+
+        if version == "HTM-DS":
+            thr = (1.0 / k_father) if thr is None else float(thr)
+            keep = thetas[:, expansion_topic] > thr
+            child_corpus = [corpus[i] for i in np.flatnonzero(keep)]
+        else:  # HTM-WS
+            tokens = [id2token[j] for j in range(len(id2token))]
+            child_corpus = []
+            for d in range(bow.shape[0]):
+                present = np.flatnonzero(bow[d] > 0)
+                if present.size == 0:
+                    continue
+                # responsibility argmax over father topics, per present word
+                resp = thetas[d][:, None] * betas[:, present]  # [K, n_w]
+                assigned = present[resp.argmax(axis=0) == expansion_topic]
+                if assigned.size == 0:
+                    continue
+                counts = bow[d, assigned].astype(int)
+                child_corpus.append(
+                    " ".join(
+                        " ".join([tokens[w]] * c)
+                        for w, c in zip(assigned, counts)
+                    )
+                )
+        if len(child_corpus) < 8:
+            raise ValueError(
+                f"{version} selected only {len(child_corpus)} documents for "
+                f"topic {expansion_topic} (thr={thr}) — not enough to train "
+                "a child model"
+            )
+
+        # Child folder lives inside the father's folder; train_model's
+        # _prepare_model_dir supplies the reference backup semantics
+        # (`tm_wrapper.py:332-346`).
+        father_dir = Path(father_dir)
+        child_wrapper = TMWrapper(father_dir)
+        child_model, child_dir = child_wrapper.train_model(
+            name, child_corpus, model_type=model_type, n_topics=n_topics,
+            model_kwargs=model_kwargs,
+        )
+        hier_config = {
+            "trainer": model_type,
+            "TMparam": {
+                k: v for k, v in (model_kwargs or {}).items()
+                if isinstance(v, (int, float, str, bool, list, tuple))
+            },
+            "hierarchy_level": 1,
+            "htm_version": version,
+            "expansion_tpc": int(expansion_topic),
+            "thr": thr,
+            "father_model": str(father_dir),
+            "n_child_docs": len(child_corpus),
+        }
+        with open(child_dir / "config.json", "w", encoding="utf8") as f:
+            json.dump(hier_config, f, indent=2)
+        logger.info(
+            "trained %s child %s on %d docs (topic %d)",
+            version, name, len(child_corpus), expansion_topic,
+        )
+        return child_model, child_dir, child_corpus
+
     # ---- metrics (`tm_wrapper.py:358-400`) ---------------------------------
     def evaluate_model(
         self,

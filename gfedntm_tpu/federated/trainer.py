@@ -442,9 +442,14 @@ class FederatedTrainer:
             model.train_data = dataset
         return model
 
-    def make_global_model(self, result: FederatedResult) -> AVITM:
+    def make_global_model(self, result: FederatedResult,
+                          dataset: BowDataset | None = None) -> AVITM:
         """Server's view: the aggregated model (``get_topics_in_server``,
-        ``federated_model.py:183-197``)."""
+        ``federated_model.py:183-197``). Pass any consensus-vectorized
+        ``dataset`` so ``get_topics`` resolves token names from its
+        ``idx2token`` (the reference server holds the global vocabulary and
+        returns real tokens, ``server.py:270-288``); without one, topics
+        fall back to index strings."""
         import copy
 
         model = copy.copy(self.template)
@@ -453,4 +458,6 @@ class FederatedTrainer:
             lambda leaf: jnp.asarray(leaf[0]), result.client_batch_stats
         )
         model.best_components = np.asarray(model.params["beta"])
+        if dataset is not None:
+            model.train_data = dataset
         return model

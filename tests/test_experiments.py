@@ -164,6 +164,55 @@ class TestTMWrapper:
             wrapper.train_model("ctm", ["a b c"] * 8, model_type="zeroshot")
 
     @pytest.mark.slow
+    @pytest.mark.parametrize("version", ["HTM-WS", "HTM-DS"])
+    def test_train_htm_submodel(self, tmp_path, version):
+        """Hierarchical second-level training (ref tm_wrapper.py:298-357):
+        father on the full corpus, child on the topic-restricted
+        subcorpus, saved inside the father's folder with hierarchy
+        metadata."""
+        docs = synthetic_docs(n_docs=40)
+        wrapper = TMWrapper(tmp_path)
+        kwargs = dict(hidden_sizes=(16, 16), num_epochs=2, batch_size=8)
+        father, father_dir = wrapper.train_model(
+            "father", docs, model_type="avitm", n_topics=3,
+            model_kwargs=kwargs,
+        )
+        child, child_dir, child_corpus = wrapper.train_htm_submodel(
+            version=version,
+            father_model=father,
+            father_dir=father_dir,
+            corpus=docs,
+            name="child0",
+            expansion_topic=0,
+            thr=0.05 if version == "HTM-DS" else None,
+            model_type="avitm",
+            n_topics=2,
+            model_kwargs=kwargs,
+        )
+        assert child_dir == father_dir / "child0"
+        cfgd = json.loads((child_dir / "config.json").read_text())
+        assert cfgd["hierarchy_level"] == 1
+        assert cfgd["htm_version"] == version
+        assert cfgd["expansion_tpc"] == 0
+        assert cfgd["n_child_docs"] == len(child_corpus)
+        # child corpus is a strict reduction of the father corpus
+        assert 0 < len(child_corpus) <= len(docs)
+        if version == "HTM-WS":
+            # word selection shrinks documents, not just the doc set
+            assert sum(len(d.split()) for d in child_corpus) < sum(
+                len(d.split()) for d in docs
+            )
+        assert len(child.get_topics(5)) == 2
+
+    def test_htm_submodel_rejects_bad_version(self, tmp_path):
+        wrapper = TMWrapper(tmp_path)
+        with pytest.raises(ValueError, match="HTM-WS"):
+            wrapper.train_htm_submodel(
+                version="HTM-XX", father_model=None, father_dir=tmp_path,
+                corpus=["a b"] * 8, name="c", expansion_topic=0,
+            )
+
+    @pytest.mark.slow
     def test_train_zeroshot_ctm(self, tmp_path):
         docs = synthetic_docs(n_docs=24)
         emb = np.random.default_rng(0).normal(
