@@ -212,15 +212,33 @@ def run(backend: str) -> dict:
     )
     metrics.close()
 
+    # Headline ratio (VERDICT r3 Weak #5): vs_baseline is the measured
+    # torch-AVITM compute baseline — beating the reference's >=3 s-sleep
+    # orchestration floor is table stakes, not the story; it stays as
+    # context under vs_orchestration_floor. If the torch baseline is
+    # unavailable entirely, the floor ratio is reported with an explicit
+    # label so the headline is never silently the easy comparison.
+    vs_torch = (
+        round(docs_per_sec / torch_docs_per_sec, 2)
+        if torch_docs_per_sec else None
+    )
     return {
         "metric": "federated_prodlda_5client_throughput",
         "value": round(docs_per_sec, 1),
         "unit": "docs/s",
-        "vs_baseline": round(docs_per_sec / baseline_docs_per_sec, 1),
-        "vs_torch_cpu": (
-            round(docs_per_sec / torch_docs_per_sec, 2)
-            if torch_docs_per_sec
-            else None
+        "vs_baseline": (
+            vs_torch if vs_torch is not None
+            else round(docs_per_sec / baseline_docs_per_sec, 1)
+        ),
+        "baseline_definition": (
+            "reference torch AVITM (same regime, this host, "
+            f"{torch_src})" if vs_torch is not None
+            else "reference >=3s-sleep orchestration floor (torch "
+            "baseline unavailable)"
+        ),
+        "vs_torch_cpu": vs_torch,
+        "vs_orchestration_floor": round(
+            docs_per_sec / baseline_docs_per_sec, 1
         ),
         "torch_cpu_docs_per_s": torch_docs_per_sec,
         "torch_baseline_source": torch_src,
@@ -243,6 +261,14 @@ def run(backend: str) -> dict:
             flops_per_step / (program_step_ms / 1e3) / 1e9, 1
         ),
         "mfu_vs_bf16_peak": round(mfu, 4),
+        # Regime-normalized trend metric (VERDICT r3 Weak #6): the CPU
+        # fallback shrinks docs/epochs, so end-to-end docs/s is not
+        # comparable across rounds with different backends. Per-step
+        # program throughput has the same (V, K, B, C) work regardless of
+        # corpus size or epochs — THIS is the cross-round trend line.
+        "program_docs_per_s_normalized": round(
+            n_clients * batch / (program_step_ms / 1e3), 1
+        ),
         "profile_trace_dir": trace_dir,
         "compile_and_first_run_s": round(compile_s, 1),
         "steady_state_s": round(steady_s, 1),

@@ -1,0 +1,84 @@
+"""Summarize a jax.profiler trace: where does the step time go?
+
+VERDICT r3 task 1c: the committed TPU trace must come with an accounting
+of the ~per-step milliseconds. This reads the TensorBoard-format trace
+(`plugins/profile/<run>/*.trace.json.gz`, Chrome trace events) written by
+``jax.profiler.trace`` (bench.py wires it via BENCH_TRACE_DIR /
+results/profile_trace) and aggregates wall time by event name, separating
+device compute streams from host threads, so the top entries answer
+"dispatch overhead or math?" directly.
+
+Usage: python experiments_scripts/analyze_trace.py <trace_dir> [top_n]
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_events(trace_dir: str) -> tuple[list[dict], dict]:
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ) + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                  recursive=True)
+    if not paths:
+        raise SystemExit(f"no trace files under {trace_dir}")
+    path = max(paths, key=os.path.getsize)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    # pid -> process name (device streams vs host threads)
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e.get("args", {}).get("name", str(e["pid"]))
+    return events, pids
+
+
+def summarize(trace_dir: str, top_n: int = 20) -> dict:
+    events, pids = load_events(trace_dir)
+    by_bucket: dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter
+    )
+    span = [float("inf"), 0.0]
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        pname = pids.get(e.get("pid"), "?").lower()
+        bucket = (
+            "device"
+            if any(s in pname for s in ("tpu", "gpu", "stream", "xla", "/device"))
+            else "host"
+        )
+        by_bucket[bucket][e.get("name", "?")] += e["dur"]
+        ts = e.get("ts", 0.0)
+        span[0] = min(span[0], ts)
+        span[1] = max(span[1], ts + e["dur"])
+    out = {
+        "trace_dir": trace_dir,
+        "wall_span_ms": round((span[1] - span[0]) / 1e3, 3),
+        "processes": sorted(set(pids.values())),
+    }
+    for bucket, counter in by_bucket.items():
+        total = sum(counter.values())
+        out[bucket] = {
+            "total_ms": round(total / 1e3, 3),
+            "top": [
+                {"name": n[:120], "ms": round(d / 1e3, 3),
+                 "pct": round(100.0 * d / max(total, 1), 1)}
+                for n, d in counter.most_common(top_n)
+            ],
+        }
+    return out
+
+
+if __name__ == "__main__":
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "results/profile_trace"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    print(json.dumps(summarize(trace_dir, top_n), indent=2))

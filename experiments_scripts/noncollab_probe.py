@@ -140,7 +140,7 @@ def main(out_path: str | None = None) -> dict:
 
     # --- Arm A: unmodified reference implementation -----------------------
     t0 = time.time()
-    from src.models.base.pytorchavitm.avitm_network.avitm import AVITM as TorchAVITM
+    from torch_baseline import make_reference_avitm
     from src.models.base.pytorchavitm.datasets.bow_dataset import BOWDataset
     from src.models.base.pytorchavitm.utils.data_preparation import (
         prepare_dataset as torch_prepare_dataset,
@@ -151,14 +151,8 @@ def main(out_path: str | None = None) -> dict:
     docs_tok = [d.split() for d in node0_docs]
     train_data, val_data, input_size, id2token, _docs, cv = \
         torch_prepare_dataset(docs_tok)
-    model = TorchAVITM(
-        logger=logging.getLogger("torch-avitm"), input_size=input_size,
-        n_components=cfg.n_topics, model_type="prodLDA",
-        hidden_sizes=(100, 100), activation="softplus", dropout=0.2,
-        learn_priors=True, batch_size=64, lr=2e-3, momentum=0.99,
-        solver="adam", num_epochs=100, reduce_on_plateau=False,
-        topic_prior_mean=0.0, topic_prior_variance=None, num_samples=20,
-        num_data_loader_workers=0, verbose=False,
+    model = make_reference_avitm(
+        input_size=input_size, n_components=cfg.n_topics, num_epochs=100,
     )
     model.fit(train_data, val_data)
     epochs_ran_torch = model.nn_epoch + 1

@@ -1,0 +1,112 @@
+"""Committed artifacts for BASELINE configs 2 and 4 (VERDICT r3 task 5).
+
+Configs 2 (NeuralLDA, 2-client IID) and 4 (CombinedTM + contextual
+embeddings, 5-client) have run inside tests since round 2
+(`tests/test_presets.py`, `tests/test_federation_net.py:192-231`) but had
+no committed metrics artifact the way config 5 has
+`results/noniid_fos_full/`. This runs both presets at scale=1.0 and
+commits, per config: the federation summary (clients, vocab, steps, final
+loss), ground-truth TSS of the aggregated global model (the corpora are
+synthetic, so recovery against the generator's topic_vectors is the
+honest quality metric — single softmax, correct word mapping), and
+topic diversity. Reference regime: CTM 5-client is the shipped default
+(`/root/reference/docker-compose.yaml:21-157`).
+
+Usage: python experiments_scripts/run_presets_24.py [out_json]
+Writes results/presets_24/metrics.json (default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(out_path: str | None = None) -> dict:
+    import jax
+
+    if os.environ.get("FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gfedntm_tpu.eval.metrics import (
+        convert_topic_word_to_init_size,
+        topic_diversity,
+        topic_similarity_score,
+    )
+    from gfedntm_tpu.presets import combinedtm_5client, neurallda_2client_iid
+
+    def softmax_rows(a):
+        e = np.exp(a - a.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    def quality(res) -> dict:
+        gt = res.extras["ground_truth"]
+        consensus = res.extras["consensus"]
+        id2token = consensus.global_vocab.id2token
+        model = res.trainer.make_global_model(
+            res.result, dataset=consensus.datasets[0]
+        )
+        beta = softmax_rows(np.asarray(model.params["beta"]))
+        beta_full = convert_topic_word_to_init_size(
+            gt.topic_vectors.shape[1], beta, id2token
+        )
+        tss = topic_similarity_score(beta_full, gt.topic_vectors)
+        k = beta.shape[0]
+        rand_tss = float(
+            topic_similarity_score(
+                np.random.default_rng(99).dirichlet(
+                    np.full(gt.topic_vectors.shape[1], 0.01), k
+                ),
+                gt.topic_vectors,
+            )
+        )
+        topics = model.get_topics(10)
+        return {
+            "tss_vs_ground_truth": round(float(tss), 4),
+            "tss_max": k,
+            "tss_random_floor": round(rand_tss, 4),
+            "topic_diversity": round(topic_diversity(topics, topn=10), 4),
+            "topics_top10": topics,
+        }
+
+    report: dict = {"backend": None, "configs": {}}
+    t0 = time.perf_counter()
+    res2 = neurallda_2client_iid(scale=1.0)
+    report["configs"]["config2_neurallda_2client_iid"] = {
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "summary": res2.summary,
+        **quality(res2),
+    }
+    print("config 2 done", flush=True)
+
+    t0 = time.perf_counter()
+    res4 = combinedtm_5client(scale=1.0)
+    report["configs"]["config4_combinedtm_5client"] = {
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "summary": res4.summary,
+        "embedder": "deterministic hashing stand-in, 768-d (SBERT needs "
+                    "network egress; the CTM contextual path is identical)",
+        **quality(res4),
+    }
+    report["backend"] = jax.default_backend()
+
+    out_path = out_path or os.path.join(
+        REPO_ROOT, "results", "presets_24", "metrics.json"
+    )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", encoding="utf8") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(
+        {c: {k: v for k, v in d.items() if k != "topics_top10"}
+         for c, d in report["configs"].items()}, indent=2))
+    return report
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -28,22 +28,32 @@ def main() -> None:
     from bench import bench_fused_largev
 
     backend = jax.default_backend()
-    table = bench_fused_largev(backend, v_list=(16384, 50_000, 100_000))
+    # Baseline rows must be measured (and labeled) at the default tiling:
+    # clear any operator-set GFEDNTM_FUSED_TILE_V for the baseline, record
+    # what was cleared, and restore the operator's value when done (ADVICE
+    # r3: a pre-existing override would silently relabel the baseline).
+    prior_tile = os.environ.pop("GFEDNTM_FUSED_TILE_V", None)
+    try:
+        table = bench_fused_largev(backend, v_list=(16384, 50_000, 100_000))
 
-    # Tile-width sweep (GFEDNTM_FUSED_TILE_V) on the cases where the default
-    # 2048-wide tile historically only broke even: wider tiles amortize grid
-    # overhead at the cost of more VMEM per step. bench_fused_largev builds
-    # fresh jitted closures per call, so the env knob takes effect per run.
-    tile_sweep: dict[str, dict] = {}
-    sweep_cases = [(50_000, 64), (100_000, 256)]
-    for tile in (4096, 8192):
-        os.environ["GFEDNTM_FUSED_TILE_V"] = str(tile)
-        try:
-            tile_sweep[f"tile{tile}"] = bench_fused_largev(
-                backend, cases=sweep_cases
-            )
-        finally:
-            del os.environ["GFEDNTM_FUSED_TILE_V"]
+        # Tile-width sweep (GFEDNTM_FUSED_TILE_V) on the cases where the
+        # default 2048-wide tile historically only broke even: wider tiles
+        # amortize grid overhead at the cost of more VMEM per step.
+        # bench_fused_largev builds fresh jitted closures per call, so the
+        # env knob takes effect per run.
+        tile_sweep: dict[str, dict] = {}
+        sweep_cases = [(50_000, 64), (100_000, 256)]
+        for tile in (4096, 8192):
+            os.environ["GFEDNTM_FUSED_TILE_V"] = str(tile)
+            try:
+                tile_sweep[f"tile{tile}"] = bench_fused_largev(
+                    backend, cases=sweep_cases
+                )
+            finally:
+                del os.environ["GFEDNTM_FUSED_TILE_V"]
+    finally:
+        if prior_tile is not None:
+            os.environ["GFEDNTM_FUSED_TILE_V"] = prior_tile
 
     def _parse(key: str) -> tuple[int, int]:
         v, b = key[1:].split("_B")
@@ -59,6 +69,8 @@ def main() -> None:
     ]
     report = {
         "backend": backend,
+        "baseline_tile_v": 2048,
+        "cleared_operator_tile_override": prior_tile,
         "table": table,
         "tile_sweep": tile_sweep,
         "all_parity": all(r["parity"] for r in table.values()),

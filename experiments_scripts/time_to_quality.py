@@ -1,0 +1,366 @@
+"""North-star time-to-quality: wall-clock to a TSS target, this framework's
+federated TPU path vs the reference's own torch AVITM on the same corpus.
+
+BASELINE.json's metric is "5-client federated ProdLDA reaches PyTorch NPMI
+in <= 1/4 the wall-clock". Its corpus (20Newsgroups) needs network egress
+this environment lacks (no offline snapshot exists on the machine — checked
+sklearn/HF caches), so per VERDICT r3 task 3 the committed substitute is
+the reference's own published evaluation regime: the V=5000 / K=50 / 5-node
+synthetic corpus (`experiments/dss_tss/config/eta_variable/config.json`,
+scaled to 2000 train docs/node so the torch arm finishes on one CPU core),
+with quality measured as ground-truth TSS — the reference's de-facto
+correctness metric — under the CORRECT word mapping and a single softmax
+(the envelope's double-softmax + off-by-one replication exists only for
+comparability with the reference's published pickles; a quality target
+should not inherit scoring bugs).
+
+Arms (same generated corpus, same TSS scorer):
+
+- **torch**: the unmodified reference AVITM (imported from /root/reference)
+  trained centrally on the union corpus — its compute-only best case (its
+  real federated path adds >=3 s sleeps per round on top of exactly this
+  compute, `src/federation/server.py:417-420`). Betas are snapshotted per
+  epoch with their wall-clock timestamps by wrapping _train_epoch.
+- **gfedntm_tpu**: the flagship 5-client federated SPMD trainer, betas
+  snapshotted per global epoch via fit(segment_callback=...) between
+  compiled segments. Wall-clock for this arm is the in-fit time (staging +
+  compile excluded the same way the torch arm's dataset prep is excluded;
+  compile time is reported separately in the artifact).
+
+Both arms then report time_to(T) for a ladder of absolute TSS targets
+derived from the joint plateau, and the headline ratio
+torch_time / tpu_time at the 95%-of-joint-plateau target.
+
+Usage: python experiments_scripts/time_to_quality.py [out_json]
+Writes results/time_to_quality/metrics.json (default).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+REFERENCE_ROOT = "/root/reference"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES, VOCAB, K, DOCS_PER_NODE = 5, 5000, 50, 2000
+ETA, ALPHA, FROZEN = 0.01, 0.1, 5
+EPOCHS = 100
+SEED = 0
+
+
+def softmax_rows(a):
+    import numpy as np
+
+    e = np.exp(a - a.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def main(out_path: str | None = None) -> dict:
+    logging.basicConfig(level=logging.INFO, force=True)
+    sys.path.insert(0, REPO_ROOT)
+    sys.path.insert(0, REFERENCE_ROOT)
+    import numpy as np
+
+    if not hasattr(np, "Inf"):
+        np.Inf = np.inf
+
+    import jax
+
+    # FORCE_CPU must be honoured BEFORE any backend query:
+    # jax.default_backend() initializes the platform, and on a dead TPU
+    # tunnel that call blocks forever in the plugin's re-dial loop.
+    if os.environ.get("FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+    from gfedntm_tpu.eval.metrics import (
+        convert_topic_word_to_init_size,
+        topic_similarity_score,
+    )
+
+    t0 = time.perf_counter()
+    corpus = generate_synthetic_corpus(
+        vocab_size=VOCAB, n_topics=K, beta=ETA, alpha=ALPHA,
+        n_docs=DOCS_PER_NODE, nwords=(150, 250), n_nodes=N_NODES,
+        frozen_topics=FROZEN, seed=SEED,
+    )
+    topic_vectors = corpus.topic_vectors
+    gen_s = time.perf_counter() - t0
+
+    def tss_of(beta_logits_or_dist, id2token, already_dist=False):
+        b = (
+            np.asarray(beta_logits_or_dist)
+            if already_dist
+            else softmax_rows(np.asarray(beta_logits_or_dist))
+        )
+        full = convert_topic_word_to_init_size(VOCAB, b, id2token)
+        return topic_similarity_score(full, topic_vectors)
+
+    # ---- torch arm -------------------------------------------------------
+    import torch
+
+    from torch_baseline import make_reference_avitm
+
+    from src.models.base.pytorchavitm.avitm_network.avitm import (
+        AVITM as TorchAVITM,
+    )
+    from src.models.base.pytorchavitm.utils.data_preparation import (
+        prepare_dataset as torch_prepare_dataset,
+    )
+
+    torch.manual_seed(SEED)
+    union_docs = [
+        d.split() for node in corpus.nodes for d in node.documents
+    ]
+    train_data, val_data, input_size, t_id2token, _docs, _cv = (
+        torch_prepare_dataset(union_docs)
+    )
+    model = make_reference_avitm(
+        input_size=input_size, n_components=K, num_epochs=EPOCHS,
+    )
+    torch_snaps: list[tuple[float, np.ndarray]] = []
+    orig_train_epoch = TorchAVITM._train_epoch
+
+    def snap_train_epoch(self, loader):
+        out = orig_train_epoch(self, loader)
+        torch_snaps.append(
+            (time.perf_counter(),
+             self.model.beta.detach().cpu().numpy().copy())
+        )
+        return out
+
+    TorchAVITM._train_epoch = snap_train_epoch
+    try:
+        t_start = time.perf_counter()
+        # No validation set -> no early stopping: the curve must extend to
+        # the plateau so targets near it are reachable by both arms.
+        model.fit(train_data, None)
+    finally:
+        TorchAVITM._train_epoch = orig_train_epoch
+    torch_curve = [
+        {"wall_s": round(ts - t_start, 2),
+         "tss": round(tss_of(beta, t_id2token), 4)}
+        for ts, beta in torch_snaps
+    ]
+    print(f"torch arm: {len(torch_curve)} epochs, "
+          f"final TSS {torch_curve[-1]['tss']}", flush=True)
+
+    # ---- torch FEDERATED arm (the north-star's own algorithm) -----------
+    # The reference's federated semantics without its orchestration: per
+    # global step each client runs one minibatch fwd/bwd/Adam step
+    # (federated_avitm.py:51-83, driven through the reference AVITM's own
+    # model/_loss/optimizer), then the full state dict is averaged across
+    # clients and written back (server.py:476-487 + dft_params.cf:50 names
+    # the entire state dict). This is the reference's COMPUTE floor — its
+    # shipped stack adds >=3 s sleep x N clients + 2N fresh-channel gRPC
+    # round-trips per step on top (BASELINE.md).
+    from src.models.base.pytorchavitm.datasets.bow_dataset import BOWDataset
+    from torch.utils.data import DataLoader
+
+    torch.manual_seed(SEED + 1)
+    idx2tok_arr = np.array([f"wd{i}" for i in range(VOCAB)])
+    fed_models, fed_iters, fed_loaders = [], [], []
+    for node in corpus.nodes:
+        m = make_reference_avitm(
+            input_size=VOCAB, n_components=K, num_epochs=EPOCHS,
+            logger_name="torch-fed",
+        )
+        loader = DataLoader(
+            BOWDataset(node.bow.astype(np.float32), idx2tok_arr),
+            batch_size=64, shuffle=True, num_workers=0,
+        )
+        fed_models.append(m)
+        fed_loaders.append(loader)
+        fed_iters.append(iter(loader))
+    steps_per_epoch_t = -(-DOCS_PER_NODE // 64)
+    total_fed_steps = EPOCHS * steps_per_epoch_t
+    torch_fed_snaps: list[tuple[float, np.ndarray]] = []
+    tf_start = time.perf_counter()
+    for step in range(total_fed_steps):
+        for c, m in enumerate(fed_models):
+            try:
+                batch = next(fed_iters[c])
+            except StopIteration:
+                fed_iters[c] = iter(fed_loaders[c])
+                batch = next(fed_iters[c])
+            X = batch["X"].float()
+            m.model.zero_grad()
+            pm, pv, qm, qv, qlv, wd = m.model(X)
+            loss = m._loss(X, wd, pm, pv, qm, qv, qlv)
+            loss.backward()
+            m.optimizer.step()
+        sds = [m.model.state_dict() for m in fed_models]
+        avg = {
+            k: (
+                torch.stack([sd[k].float() for sd in sds]).mean(0)
+                if torch.is_floating_point(sds[0][k]) else sds[0][k]
+            )
+            for k in sds[0]
+        }
+        for m in fed_models:
+            m.model.load_state_dict(avg)
+        if (step + 1) % steps_per_epoch_t == 0:
+            torch_fed_snaps.append(
+                (time.perf_counter(),
+                 fed_models[0].model.beta.detach().cpu().numpy().copy())
+            )
+    t_id2tok_full = {i: f"wd{i}" for i in range(VOCAB)}
+    torch_fed_curve = [
+        {"wall_s": round(ts - tf_start, 2),
+         "tss": round(tss_of(beta, t_id2tok_full), 4)}
+        for ts, beta in torch_fed_snaps
+    ]
+    print(f"torch federated arm: {len(torch_fed_curve)} epochs, "
+          f"final TSS {torch_fed_curve[-1]['tss']}", flush=True)
+
+    # ---- gfedntm_tpu federated arm --------------------------------------
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+    from gfedntm_tpu.models.avitm import AVITM
+
+    idx2token = {i: f"wd{i}" for i in range(VOCAB)}
+    datasets = [
+        BowDataset(X=node.bow, idx2token=idx2token) for node in corpus.nodes
+    ]
+    template = AVITM(
+        input_size=VOCAB, n_components=K, hidden_sizes=(100, 100),
+        batch_size=64, num_epochs=EPOCHS, lr=2e-3, momentum=0.99, seed=SEED,
+    )
+    trainer = FederatedTrainer(template, n_clients=N_NODES)
+    steps_per_epoch = max(1, -(-DOCS_PER_NODE // template.batch_size))
+
+    jax_snaps: list[tuple[float, np.ndarray]] = []
+
+    def snap_segment(step, params, batch_stats):
+        jax_snaps.append(
+            (time.perf_counter(), np.asarray(params["beta"][0]).copy())
+        )
+
+    # Warmup fit (1 segment): stages data + compiles both segment shapes.
+    warm_template_epochs = template.num_epochs
+    template.num_epochs = 1
+    t0 = time.perf_counter()
+    trainer.fit(datasets)
+    compile_s = time.perf_counter() - t0
+    template.num_epochs = warm_template_epochs
+
+    j_start = time.perf_counter()
+    trainer.fit(
+        datasets, checkpoint_every=steps_per_epoch,
+        segment_callback=snap_segment,
+    )
+    jax_curve = [
+        {"wall_s": round(ts - j_start, 2),
+         "tss": round(tss_of(beta, idx2token), 4)}
+        for ts, beta in jax_snaps
+    ]
+    print(f"jax arm ({backend}): {len(jax_curve)} epochs, "
+          f"final TSS {jax_curve[-1]['tss']}", flush=True)
+
+    # ---- time-to-target ladder ------------------------------------------
+    # The north star compares like with like: the reference's federated
+    # algorithm (its compute floor) vs this framework's federated SPMD run
+    # — same FedAvg semantics, so the arms share a quality plateau and
+    # time-to-target is well-posed. The centralized torch curve stays in
+    # the artifact as context (centralized reaches a higher plateau than
+    # any FedAvg run on this non-IID split; that gap is the algorithm's,
+    # not the framework's).
+    plateau = min(torch_fed_curve[-1]["tss"], jax_curve[-1]["tss"])
+    baseline_tss = float(
+        topic_similarity_score(
+            np.random.default_rng(SEED + 9).dirichlet(
+                np.full(VOCAB, ETA), K
+            ),
+            topic_vectors,
+        )
+    )
+
+    def time_to(curve, target):
+        for p in curve:
+            if p["tss"] >= target:
+                return p["wall_s"]
+        return None
+
+    ladder = {}
+    for frac in (0.80, 0.90, 0.95, 0.99):
+        target = baseline_tss + frac * (plateau - baseline_tss)
+        ladder[f"{int(frac * 100)}pct"] = {
+            "target_tss": round(target, 4),
+            "torch_federated_s": time_to(torch_fed_curve, target),
+            "torch_centralized_s": time_to(torch_curve, target),
+            "gfedntm_tpu_s": time_to(jax_curve, target),
+        }
+    head = ladder["95pct"]
+    speedup = (
+        round(head["torch_federated_s"] / head["gfedntm_tpu_s"], 2)
+        if head["torch_federated_s"] and head["gfedntm_tpu_s"] else None
+    )
+    # The reference's SHIPPED federated stack pays >=3 s x N clients of
+    # sleeps + 2N fresh-channel gRPC round-trips per global step before any
+    # math (server.py:417-420,449,472,515) — the orchestration-inclusive
+    # wall-clock its users actually experience.
+    fed_95_steps = (
+        None if head["torch_federated_s"] is None else
+        int(round(head["torch_federated_s"] / max(
+            (torch_fed_curve[-1]["wall_s"]) / total_fed_steps, 1e-9)))
+    )
+    shipped_floor_s = (
+        None if fed_95_steps is None else round(fed_95_steps * 3.0 * N_NODES)
+    )
+
+    out = {
+        "metric": "wall_clock_to_tss_target",
+        "headline_speedup_at_95pct": speedup,
+        "headline_definition": (
+            "torch_federated_s / gfedntm_tpu_s at the 95%-of-joint-"
+            "federated-plateau TSS target (both arms run the reference's "
+            "FedAvg algorithm on the same corpus)"
+        ),
+        "north_star_target": (
+            ">= 4.0 (BASELINE.json: quality in <= 1/4 the wall-clock)"
+        ),
+        "reference_shipped_stack_floor_s_at_95pct": shipped_floor_s,
+        "backend": backend,
+        "regime": {
+            "n_nodes": N_NODES, "vocab": VOCAB, "k": K,
+            "docs_per_node": DOCS_PER_NODE, "eta": ETA, "alpha": ALPHA,
+            "frozen_topics": FROZEN, "epochs": EPOCHS, "seed": SEED,
+            "substitute_for": "20Newsgroups (no offline snapshot; no "
+                              "egress) — reference eval regime instead",
+            "corpus_gen_s": round(gen_s, 1),
+        },
+        "quality_metric": (
+            "TSS vs ground-truth topic_vectors, single softmax, correct "
+            "word mapping (max=50; random-Dirichlet floor ~3.6)"
+        ),
+        "baseline_tss_random": round(baseline_tss, 4),
+        "joint_plateau_tss": round(plateau, 4),
+        "targets": ladder,
+        "torch_note": (
+            "centralized fit = the reference's compute-only best case; its "
+            "shipped federated path adds >=3 s sleep x N clients per "
+            "global step on top (server.py:417-420,472)"
+        ),
+        "gfedntm_compile_and_stage_s": round(compile_s, 1),
+        "torch_federated_curve": torch_fed_curve,
+        "torch_curve": torch_curve,
+        "gfedntm_curve": jax_curve,
+    }
+    out_path = out_path or os.path.join(
+        REPO_ROOT, "results", "time_to_quality", "metrics.json"
+    )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", encoding="utf8") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({k: v for k, v in out.items()
+                      if not k.endswith("_curve")}, indent=2))
+    return out
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

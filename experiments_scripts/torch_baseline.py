@@ -31,6 +31,49 @@ import time
 REFERENCE_ROOT = "/root/reference"
 
 
+def make_reference_avitm(
+    input_size: int,
+    n_components: int,
+    num_epochs: int,
+    hidden_sizes: tuple[int, ...] = (100, 100),
+    logger_name: str = "torch-avitm",
+    **overrides,
+):
+    """Construct the UNMODIFIED reference AVITM with its experiment-regime
+    defaults (`run_simulation.py:271-318` / dft_params.cf): prodLDA,
+    softplus, dropout 0.2, batch 64, Adam(lr 2e-3, beta1 0.99), 20 theta
+    samples. Every script that drives the reference as a baseline
+    (torch_baseline, noncollab_probe, parity_vs_torch, time_to_quality)
+    builds it HERE so the arms can never silently drift to different
+    regimes. Also installs the sys.path + numpy-2 shims the reference
+    needs."""
+    sys.path.insert(0, REFERENCE_ROOT)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+
+    # The reference targets numpy<2 (`np.Inf` in pytorchtools.py:26); shim
+    # the removed alias so the unmodified reference runs under numpy 2.
+    if not hasattr(np, "Inf"):
+        np.Inf = np.inf
+
+    from src.models.base.pytorchavitm.avitm_network.avitm import AVITM
+
+    kwargs = dict(
+        logger=logging.getLogger(logger_name), input_size=input_size,
+        n_components=n_components, model_type="prodLDA",
+        hidden_sizes=tuple(hidden_sizes), activation="softplus",
+        dropout=0.2, learn_priors=True, batch_size=64, lr=2e-3,
+        momentum=0.99, solver="adam", num_epochs=num_epochs,
+        reduce_on_plateau=False, topic_prior_mean=0.0,
+        topic_prior_variance=None, num_samples=20,
+        num_data_loader_workers=0, verbose=False,
+    )
+    kwargs.update(overrides)
+    return AVITM(**kwargs)
+
+
 def run_torch_baseline(epochs: int = 3, out_path: str | None = None) -> dict:
     sys.path.insert(0, REFERENCE_ROOT)
     sys.path.insert(
@@ -45,7 +88,6 @@ def run_torch_baseline(epochs: int = 3, out_path: str | None = None) -> dict:
     if not hasattr(np, "Inf"):
         np.Inf = np.inf
 
-    from src.models.base.pytorchavitm.avitm_network.avitm import AVITM
     from src.models.base.pytorchavitm.datasets.bow_dataset import BOWDataset
 
     from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
@@ -61,15 +103,10 @@ def run_torch_baseline(epochs: int = 3, out_path: str | None = None) -> dict:
     idx2token = {i: f"wd{i}" for i in range(vocab)}
     dataset = BOWDataset(X, idx2token)
 
-    logger = logging.getLogger("torch_baseline")
-    model = AVITM(
-        logger=logger, input_size=vocab, n_components=k,
-        model_type="prodLDA", hidden_sizes=(50, 50), activation="softplus",
-        dropout=0.2, learn_priors=True, batch_size=batch, lr=2e-3,
-        momentum=0.99, solver="adam", num_epochs=epochs,
-        reduce_on_plateau=False, topic_prior_mean=0.0,
-        topic_prior_variance=None, num_samples=20,
-        num_data_loader_workers=0, verbose=False,
+    model = make_reference_avitm(
+        input_size=vocab, n_components=k, num_epochs=epochs,
+        hidden_sizes=(50, 50), logger_name="torch_baseline",
+        batch_size=batch,
     )
     # fit()'s own loader config (avitm.py:371-375) minus the worker pool —
     # on this 1-core host mp.cpu_count() workers only add IPC overhead.

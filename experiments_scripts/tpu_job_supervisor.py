@@ -70,14 +70,33 @@ def _kill_group(proc):
 
 
 def io_bytes(pid):
-    try:
-        with open(f"/proc/{pid}/io") as f:
-            d = dict(
-                line.strip().split(": ") for line in f if ": " in line
-            )
-        return int(d["rchar"]) + int(d["wchar"])
-    except OSError:
-        return None
+    """Sum rchar+wchar across the job's whole process group.
+
+    The job runs in its own session (start_new_session), so its pgid ==
+    the direct child's pid; bench.py and the sweep runners do their real
+    work in grandchildren, whose I/O is not reflected in the parent's
+    counters until reaped — a parent blocked in wait() for >STALL_S would
+    otherwise be killed as stalled while its child works (ADVICE r3)."""
+    total, found = 0, False
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                # field 5 (index 4 after comm) is pgrp; comm may contain
+                # spaces, so split after the closing paren.
+                pgrp = int(f.read().rsplit(")", 1)[1].split()[2])
+            if pgrp != pid:
+                continue
+            with open(f"/proc/{entry}/io") as f:
+                d = dict(
+                    line.strip().split(": ") for line in f if ": " in line
+                )
+            total += int(d["rchar"]) + int(d["wchar"])
+            found = True
+        except (OSError, ValueError, IndexError):
+            continue  # raced a process exit or unreadable entry
+    return total if found else None
 
 
 def run_watched(name, cmd, job_timeout, attempts=6):
@@ -129,17 +148,22 @@ def main():
         log("tunnel never came up; aborting")
         sys.exit(1)
     py = sys.executable
+    # Round-4 priority order (VERDICT r3 task 1): the kernel re-soak and a
+    # TPU-backed bench + profiler trace are the round's defining evidence;
+    # quality artifacts follow. The CPU-bound envelope is NOT here — it
+    # runs independently of the chip.
     jobs = [
-        ("envelope",
-         [py, "experiments_scripts/run_dss_tss_envelope.py", "5"],
-         6 * 3600, 10),
         ("soak", [py, "experiments_scripts/soak_fused_kernel.py"],
          2400, 4),
-        ("parity", [py, "experiments_scripts/parity_vs_torch.py"],
+        ("bench", [py, "bench.py"], 1500, 3),
+        ("ttq", [py, "experiments_scripts/time_to_quality.py"],
          3600, 3),
+        ("parity", [py, "experiments_scripts/parity_vs_torch.py"],
+         5400, 3),
         ("noniid", [py, "experiments_scripts/run_noniid_full.py"],
          3600, 3),
-        ("bench", [py, "bench.py"], 1500, 2),
+        ("presets24", [py, "experiments_scripts/run_presets_24.py"],
+         3600, 3),
     ]
     results = {}
     for name, cmd, jt, attempts in jobs:

@@ -278,7 +278,17 @@ class FederatedTrainer:
         checkpoint_every: int | None = None,
         resume: bool = False,
         metrics=None,
+        segment_callback=None,
     ) -> FederatedResult:
+        """Run the federated fit; see class docstring.
+
+        ``segment_callback(step, params, batch_stats)`` — if given, invoked
+        after each completed segment (state is already host-synced between
+        segments) with the absolute step count and the per-client stacked
+        variable trees. Used by quality-vs-wall-clock experiments to
+        snapshot betas without touching the timed device program; keep it
+        cheap — its cost sits between segments.
+        """
         t = self.template
         C, B = self.n_clients, t.batch_size
         if len(datasets) != C:
@@ -381,6 +391,8 @@ class FederatedTrainer:
                     "federated_segment", step=step,
                     mean_loss=float(np.asarray(seg_losses)[:, :C].mean()),
                 )
+            if segment_callback is not None:
+                segment_callback(step, params, batch_stats)
             if manager is not None and step < total_steps:
                 manager.save(step, {
                     "params": params,

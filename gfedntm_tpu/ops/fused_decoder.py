@@ -36,6 +36,14 @@ direction. (The one XLA backward left is the rows-sharded branch of the
 V-sharded VJP, whose cross-device batch-statistic sums cannot interleave
 with the tile stream.)
 
+Residual-memory tradeoff: "no [B, V] array reaches HBM" refers to
+*intermediates* (z/n/p and their cotangents). The padded inputs themselves
+— x_p [B_pad, V_pad] plus padded theta/beta — are saved as VJP residuals
+so the backward never re-pads; at V=100k, B=256 that keeps ~100 MB of
+padded x live from forward to backward. If peak HBM ever binds before
+bandwidth does, drop x_p from the residuals and re-pad x alone in the
+backward (one extra copy per step).
+
 Interpret mode (`interpret=True`, the default off-TPU) runs the same kernels
 on CPU for tests.
 """
@@ -64,10 +72,15 @@ def _pick_tile_v(v: int) -> tuple[int, int]:
     128) degenerated to 391 sequential 128-wide grid steps. Padding V=50000
     to 51200 costs 2.4% wasted columns and keeps the MXU on 2048-wide tiles.
 
-    ``GFEDNTM_FUSED_TILE_V`` (a multiple of 128) overrides the tile width —
-    the tuning knob behind ``soak_fused_kernel.py``'s tile sweep; forward
-    and backward read it through the same path, so their geometries always
-    agree within a process."""
+    ``GFEDNTM_FUSED_TILE_V`` overrides the tile width (values are rounded
+    up to a multiple of 128) — the tuning knob behind
+    ``soak_fused_kernel.py``'s tile sweep; forward and backward read it
+    through the same path, so their geometries always agree within a
+    process. The knob is read at TRACE time: a jit-compiled function keeps
+    the tiling it was traced with (the jit cache is keyed on shapes, not
+    env vars), so changing it only affects functions traced afterwards —
+    sweep scripts must build a fresh closure per setting (as
+    ``soak_fused_kernel.py`` does)."""
     v = max(v, 128)
     tile_cap = 2048
     override = os.environ.get("GFEDNTM_FUSED_TILE_V")
@@ -76,8 +89,7 @@ def _pick_tile_v(v: int) -> tuple[int, int]:
             tile_cap = max(128, _round_up(int(override), 128))
         except ValueError:
             raise ValueError(
-                "GFEDNTM_FUSED_TILE_V must be an integer (multiple of "
-                f"128); got {override!r}"
+                f"GFEDNTM_FUSED_TILE_V must be an integer; got {override!r}"
             ) from None
     if v <= tile_cap:
         v_pad = _round_up(v, 128)
@@ -858,11 +870,24 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
             backend = jax.default_backend()
         except RuntimeError as err:  # no usable backend at all
             return False, repr(err)
-    cached = _KERNEL_HEALTH.get(backend)
+    # Probe at n_tiles=2 REGARDLESS of the GFEDNTM_FUSED_TILE_V override:
+    # probing v = 2x the resolved tile width keeps the multi-tile Mosaic
+    # lowering path exercised (a fixed v=4096 under an override >= 4096
+    # would silently degrade to a single-tile probe and could greenlight a
+    # tiling that crashes at real V). The cache is keyed on the resolved
+    # tile width so changing the knob re-probes. A malformed override must
+    # degrade to the unfused path like every other probe failure — the
+    # "auto" never-crash contract — not raise out of here.
+    try:
+        tile_v, _ = _pick_tile_v(1 << 30)
+    except ValueError as err:
+        return False, repr(err)
+    cache_key = f"{backend}:tile{tile_v}"
+    cached = _KERNEL_HEALTH.get(cache_key)
     if cached is not None:
         return cached
     try:
-        b, k, v = 8, 8, 4096  # tile_v=2048 -> n_tiles=2: the tiling regime
+        b, k, v = 8, 8, 2 * tile_v  # n_tiles=2: the tiling regime
         key = jax.random.PRNGKey(0)
         theta = jax.random.uniform(key, (b, k))
         beta = jax.random.normal(key, (k, v))
@@ -890,7 +915,7 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
         result = (ok, "" if ok else "non-finite probe loss/grads")
     except Exception as err:  # Mosaic lowering, platform, tunnel — any
         result = (False, repr(err))
-    _KERNEL_HEALTH[backend] = result
+    _KERNEL_HEALTH[cache_key] = result
     return result
 
 

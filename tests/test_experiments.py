@@ -64,6 +64,44 @@ class TestDssTssSimulation:
         for arm in res.values():
             assert 0.0 < arm["betas"] <= 4.0 + 1e-6
 
+    def test_refmap_project_replicates_reference_shift(self):
+        """refmap_project must reproduce the reference scorer's off-by-one:
+        token wdN lands in column N-1, wd0's mass is dropped, rows
+        renormalize (run_simulation.py:225-268 vs :170-179)."""
+        from gfedntm_tpu.experiments.dss_tss import refmap_project
+
+        beta = np.array([[0.5, 0.3, 0.2]])
+        id2token = {0: "wd0", 1: "wd1", 2: "wd3"}
+        out = refmap_project(beta, id2token, vocab_size=4)
+        # wd1 -> col 0, wd3 -> col 2; wd0's 0.5 dropped then renormalized
+        assert out.shape == (1, 4)
+        np.testing.assert_allclose(out[0], [0.6, 0.0, 0.4, 0.0])
+
+    def test_iter_simulation_refmap_leq_correct_map(self):
+        """The shifted mapping can only lose alignment on trained arms;
+        baseline (drawn on the full vocab, no projection) is identical by
+        construction."""
+        res = run_iter_simulation(tiny_sim_config(), seed=0)
+        for arm in ("centralized", "non_colab"):
+            assert res[arm]["betas_refmap"] <= res[arm]["betas"] + 1e-9
+        assert res["baseline"]["betas_refmap"] == res["baseline"]["betas"]
+
+    def test_eta_sweep_uses_reference_frozen_override(self, tmp_path):
+        """experiment=1 with a multi-entry frozen list must run at
+        frozen_topics_list[1] (run_simulation.py:694-696) and stamp the
+        override into the artifact regime + checkpoint digest."""
+        cfg = tiny_sim_config(
+            frozen_topics_list=(1, 3), frozen_topics=1, iters=1
+        )
+        out = run_simulation(cfg, results_dir=tmp_path)
+        assert out["meta"]["regime"]["frozen_topics"] == 3
+        stamp_dirs = list((tmp_path / "iters").iterdir())
+        assert len(stamp_dirs) == 1
+        stamp = json.loads(
+            (stamp_dirs[0] / "config_stamp.json").read_text()
+        )
+        assert stamp["frozen_topics"] == "3"
+
     def test_run_simulation_sweep_schema_and_artifacts(self, tmp_path):
         cfg = tiny_sim_config(eta_list=(0.05, 0.1))
         out = run_simulation(cfg, results_dir=tmp_path)
@@ -336,23 +374,44 @@ class TestEnvelopeArtifacts:
         )
 
     def test_frozen_point_band_and_ordering(self):
+        """frozen=40 under BOTH word mappings (see refmap_project): the
+        reference's published pickles (centralized 8.664 +/- 0.037 vs
+        non-collab 8.475 +/- 0.046, centralized on top) are computed under
+        its off-by-one mapping, so the published bands AND the published
+        ordering are asserted on the refmap columns. Under the correct
+        mapping every arm scores higher and non-collab overtakes
+        centralized at this near-full-sharing point — asserted as this
+        repo's own established values (round-4 n=10 artifact). This is the
+        test that would have caught round 3's 'ordering preserved
+        everywhere' misreport: the correct-map inversion is real, and the
+        refmap columns are the only ones comparable to the reference."""
         art = self._load(self.FROZEN_ARTIFACT)
         cols = art["columns"]
         central = cols["centralized_betas_mean"][0]
         noncollab = cols["non_colab_betas_mean"][0]
-        # Reference frozen=40: centralized 8.664 +/- 0.037, non-collab
-        # 8.475 +/- 0.046 — the arms nearly meet at high sharing, so assert
-        # the band and that collaboration does not hurt.
-        sigma = max(0.037, float(cols["centralized_betas_std"][0]), 0.25 / 3)
-        assert abs(central - 8.664) <= 3 * sigma, (central, sigma)
-        assert central >= noncollab - 3 * 0.046
+        # Correct-map regression bands around this repo's own values.
+        sigma = max(float(cols["centralized_betas_std"][0]), 0.25 / 3)
+        assert abs(central - 8.87) <= 3 * sigma, (central, sigma)
+        assert abs(noncollab - 8.96) <= 3 * max(
+            float(cols["non_colab_betas_std"][0]), 0.25 / 3
+        )
+        # Reference-comparable (refmap) bands + the PUBLISHED ordering.
+        c_ref = cols.get("centralized_betas_refmap_mean", [None])[0]
+        n_ref = cols.get("non_colab_betas_refmap_mean", [None])[0]
+        if c_ref is not None and n_ref is not None:
+            assert abs(c_ref - 8.664) <= max(
+                3 * 0.037, 0.2
+            ), c_ref
+            assert abs(n_ref - 8.475) <= max(3 * 0.046, 0.2), n_ref
+            assert c_ref > n_ref  # the reference's ordering, its mapping
         assert art["meta"]["iters"] >= 5
 
     def test_frozen5_point_when_present(self):
         """frozen=5 is where collaboration matters most in the reference
-        (centralized 8.676 +/- 0.049 vs non-collab 7.207 +/- 0.058): assert
-        the band AND a decisive centralized > non-collab gap. Skipped until
-        the sweep artifact includes the point."""
+        (centralized 8.676 +/- 0.049 vs non-collab 7.207 +/- 0.058 under
+        its mapping): assert the refmap bands AND a decisive
+        centralized > non-collab gap (which holds under both mappings
+        here). Skipped until the sweep artifact includes the point."""
         art = self._load(self.FROZEN_ARTIFACT)
         if 5 not in art["index"]:
             pytest.skip("frozen=5 point not yet swept")
@@ -360,9 +419,15 @@ class TestEnvelopeArtifacts:
         cols = art["columns"]
         central = cols["centralized_betas_mean"][i]
         noncollab = cols["non_colab_betas_mean"][i]
-        sigma = max(0.049, float(cols["centralized_betas_std"][i]), 0.25 / 3)
-        assert abs(central - 8.676) <= 3 * sigma, (central, sigma)
-        assert central - noncollab > 0.5, (central, noncollab)
+        sigma = max(float(cols["centralized_betas_std"][i]), 0.25 / 3)
+        assert abs(central - 8.87) <= 3 * sigma, (central, sigma)
+        assert central - noncollab > 0.3, (central, noncollab)
+        c_ref = cols.get("centralized_betas_refmap_mean", [None])[i]
+        n_ref = cols.get("non_colab_betas_refmap_mean", [None])[i]
+        if c_ref is not None and n_ref is not None:
+            assert abs(c_ref - 8.676) <= max(3 * 0.049, 0.2), c_ref
+            assert abs(n_ref - 7.207) <= max(3 * 0.058, 0.2), n_ref
+            assert c_ref - n_ref > 0.5
 
     @pytest.mark.parametrize("eta,ref_mean", [
         # Reference eta_variable/results.pickle (20 repeats); stds ~0.04-0.05

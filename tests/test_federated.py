@@ -47,6 +47,37 @@ def test_share_all_makes_params_identical_across_clients():
 
 
 @pytest.mark.slow
+def test_segment_callback_snapshots_each_segment():
+    """fit(segment_callback=...) fires once per completed segment with the
+    absolute step count and host-synced state, and does not change the
+    result (time_to_quality.py relies on both properties)."""
+    dsets, _ = _datasets(2, n_docs=32)
+    t = _template(num_epochs=4, batch_size=16)
+    spe = 2  # ceil(32/16)
+    seen = []
+
+    def cb(step, params, batch_stats):
+        seen.append((step, np.asarray(params["beta"][0]).copy()))
+
+    res = FederatedTrainer(t, n_clients=2, seed=5).fit(
+        dsets, checkpoint_every=spe, segment_callback=cb
+    )
+    total = int(res.losses.shape[0])
+    assert [s for s, _ in seen] == list(range(spe, total + 1, spe))
+    # callback state matches the final result at the last segment
+    np.testing.assert_allclose(
+        seen[-1][1], np.asarray(res.client_params["beta"][0]),
+        rtol=1e-6, atol=1e-7,
+    )
+    # segmentation + callback must not perturb the run
+    ref = FederatedTrainer(t, n_clients=2, seed=5).fit(dsets)
+    np.testing.assert_allclose(
+        np.asarray(ref.client_params["beta"][0]), seen[-1][1],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.slow
 def test_share_minimal_keeps_encoders_local():
     dsets, _ = _datasets(2)
     ft = FederatedTrainer(_template(), n_clients=2, grads_to_share=SHARE_MINIMAL)

@@ -301,17 +301,47 @@ class TestFailSafe:
     """`fused_decoder="auto"` must never crash a run the unfused XLA loss
     could complete (VERDICT r2 task 1)."""
 
-    def test_kernel_health_caches_per_backend(self):
+    def test_kernel_health_caches_per_backend_and_tile(self):
         from gfedntm_tpu.ops import fused_decoder as fd
 
-        fd._KERNEL_HEALTH.pop("cpu", None)
+        tile_v, _ = fd._pick_tile_v(1 << 30)
+        key = f"cpu:tile{tile_v}"
+        fd._KERNEL_HEALTH.pop(key, None)
         ok, err = fd.kernel_health("cpu")
         assert ok and err == ""
-        assert fd._KERNEL_HEALTH["cpu"] == (True, "")
+        assert fd._KERNEL_HEALTH[key] == (True, "")
         # A poisoned cache entry is honoured without re-probing.
-        fd._KERNEL_HEALTH["cpu"] = (False, "boom")
+        fd._KERNEL_HEALTH[key] = (False, "boom")
         assert fd.kernel_health("cpu") == (False, "boom")
-        fd._KERNEL_HEALTH.pop("cpu", None)
+        fd._KERNEL_HEALTH.pop(key, None)
+
+    def test_kernel_health_malformed_override_degrades_not_raises(
+        self, monkeypatch
+    ):
+        """A typo'd GFEDNTM_FUSED_TILE_V (e.g. left over from a soak sweep)
+        must return (False, msg) so 'auto' falls back to unfused — never
+        raise out of kernel_health."""
+        from gfedntm_tpu.ops import fused_decoder as fd
+
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "2048,")
+        ok, err = fd.kernel_health("cpu")
+        assert not ok and "GFEDNTM_FUSED_TILE_V" in err
+
+    def test_kernel_health_probe_stays_multi_tile_under_override(self,
+                                                                 monkeypatch):
+        """ADVICE r3: an override >= the old fixed probe V must not turn
+        the probe single-tile — the probe geometry tracks the knob."""
+        from gfedntm_tpu.ops import fused_decoder as fd
+
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "8192")
+        tile_v, _ = fd._pick_tile_v(1 << 30)
+        assert tile_v == 8192
+        key = f"cpu:tile{tile_v}"
+        fd._KERNEL_HEALTH.pop(key, None)
+        ok, err = fd.kernel_health("cpu")
+        assert ok and err == ""
+        assert key in fd._KERNEL_HEALTH  # keyed on the resolved tile
+        fd._KERNEL_HEALTH.pop(key, None)
 
     def test_resolve_fused_auto_off_tpu(self):
         from gfedntm_tpu.models.avitm import AVITM
