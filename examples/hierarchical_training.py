@@ -1,0 +1,54 @@
+"""Hierarchical (second-level) topic modeling with TMWrapper — the
+reference's `--hierarchical` workflow (`tm_wrapper.py:298-357`, HTM-WS /
+HTM-DS) driven natively: train a father model, expand one of its topics
+into a child model on the topic-restricted subcorpus.
+
+Run: python examples/hierarchical_training.py
+"""
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+from gfedntm_tpu.experiments.tm_wrapper import TMWrapper
+
+corpus = generate_synthetic_corpus(
+    vocab_size=400, n_topics=6, n_docs=200, nwords=(25, 45), n_nodes=1,
+    frozen_topics=2, seed=0,
+)
+docs = corpus.nodes[0].documents
+
+models_root = Path(tempfile.mkdtemp(prefix="htm_"))
+wrapper = TMWrapper(models_root)
+
+father, father_dir = wrapper.train_model(
+    "father", docs, model_type="avitm", n_topics=6,
+    model_kwargs=dict(hidden_sizes=(32, 32), num_epochs=5, batch_size=16),
+)
+print("father topics:")
+for i, topic in enumerate(father.get_topics(6)):
+    print(f"  {i}: {topic}")
+
+for version in ("HTM-WS", "HTM-DS"):
+    child, child_dir, child_corpus = wrapper.train_htm_submodel(
+        version=version,
+        father_model=father,
+        father_dir=father_dir,
+        corpus=docs,
+        name=f"child_{version.lower().replace('-', '_')}",
+        expansion_topic=0,
+        model_type="avitm",
+        n_topics=3,
+        model_kwargs=dict(hidden_sizes=(16, 16), num_epochs=3, batch_size=8),
+    )
+    print(f"\n{version}: child trained on {len(child_corpus)} docs "
+          f"-> {child_dir}")
+    for i, topic in enumerate(child.get_topics(6)):
+        print(f"  {i}: {topic}")

@@ -31,6 +31,11 @@ def main() -> None:
     )
     import jax
 
+    if os.environ.get("FORCE_CPU"):
+        # Must precede any backend query: jax.default_backend() on a dead
+        # TPU tunnel blocks forever in the plugin's re-dial loop.
+        jax.config.update("jax_platforms", "cpu")
+
     from gfedntm_tpu.presets import noniid_fos_5client
 
     t0 = time.perf_counter()

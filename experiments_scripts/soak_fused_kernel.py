@@ -24,6 +24,13 @@ def main() -> None:
     )
     import jax
 
+    if os.environ.get("FORCE_CPU"):
+        # Must precede any backend query: jax.default_backend() on a dead
+        # TPU tunnel blocks forever in the plugin's re-dial loop. (A
+        # CPU-forced soak runs interpret-mode only — useful as a harness
+        # shakeout, never as kernel evidence.)
+        jax.config.update("jax_platforms", "cpu")
+
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import bench_fused_largev
 
