@@ -123,6 +123,9 @@ def main() -> None:
     p.add_argument("--eta", help="eta-sweep results.json")
     p.add_argument("--frozen", help="frozen-sweep results.json")
     args = p.parse_args()
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     if args.figure == "dss_tss":
         plot_dss_tss(args.out, args.eta, args.frozen)
     else:
