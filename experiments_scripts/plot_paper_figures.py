@@ -32,20 +32,59 @@ COLORS = {"centralized": "tab:green", "non_colab": "tab:blue",
           "baseline": "tab:red"}
 
 
-def _panel(ax, results: dict, stat: str, logx: bool) -> None:
+# The reference's committed pickles, for visual overlay (its TSS values
+# are refmap scores — see gfedntm_tpu/experiments/dss_tss.refmap_project —
+# so they are drawn against this repo's *_betas_refmap_* columns).
+REF_PUBLISHED = {
+    "eta": {
+        "index": [0.01, 0.02, 0.03, 0.04, 0.08, 1.0],
+        "centralized_betas": [8.679, 12.205, 14.747, 16.812, 22.671, 44.302],
+        "non_colab_betas": [7.571, None, None, None, None, 44.302],
+        "baseline_betas": [3.564, None, None, None, None, 39.660],
+    },
+    "frozen": {
+        "index": [40, 5],
+        "centralized_betas": [8.664, 8.676],
+        "non_colab_betas": [8.475, 7.207],
+    },
+}
+
+
+def _panel(ax, results: dict, stat: str, logx: bool,
+           ref: dict | None = None) -> None:
     index = results["index"]
     cols = results["columns"]
     for arm in ARMS:
+        # Prefer the reference-comparable refmap column when overlaying
+        # the published values; fall back to the correct-map column.
         mean_key, std_key = f"{arm}_{stat}_mean", f"{arm}_{stat}_std"
+        if ref is not None and f"{arm}_{stat}_refmap_mean" in cols:
+            rm = cols[f"{arm}_{stat}_refmap_mean"]
+            if all(v is not None for v in rm):
+                mean_key = f"{arm}_{stat}_refmap_mean"
+                std_key = f"{arm}_{stat}_refmap_std"
         if mean_key not in cols:
             continue
         if stat == "thetas" and arm == "baseline":
             continue  # reference omits the random arm from DSS panels
         ax.errorbar(
-            index, cols[mean_key], yerr=cols[std_key], fmt="x-",
+            index, cols[mean_key], yerr=cols.get(std_key), fmt="x-",
             label=LABELS[arm], color=COLORS[arm], ecolor="gray",
             capsize=2, lw=1,
         )
+        if ref is not None and stat == "betas":
+            pub = ref.get(f"{arm}_{stat}")
+            if pub:
+                pts = [
+                    (x, y) for x, y in zip(ref["index"], pub)
+                    if y is not None and x in index
+                ]
+                if pts:
+                    ax.plot(
+                        [p[0] for p in pts], [p[1] for p in pts], "o",
+                        mfc="none", color=COLORS[arm], ms=7,
+                        label=f"{LABELS[arm]} (reference)",
+                    )
     if logx:
         ax.set_xscale("log")
     ax.set_xlabel(results.get("index_name", ""))
@@ -74,7 +113,8 @@ def plot_dss_tss(out: str, eta_json: str | None, frozen_json: str | None):
         squeeze=False,
     )
     for row, (name, results) in enumerate(sweeps):
-        _panel(axs[row][0], results, "betas", logx=name == "eta")
+        ref = REF_PUBLISHED.get(name)
+        _panel(axs[row][0], results, "betas", logx=name == "eta", ref=ref)
         _panel(axs[row][1], results, "thetas", logx=name == "eta")
     axs[0][0].legend(fontsize=8)
     fig.tight_layout()
