@@ -331,144 +331,171 @@ def bench_fused_largev(
     which flattens any compute difference (this is exactly what made the
     round-2 per-call numbers meaningless).
     """
+    interpret = backend == "cpu"  # CPU fallback: interpret mode (tiny V only)
+    out = {}
+    if cases is None:
+        cases = [(V, B) for V in v_list for B in batch_list]
+    if interpret:
+        cases = [(2048, 64)]
+    for V, B in cases:
+        # A failing case must not lose the rows already measured — the
+        # round-4 soak died at the tile-4096 x (V=100k, B=256) sweep case
+        # (Mosaic scoped-VMEM overflow) and dropped the whole artifact.
+        # Error rows carry the resolved tile too: the geometry that failed
+        # is exactly the diagnostic the artifact exists to preserve.
+        from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
+
+        try:
+            out[f"V{V}_B{B}"] = _fused_case(V, B, interpret)
+        except Exception as err:  # noqa: BLE001 — record, keep sweeping
+            out[f"V{V}_B{B}"] = {
+                "tile_v": resolve_tile_v(V, B),
+                "parity": False,
+                "error": f"{type(err).__name__}: {err}"[:600],
+            }
+    return out
+
+
+def _fused_case(V: int, B: int, interpret: bool) -> dict:
+    """Parity + timing for one (V, B) soak case; see bench_fused_largev."""
     import jax
     import jax.numpy as jnp
 
     from gfedntm_tpu.ops.fused_decoder import (
         prodlda_recon_loss,
         prodlda_recon_loss_reference,
+        resolve_tile_v,
     )
 
-    interpret = backend == "cpu"  # CPU fallback: interpret mode (tiny V only)
-    out = {}
     K = 50
-    if cases is None:
-        cases = [(V, B) for V in v_list for B in batch_list]
-    if interpret:
-        cases = [(2048, 64)]
-    for V, B in cases:
-        rng = np.random.default_rng(0)
-        theta = jnp.asarray(
-            rng.dirichlet(np.ones(K), size=B).astype(np.float32)
-        )
-        beta = jnp.asarray(rng.normal(size=(K, V)).astype(np.float32))
-        x = jnp.asarray(
-            rng.integers(0, 3, size=(B, V)).astype(np.float32)
-        )
-        mask = jnp.ones((B,), jnp.float32)
-        rm, rv = jnp.zeros((V,)), jnp.ones((V,))
+    # The tile width the kernel will actually use for this case: the
+    # VMEM-frontier clamp can silently shrink an operator-requested
+    # GFEDNTM_FUSED_TILE_V at large B, so sweep rows must record the
+    # resolved geometry or wider-tile labels would report baseline-tile
+    # numbers as sweep results.
+    resolved_tile_v = resolve_tile_v(V, B)
+    rng = np.random.default_rng(0)
+    theta = jnp.asarray(
+        rng.dirichlet(np.ones(K), size=B).astype(np.float32)
+    )
+    beta = jnp.asarray(rng.normal(size=(K, V)).astype(np.float32))
+    x = jnp.asarray(
+        rng.integers(0, 3, size=(B, V)).astype(np.float32)
+    )
+    mask = jnp.ones((B,), jnp.float32)
+    rm, rv = jnp.zeros((V,)), jnp.ones((V,))
 
-        def loss_fused(theta, beta):
-            rl, _, _ = prodlda_recon_loss(
-                theta, beta, x, rm, rv, mask, True, interpret=interpret
+    def loss_fused(theta, beta):
+        rl, _, _ = prodlda_recon_loss(
+            theta, beta, x, rm, rv, mask, True, interpret=interpret
+        )
+        return jnp.sum(rl * mask)
+
+    def loss_ref(theta, beta):
+        rl, _, _ = prodlda_recon_loss_reference(
+            theta, beta, x, rm, rv, mask, True
+        )
+        return jnp.sum(rl * mask)
+
+    # ---- parity (one call each) ----------------------------------------
+    # Grad criterion: both f32 paths are compared against a float64
+    # numpy oracle; the fused kernel passes if it is no farther from
+    # the oracle than ~2x the unfused XLA path (plus an absolute floor
+    # for when both are at f32 noise). A fused-vs-unfused bitwise-style
+    # threshold instead measures f32 summation-order noise, which grows
+    # with B*V and says nothing about which path is wrong.
+    f_fused = jax.jit(jax.value_and_grad(loss_fused, argnums=(0, 1)))
+    f_ref = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1)))
+    lf, gf = f_fused(theta, beta)
+    lr, gr = f_ref(theta, beta)
+    jax.block_until_ready((lf, gf, lr, gr))
+    loss_rel = abs(float(lf) - float(lr)) / max(abs(float(lr)), 1e-9)
+    grad_rel = max(
+        float(jnp.max(jnp.abs(a - b)))
+        / max(float(jnp.max(jnp.abs(b))), 1e-9)
+        for a, b in zip(gf, gr)
+    )
+    g64 = _grad_oracle_f64(
+        np.asarray(theta), np.asarray(beta), np.asarray(x),
+        np.asarray(mask),
+    )
+    def _oracle_err(grads):
+        return max(
+            float(np.max(np.abs(np.asarray(a, np.float64) - o)))
+            / max(float(np.max(np.abs(o))), 1e-9)
+            for a, o in zip(grads, g64)
+        )
+    fused_vs_f64 = _oracle_err(gf)
+    unfused_vs_f64 = _oracle_err(gr)
+    grad_ok = fused_vs_f64 <= max(2.0 * unfused_vs_f64, 1e-4)
+
+    # ---- timing (n steps inside one jitted scan) -----------------------
+    n_steps = 200
+
+    def make_loop(loss_fn):
+        grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1))
+
+        @jax.jit
+        def run(theta, beta):
+            def body(carry, _):
+                th, bt = carry
+                loss, (gt, gb) = grad_fn(th, bt)
+                # SGD-coupled so no step can be folded away or reordered.
+                return (th - 1e-6 * gt, bt - 1e-6 * gb), loss
+
+            carry, losses = jax.lax.scan(
+                body, (theta, beta), None, length=n_steps
             )
-            return jnp.sum(rl * mask)
+            return carry, losses
 
-        def loss_ref(theta, beta):
-            rl, _, _ = prodlda_recon_loss_reference(
-                theta, beta, x, rm, rv, mask, True
-            )
-            return jnp.sum(rl * mask)
+        return run
 
-        # ---- parity (one call each) ----------------------------------------
-        # Grad criterion: both f32 paths are compared against a float64
-        # numpy oracle; the fused kernel passes if it is no farther from
-        # the oracle than ~2x the unfused XLA path (plus an absolute floor
-        # for when both are at f32 noise). A fused-vs-unfused bitwise-style
-        # threshold instead measures f32 summation-order noise, which grows
-        # with B*V and says nothing about which path is wrong.
-        f_fused = jax.jit(jax.value_and_grad(loss_fused, argnums=(0, 1)))
-        f_ref = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1)))
-        lf, gf = f_fused(theta, beta)
-        lr, gr = f_ref(theta, beta)
-        jax.block_until_ready((lf, gf, lr, gr))
-        loss_rel = abs(float(lf) - float(lr)) / max(abs(float(lr)), 1e-9)
-        grad_rel = max(
-            float(jnp.max(jnp.abs(a - b)))
-            / max(float(jnp.max(jnp.abs(b))), 1e-9)
-            for a, b in zip(gf, gr)
-        )
-        g64 = _grad_oracle_f64(
-            np.asarray(theta), np.asarray(beta), np.asarray(x),
-            np.asarray(mask),
-        )
-        def _oracle_err(grads):
-            return max(
-                float(np.max(np.abs(np.asarray(a, np.float64) - o)))
-                / max(float(np.max(np.abs(o))), 1e-9)
-                for a, o in zip(grads, g64)
-            )
-        fused_vs_f64 = _oracle_err(gf)
-        unfused_vs_f64 = _oracle_err(gr)
-        grad_ok = fused_vs_f64 <= max(2.0 * unfused_vs_f64, 1e-4)
+    def timeit_once(run):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(theta, beta))
+        return (time.perf_counter() - t0) / n_steps * 1e3
 
-        # ---- timing (n steps inside one jitted scan) -----------------------
-        n_steps = 200
+    # Interleaved best-of-N: single-call timings through the tunnel show
+    # multi-hundred-percent run-to-run drift, so fused/unfused strictly
+    # alternate (F,R,F,R,...) and the minimum (the least-interfered
+    # pass) is reported for each — consecutive blocks would let slow
+    # drift systematically favor whichever path lands in the quiet
+    # window.
+    run_fused, run_ref = make_loop(loss_fused), make_loop(loss_ref)
+    jax.block_until_ready(run_fused(theta, beta))  # compile + warm
+    jax.block_until_ready(run_ref(theta, beta))
+    fused_ms = unfused_ms = float("inf")
+    for _ in range(7):
+        fused_ms = min(fused_ms, timeit_once(run_fused))
+        unfused_ms = min(unfused_ms, timeit_once(run_ref))
 
-        def make_loop(loss_fn):
-            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1))
-
-            @jax.jit
-            def run(theta, beta):
-                def body(carry, _):
-                    th, bt = carry
-                    loss, (gt, gb) = grad_fn(th, bt)
-                    # SGD-coupled so no step can be folded away or reordered.
-                    return (th - 1e-6 * gt, bt - 1e-6 * gb), loss
-
-                carry, losses = jax.lax.scan(
-                    body, (theta, beta), None, length=n_steps
-                )
-                return carry, losses
-
-            return run
-
-        def timeit_once(run):
-            t0 = time.perf_counter()
-            jax.block_until_ready(run(theta, beta))
-            return (time.perf_counter() - t0) / n_steps * 1e3
-
-        # Interleaved best-of-N: single-call timings through the tunnel show
-        # multi-hundred-percent run-to-run drift, so fused/unfused strictly
-        # alternate (F,R,F,R,...) and the minimum (the least-interfered
-        # pass) is reported for each — consecutive blocks would let slow
-        # drift systematically favor whichever path lands in the quiet
-        # window.
-        run_fused, run_ref = make_loop(loss_fused), make_loop(loss_ref)
-        jax.block_until_ready(run_fused(theta, beta))  # compile + warm
-        jax.block_until_ready(run_ref(theta, beta))
-        fused_ms = unfused_ms = float("inf")
-        for _ in range(7):
-            fused_ms = min(fused_ms, timeit_once(run_fused))
-            unfused_ms = min(unfused_ms, timeit_once(run_ref))
-
-        # Analytic floors per step (f32): matmul FLOPs and minimal HBM
-        # traffic. Fused: z fwd (2BKV) + remat z, dtheta, dbeta in bwd
-        # (6BKV). Unfused autodiff: no remat -> 6BKV, but it streams the
-        # [B, V] intermediates through HBM.
-        flops_fused = 8.0 * B * K * V
-        bytes_fused = 4.0 * (4 * K * V + 2 * B * V)  # beta x4, x_bow x2
-        step_s = fused_ms / 1e3
-        out[f"V{V}_B{B}"] = {
-            "fused_ms": round(fused_ms, 3),
-            "unfused_ms": round(unfused_ms, 3),
-            "speedup": round(unfused_ms / fused_ms, 3),
-            "loss_rel_err": float(f"{loss_rel:.2e}"),
-            "grad_rel_err": float(f"{grad_rel:.2e}"),
-            "grad_fused_vs_f64": float(f"{fused_vs_f64:.2e}"),
-            "grad_unfused_vs_f64": float(f"{unfused_vs_f64:.2e}"),
-            "parity": bool(loss_rel < 1e-4 and grad_ok),
-            "fused_gflops_per_s": round(flops_fused / step_s / 1e9, 1),
-            "fused_mfu_vs_bf16_peak": round(
-                flops_fused / step_s / _V5E_PEAK_FLOPS, 4
-            ),
-            "fused_hbm_gb_per_s": round(bytes_fused / step_s / 1e9, 1),
-            "fused_hbm_util": round(
-                bytes_fused / step_s / 1e9 / _V5E_PEAK_HBM_GBS, 3
-            ),
-            "timing": f"{n_steps}-step jitted scan, per-step ms, best-of-interleaved",
-        }
-    return out
+    # Analytic floors per step (f32): matmul FLOPs and minimal HBM
+    # traffic. Fused: z fwd (2BKV) + remat z, dtheta, dbeta in bwd
+    # (6BKV). Unfused autodiff: no remat -> 6BKV, but it streams the
+    # [B, V] intermediates through HBM.
+    flops_fused = 8.0 * B * K * V
+    bytes_fused = 4.0 * (4 * K * V + 2 * B * V)  # beta x4, x_bow x2
+    step_s = fused_ms / 1e3
+    return {
+        "tile_v": resolved_tile_v,
+        "fused_ms": round(fused_ms, 3),
+        "unfused_ms": round(unfused_ms, 3),
+        "speedup": round(unfused_ms / fused_ms, 3),
+        "loss_rel_err": float(f"{loss_rel:.2e}"),
+        "grad_rel_err": float(f"{grad_rel:.2e}"),
+        "grad_fused_vs_f64": float(f"{fused_vs_f64:.2e}"),
+        "grad_unfused_vs_f64": float(f"{unfused_vs_f64:.2e}"),
+        "parity": bool(loss_rel < 1e-4 and grad_ok),
+        "fused_gflops_per_s": round(flops_fused / step_s / 1e9, 1),
+        "fused_mfu_vs_bf16_peak": round(
+            flops_fused / step_s / _V5E_PEAK_FLOPS, 4
+        ),
+        "fused_hbm_gb_per_s": round(bytes_fused / step_s / 1e9, 1),
+        "fused_hbm_util": round(
+            bytes_fused / step_s / 1e9 / _V5E_PEAK_HBM_GBS, 3
+        ),
+        "timing": f"{n_steps}-step jitted scan, per-step ms, best-of-interleaved",
+    }
 
 
 def _phase_main(phase: str, backend: str) -> None:

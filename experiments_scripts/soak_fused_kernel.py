@@ -18,6 +18,19 @@ import os
 import sys
 
 
+def _pick_tile_v_default(v: int, b: int) -> int:
+    """Tile width the kernel resolves with NO operator override (the
+    baseline geometry), independent of the current env state."""
+    from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
+
+    saved = os.environ.pop("GFEDNTM_FUSED_TILE_V", None)
+    try:
+        return resolve_tile_v(v, b)
+    finally:
+        if saved is not None:
+            os.environ["GFEDNTM_FUSED_TILE_V"] = saved
+
+
 def main() -> None:
     out_path = (
         sys.argv[1] if len(sys.argv) > 1 else "results/fused_kernel_soak.json"
@@ -50,12 +63,28 @@ def main() -> None:
         # env knob takes effect per run.
         tile_sweep: dict[str, dict] = {}
         sweep_cases = [(50_000, 64), (100_000, 256)]
+        from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
+
         for tile in (4096, 8192):
             os.environ["GFEDNTM_FUSED_TILE_V"] = str(tile)
             try:
-                tile_sweep[f"tile{tile}"] = bench_fused_largev(
-                    backend, cases=sweep_cases
-                )
+                # Skip combos where the VMEM-frontier clamp resolves the
+                # requested tile back to the default geometry (large B):
+                # re-benching them would just duplicate the baseline row
+                # under a wider-tile label.
+                live_cases = [
+                    (v, b) for v, b in sweep_cases
+                    if resolve_tile_v(v, b) != _pick_tile_v_default(v, b)
+                ]
+                if live_cases:
+                    tile_sweep[f"tile{tile}"] = bench_fused_largev(
+                        backend, cases=live_cases
+                    )
+                skipped = [c for c in sweep_cases if c not in live_cases]
+                if skipped:
+                    tile_sweep.setdefault(f"tile{tile}", {})[
+                        "skipped_clamped"
+                    ] = [f"V{v}_B{b}" for v, b in skipped]
             finally:
                 del os.environ["GFEDNTM_FUSED_TILE_V"]
     finally:

@@ -1,0 +1,161 @@
+"""Attribute the federated bench-regime per-step cost (VERDICT r3 task 4).
+
+The round-2 TPU bench measured ~47 ms per global step for ~1 GFLOP of
+matmul — three orders of magnitude off the chip's peak, i.e. the step is
+overhead-dominated, not math-dominated. The whole run is ONE jitted
+``lax.scan`` (federated/trainer.py:142-146), so the overhead is *inside*
+the compiled program: candidate costs are the threefry RNG streams
+(3 fold_ins + dropout/reparam draws per client per step), the per-step
+``jnp.take`` corpus gather, f32 (vs bf16) matmuls, and the FedAvg
+psum/broadcast exchange.
+
+This probe times the SAME bench regime (V=5000, K=50, B=64, C=5,
+20 epochs) under ablations, each as its own freshly-compiled program:
+
+- ``baseline``     bench configuration exactly;
+- ``bf16``         compute_dtype="bfloat16" (MXU at 2x f32 rate);
+- ``no_dropout``   dropout=0.0 (removes 2 dropout mask draws/client/step);
+- ``no_exchange``  grads_to_share=() (FedAvg mix becomes identity: no
+                   psum, no broadcast — isolates the exchange cost);
+- ``bf16_nodrop``  both (the compounding check).
+
+Timing discipline matches bench.py: warm fit to compile + stage, then a
+timed fit whose ``program_segment`` phase isolates the compiled program
+from host schedule building. Reference framing: the reference's per-step
+cost is pure orchestration (server.py:417-420 sleeps); ours must be pure
+compute — this artifact says what it actually is.
+
+Usage: python experiments_scripts/step_time_probe.py [out_json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def run_variant(name: str, *, dropout=0.2, compute_dtype="float32",
+                grads_to_share=None) -> dict:
+    import jax
+    import numpy as np
+
+    from gfedntm_tpu.config import SHARE_ALL
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+    from gfedntm_tpu.models.avitm import AVITM
+    from gfedntm_tpu.utils.observability import MetricsLogger
+
+    n_clients, vocab, k, batch, epochs = 5, 5000, 50, 64, 20
+    corpus = generate_synthetic_corpus(
+        vocab_size=vocab, n_topics=k, n_docs=2000, nwords=(150, 250),
+        n_nodes=n_clients, frozen_topics=5, seed=0, materialize_docs=False,
+    )
+    idx2token = {i: f"wd{i}" for i in range(vocab)}
+    datasets = [
+        BowDataset(X=node.bow, idx2token=idx2token) for node in corpus.nodes
+    ]
+
+    template = AVITM(
+        input_size=vocab, n_components=k, hidden_sizes=(50, 50),
+        batch_size=batch, num_epochs=epochs, lr=2e-3, momentum=0.99,
+        seed=0, dropout=dropout, compute_dtype=compute_dtype,
+    )
+    trainer = FederatedTrainer(
+        template, n_clients=n_clients,
+        grads_to_share=tuple(grads_to_share)
+        if grads_to_share is not None else SHARE_ALL,
+    )
+
+    metrics = MetricsLogger(None)
+    t0 = time.perf_counter()
+    warm = trainer.fit(datasets, metrics=metrics)
+    jax.block_until_ready(warm.client_params)
+    compile_s = time.perf_counter() - t0
+    assert np.isfinite(warm.losses).all(), f"{name}: non-finite losses"
+
+    n_before = len(metrics.events("phase"))
+    t0 = time.perf_counter()
+    result = trainer.fit(datasets, metrics=metrics)
+    jax.block_until_ready(result.client_params)
+    steady_s = time.perf_counter() - t0
+    phases = metrics.events("phase")[n_before:]
+    program_s = sum(
+        r["seconds"] for r in phases if r["phase"] == "program_segment"
+    )
+    schedule_s = sum(
+        r["seconds"] for r in phases if r["phase"] == "build_schedules"
+    )
+    steps = int(result.losses.shape[0])
+    return {
+        "steps": steps,
+        "compile_and_first_run_s": round(compile_s, 2),
+        "steady_s": round(steady_s, 3),
+        "program_ms_per_step": round(program_s / steps * 1e3, 3),
+        "steady_ms_per_step": round(steady_s / steps * 1e3, 3),
+        "schedule_s": round(schedule_s, 3),
+        "docs_per_s": round(steps * 5 * 64 / steady_s, 1),
+        "final_mean_loss": float(result.losses[-1].mean()),
+    }
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1] if len(sys.argv) > 1 else "results/step_time_probe.json"
+    )
+    import jax
+
+    if os.environ.get("FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    backend = jax.default_backend()
+
+    variants = {
+        "baseline": {},
+        "bf16": {"compute_dtype": "bfloat16"},
+        "no_dropout": {"dropout": 0.0},
+        "no_exchange": {"grads_to_share": ()},
+        "bf16_nodrop": {"compute_dtype": "bfloat16", "dropout": 0.0},
+    }
+    report = {
+        "backend": backend,
+        "regime": "V=5000 K=50 B=64 C=5 epochs=20 (bench regime)",
+        "variants": {},
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+
+    def _flush():
+        # Incremental write after every variant: a failing variant (or a
+        # supervisor stall-kill) must not lose the measurements already
+        # taken — same lesson as bench_fused_largev's per-case capture.
+        base = report["variants"].get("baseline", {}).get(
+            "program_ms_per_step"
+        )
+        if base is not None:
+            report["attribution_ms"] = {
+                name: round(base - v["program_ms_per_step"], 3)
+                for name, v in report["variants"].items()
+                if name != "baseline" and "program_ms_per_step" in v
+            }
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+
+    for name, kw in variants.items():
+        print(f"[probe] {name} ...", flush=True)
+        try:
+            report["variants"][name] = run_variant(name, **kw)
+        except Exception as err:  # noqa: BLE001 — record, keep probing
+            report["variants"][name] = {
+                "error": f"{type(err).__name__}: {err}"[:600]
+            }
+        print(f"[probe] {name}: {report['variants'][name]}", flush=True)
+        _flush()
+    print(json.dumps({"probe": "done", "out": out_path}))
+
+
+if __name__ == "__main__":
+    main()

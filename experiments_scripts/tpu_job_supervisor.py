@@ -156,6 +156,8 @@ def main():
         ("soak", [py, "experiments_scripts/soak_fused_kernel.py"],
          2400, 4),
         ("bench", [py, "bench.py"], 1500, 3),
+        ("stepprobe", [py, "experiments_scripts/step_time_probe.py"],
+         2400, 2),
         ("ttq", [py, "experiments_scripts/time_to_quality.py"],
          3600, 3),
         ("parity", [py, "experiments_scripts/parity_vs_torch.py"],

@@ -1,0 +1,113 @@
+"""Compile-only probe of the fused backward kernel's Mosaic scoped-VMEM
+frontier on real TPU.
+
+The round-4 soak crashed in its tile sweep at (V=100k, B=256,
+**tile=4096**: the failing HLO's v_pad was 102400 = 25x4096): the
+one-pass backward (`_grads_kernel`) exceeded the 16 MB scoped-VMEM limit
+at 19.17 MB. All six default-tiling table cases — including (V=100k,
+B=256) at tile 2048 — had compiled and run, so the limit scales with
+B x TILE, not V. This probe compiles (never runs) the fused
+value_and_grad across (V, B, tile) combos and records pass/fail + the
+reported scoped size, giving the data for the batch-aware tile cap in
+`_pick_tile_v` (`_VMEM_TILE_ELEMS`): every b_pad*tile = 2^19 combo
+compiles; 256x4096 = 2^20 does not (it either VMEM-errors, as in the
+soak, or exceeds the probe's compile timeout).
+
+Usage: python experiments_scripts/vmem_frontier_probe.py [out_json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe_case(v: int, b: int, tile: int) -> dict:
+    import subprocess
+
+    # Each case in a fresh process: the tile knob is read at trace time and
+    # a poisoned Mosaic cache or leaked compile state must not leak across
+    # cases.
+    code = f"""
+import os
+os.environ["GFEDNTM_FUSED_TILE_V"] = "{tile}"
+# Probe the RAW requested geometry: with the production VMEM-frontier
+# clamp active, over-frontier combos would silently compile the clamped
+# tile and report ok for a geometry that never compiled.
+os.environ["GFEDNTM_FUSED_TILE_UNCLAMPED"] = "1"
+import jax, jax.numpy as jnp, numpy as np
+import sys
+sys.path.insert(0, "{_REPO}")
+from gfedntm_tpu.ops.fused_decoder import prodlda_recon_loss
+K = 50
+rng = np.random.default_rng(0)
+theta = jnp.asarray(rng.dirichlet(np.ones(K), size={b}).astype(np.float32))
+beta = jnp.asarray(rng.normal(size=(K, {v})).astype(np.float32))
+x = jnp.asarray(rng.integers(0, 3, size=({b}, {v})).astype(np.float32))
+mask = jnp.ones(({b},), jnp.float32)
+rm, rv = jnp.zeros(({v},)), jnp.ones(({v},))
+def loss(theta, beta):
+    rl, _, _ = prodlda_recon_loss(theta, beta, x, rm, rv, mask, True)
+    return jnp.sum(rl * mask)
+f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+f.lower(theta, beta).compile()
+print("COMPILE_OK")
+"""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=420, cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "timeout"}
+    ok = "COMPILE_OK" in r.stdout
+    out = {"ok": ok}
+    if not ok:
+        m = re.search(r"size ([0-9.]+)M and limit ([0-9.]+)M", r.stderr)
+        if m:
+            out["scoped_mb"] = float(m.group(1))
+            out["limit_mb"] = float(m.group(2))
+        else:
+            out["error"] = r.stderr.strip()[-400:]
+    return out
+
+
+def main() -> None:
+    out_path = (
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else "results/vmem_frontier_probe.json"
+    )
+    cases = [
+        # the observed frontier around the tile-4096 sweep crash: all
+        # default-tiling (2048) cases compiled and ran in the soak, so
+        # these first rows pin the known-good side of the frontier
+        (100_000, 256, 2048),   # compiled+ran in the soak (default tiling)
+        (50_000, 256, 2048),    # compiled+ran in the soak
+        (16_384, 256, 2048),    # compiled+ran in the soak
+        (100_000, 256, 1536),
+        (100_000, 256, 1024),
+        # the tile-sweep combos the soak would try next
+        (50_000, 64, 4096),
+        (50_000, 64, 8192),
+        (100_000, 256, 4096),
+        (100_000, 256, 8192),
+        (100_000, 64, 2048),
+    ]
+    report = {}
+    for v, b, tile in cases:
+        key = f"V{v}_B{b}_T{tile}"
+        report[key] = probe_case(v, b, tile)
+        print(f"{key}: {report[key]}", flush=True)
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps({"probe": "done", "out": out_path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,7 @@ on CPU for tests.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 
 import jax
@@ -65,36 +66,85 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _pick_tile_v(v: int) -> tuple[int, int]:
+# Mosaic scoped-VMEM ceiling for the kernel's [B_pad, TILE_V] working set,
+# in f32 elements. Evidence (TPU v5e, round 4): the soak's tile sweep died
+# compiling the backward at B=256, tile=4096 (v_pad 102400) with "Scoped
+# allocation with size 19.17M and limit 16.00M exceeded" — _grads_kernel
+# keeps ~5 [B,TILE] f32 temporaries plus the double-buffered x/beta/g_beta
+# block windows live per grid step — while the compile-only frontier probe
+# (results/vmem_frontier_probe.json) confirms every b_pad*tile = 2^19
+# combination the soak uses (256x2048, 64x8192, 64x4096) compiles clean.
+# 2^19 is therefore the largest *measured-good* product, not a proven
+# supremum; raise it only with a fresh probe run.
+_VMEM_TILE_ELEMS = 524_288
+_CLAMP_WARNED: set[tuple[int, int]] = set()
+
+
+def _pick_tile_v(v: int, b_pad: int = 8) -> tuple[int, int]:
     """Pick ``(tile_v, v_pad)``. V is padded *up to a multiple of the tile*
     rather than fitting the tile to ``round_up(v, 128)`` — the round-2 picker
     did the latter, and at V=50000 (v_pad=50048, divisible by nothing above
     128) degenerated to 391 sequential 128-wide grid steps. Padding V=50000
     to 51200 costs 2.4% wasted columns and keeps the MXU on 2048-wide tiles.
 
+    The tile is additionally capped so ``b_pad * tile_v`` stays within the
+    measured Mosaic scoped-VMEM frontier (``_VMEM_TILE_ELEMS``): the
+    backward kernel's live working set scales with B x TILE_V, and
+    exceeding the frontier is a hard compile error on TPU (the round-4
+    soak crash at V=100k, B=256, tile=4096).
+
     ``GFEDNTM_FUSED_TILE_V`` overrides the tile width (values are rounded
-    up to a multiple of 128) — the tuning knob behind
-    ``soak_fused_kernel.py``'s tile sweep; forward and backward read it
-    through the same path, so their geometries always agree within a
-    process. The knob is read at TRACE time: a jit-compiled function keeps
-    the tiling it was traced with (the jit cache is keyed on shapes, not
-    env vars), so changing it only affects functions traced afterwards —
-    sweep scripts must build a fresh closure per setting (as
+    up to a multiple of 128, then clamped to the VMEM frontier for the
+    batch at hand — a clamped request is logged once) — the tuning knob
+    behind ``soak_fused_kernel.py``'s tile sweep; forward and backward
+    read it through the same path, so their geometries always agree within
+    a process. The knob is read at TRACE time: a jit-compiled function
+    keeps the tiling it was traced with (the jit cache is keyed on shapes,
+    not env vars), so changing it only affects functions traced afterwards
+    — sweep scripts must build a fresh closure per setting (as
     ``soak_fused_kernel.py`` does)."""
     v = max(v, 128)
-    tile_cap = 2048
+    # GFEDNTM_FUSED_TILE_UNCLAMPED=1 disables the VMEM-frontier clamp so
+    # vmem_frontier_probe.py can compile the RAW requested geometry — with
+    # the clamp active the probe would silently test the clamped tile and
+    # report ok for combos it never compiled. Probe-only; never set it for
+    # training.
+    unclamped = bool(os.environ.get("GFEDNTM_FUSED_TILE_UNCLAMPED"))
+    vmem_cap = (
+        1 << 30 if unclamped
+        else max(128, _VMEM_TILE_ELEMS // max(b_pad, 8) // 128 * 128)
+    )
+    tile_cap = min(2048, vmem_cap)
     override = os.environ.get("GFEDNTM_FUSED_TILE_V")
     if override:
         try:
-            tile_cap = max(128, _round_up(int(override), 128))
+            requested = max(128, _round_up(int(override), 128))
         except ValueError:
             raise ValueError(
                 f"GFEDNTM_FUSED_TILE_V must be an integer; got {override!r}"
             ) from None
+        tile_cap = min(requested, vmem_cap)
+        if tile_cap < requested and (requested, b_pad) not in _CLAMP_WARNED:
+            _CLAMP_WARNED.add((requested, b_pad))
+            logging.getLogger(__name__).warning(
+                "GFEDNTM_FUSED_TILE_V=%d clamped to %d: b_pad=%d puts the "
+                "requested tile past the measured scoped-VMEM frontier "
+                "(b_pad*tile <= %d).",
+                requested, tile_cap, b_pad, _VMEM_TILE_ELEMS,
+            )
     if v <= tile_cap:
         v_pad = _round_up(v, 128)
         return v_pad, v_pad
     return tile_cap, _round_up(v, tile_cap)
+
+
+def resolve_tile_v(v: int, b: int) -> int:
+    """Public: the tile width the kernel will use for a (V, batch) case —
+    identical resolution path to ``_pad_geometry`` (same batch padding
+    rule), so sweep/bench tooling can label rows with the geometry that
+    actually runs."""
+    b_pad = _round_up(max(b, 8), 8)
+    return _pick_tile_v(v, b_pad)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +279,7 @@ def _loss_kernel(
 def _pad_geometry(b: int, k: int, v: int):
     b_pad = _round_up(max(b, 8), 8)
     k_pad = _round_up(max(k, 8), 8)
-    tile_v, v_pad = _pick_tile_v(v)
+    tile_v, v_pad = _pick_tile_v(v, b_pad)
     return b_pad, k_pad, tile_v, v_pad
 
 
@@ -874,10 +924,14 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
     # probing v = 2x the resolved tile width keeps the multi-tile Mosaic
     # lowering path exercised (a fixed v=4096 under an override >= 4096
     # would silently degrade to a single-tile probe and could greenlight a
-    # tiling that crashes at real V). The cache is keyed on the resolved
-    # tile width so changing the knob re-probes. A malformed override must
-    # degrade to the unfused path like every other probe failure — the
-    # "auto" never-crash contract — not raise out of here.
+    # tiling that crashes at real V). The probe runs at b=8, so the width
+    # resolved here is the WIDEST the override can produce (the VMEM
+    # frontier clamp only narrows tiles as B grows; batch-clamped runs use
+    # a narrower — smaller-working-set, better-tested — geometry than the
+    # one probed). The cache is keyed on that widest resolved width so
+    # changing the knob re-probes. A malformed override must degrade to
+    # the unfused path like every other probe failure — the "auto"
+    # never-crash contract — not raise out of here.
     try:
         tile_v, _ = _pick_tile_v(1 << 30)
     except ValueError as err:
