@@ -331,6 +331,8 @@ def bench_fused_largev(
     which flattens any compute difference (this is exactly what made the
     round-2 per-call numbers meaningless).
     """
+    from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
+
     interpret = backend == "cpu"  # CPU fallback: interpret mode (tiny V only)
     out = {}
     if cases is None:
@@ -343,8 +345,6 @@ def bench_fused_largev(
         # (Mosaic scoped-VMEM overflow) and dropped the whole artifact.
         # Error rows carry the resolved tile too: the geometry that failed
         # is exactly the diagnostic the artifact exists to preserve.
-        from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
-
         try:
             out[f"V{V}_B{B}"] = _fused_case(V, B, interpret)
         except Exception as err:  # noqa: BLE001 — record, keep sweeping

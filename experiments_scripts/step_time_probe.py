@@ -96,7 +96,7 @@ def run_variant(name: str, *, dropout=0.2, compute_dtype="float32",
         "program_ms_per_step": round(program_s / steps * 1e3, 3),
         "steady_ms_per_step": round(steady_s / steps * 1e3, 3),
         "schedule_s": round(schedule_s, 3),
-        "docs_per_s": round(steps * 5 * 64 / steady_s, 1),
+        "docs_per_s": round(steps * n_clients * batch / steady_s, 1),
         "final_mean_loss": float(result.losses[-1].mean()),
     }
 

@@ -114,6 +114,22 @@ def _pick_tile_v(v: int, b_pad: int = 8) -> tuple[int, int]:
         1 << 30 if unclamped
         else max(128, _VMEM_TILE_ELEMS // max(b_pad, 8) // 128 * 128)
     )
+    if not unclamped and b_pad * 128 > _VMEM_TILE_ELEMS and (
+        (-1, b_pad) not in _CLAMP_WARNED
+    ):
+        # The one-lane floor itself exceeds the measured frontier (b_pad >
+        # 4096): no tile width is known-safe, so the compile may hit the
+        # Mosaic scoped-VMEM limit. Warn rather than silently proceed —
+        # kernel_health probes at b=8 and cannot catch this, and the
+        # "auto" fused mode's runtime fallback is the recovery path.
+        _CLAMP_WARNED.add((-1, b_pad))
+        logging.getLogger(__name__).warning(
+            "fused decoder: b_pad=%d exceeds the measured scoped-VMEM "
+            "frontier even at the minimum 128-wide tile (b_pad*tile <= %d);"
+            " the kernel may fail to compile — consider a smaller batch or "
+            "the unfused path.",
+            b_pad, _VMEM_TILE_ELEMS,
+        )
     tile_cap = min(2048, vmem_cap)
     override = os.environ.get("GFEDNTM_FUSED_TILE_V")
     if override:

@@ -279,6 +279,74 @@ class TestTilePicker:
         assert_grad_parity(theta, beta, x, rm, rv, mask=mask, max_rel=2e-4)
 
 
+    def test_vmem_frontier_clamp_scales_with_batch(self, monkeypatch):
+        """Round-4 fix: the backward kernel's scoped-VMEM working set
+        scales with B x TILE_V; b_pad*tile must stay within the measured
+        2^19 frontier (the soak crashed compiling B=256 x tile=4096:
+        19.17M > the 16M Mosaic limit)."""
+        from gfedntm_tpu.ops.fused_decoder import (
+            _VMEM_TILE_ELEMS,
+            _pick_tile_v,
+        )
+
+        monkeypatch.delenv("GFEDNTM_FUSED_TILE_V", raising=False)
+        monkeypatch.delenv("GFEDNTM_FUSED_TILE_UNCLAMPED", raising=False)
+        # default geometry unchanged at small batch
+        assert _pick_tile_v(100_000, 64) == (2048, 100_352)
+        # large batch narrows the auto tile to stay inside the frontier
+        tile_b256, _ = _pick_tile_v(100_000, 256)
+        assert tile_b256 * 256 <= _VMEM_TILE_ELEMS
+        # past-frontier batches keep the one-lane floor (shape validity)
+        # and warn that no tile width is known-safe
+        import logging as _logging
+
+        from gfedntm_tpu.ops import fused_decoder as fd
+
+        fd._CLAMP_WARNED.clear()
+        records: list = []
+        handler = _logging.Handler()
+        handler.emit = records.append
+        logger = _logging.getLogger("gfedntm_tpu.ops.fused_decoder")
+        logger.addHandler(handler)
+        try:
+            assert _pick_tile_v(100_000, 8192)[0] == 128
+        finally:
+            logger.removeHandler(handler)
+        assert any("frontier" in r.getMessage() for r in records)
+
+    def test_override_clamped_to_frontier(self, monkeypatch):
+        """An operator tile request past the frontier is clamped (not
+        honored into a guaranteed compile crash), and the probe-only
+        bypass restores the raw geometry."""
+        from gfedntm_tpu.ops.fused_decoder import (
+            _pick_tile_v,
+            resolve_tile_v,
+        )
+
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "4096")
+        assert _pick_tile_v(100_000, 256)[0] == 2048  # clamped
+        assert _pick_tile_v(100_000, 64)[0] == 4096   # within frontier
+        assert resolve_tile_v(100_000, 256) == 2048
+        assert resolve_tile_v(100_000, 60) == 4096    # b_pad=64 rule shared
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_UNCLAMPED", "1")
+        assert _pick_tile_v(100_000, 256)[0] == 4096  # probe bypass
+
+    def test_soak_error_rows_keep_geometry(self, monkeypatch):
+        """bench_fused_largev must record a failing case (with its
+        resolved tile) instead of losing the artifact — the round-4 soak
+        died at its last sweep case and dropped every measured row."""
+        import bench as bench_mod
+
+        def boom(V, B, interpret):
+            raise RuntimeError("mosaic scoped vmem")
+
+        monkeypatch.setattr(bench_mod, "_fused_case", boom)
+        table = bench_mod.bench_fused_largev("cpu")
+        row = table["V2048_B64"]
+        assert row["parity"] is False
+        assert "mosaic scoped vmem" in row["error"]
+        assert row["tile_v"] == 2048
+
     @pytest.mark.parametrize("tile", ["256", "512"])
     def test_tile_override_parity_fwd_and_grad(self, tile, monkeypatch):
         """The GFEDNTM_FUSED_TILE_V sweep configurations must be
