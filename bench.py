@@ -270,7 +270,11 @@ def run(backend: str) -> dict:
             n_clients * batch / (program_step_ms / 1e3), 1
         ),
         "profile_trace_dir": trace_dir,
+        # With a persistent XLA cache (the supervisor sets it so stall-kill
+        # relaunches replay compiles from disk), this measures cache
+        # deserialization, not compilation — the field below says which.
         "compile_and_first_run_s": round(compile_s, 1),
+        "compilation_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
         "steady_state_s": round(steady_s, 1),
         "regime": {
             "n_clients": n_clients, "vocab": vocab, "k": k, "batch": batch,

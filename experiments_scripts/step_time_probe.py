@@ -91,6 +91,9 @@ def run_variant(name: str, *, dropout=0.2, compute_dtype="float32",
     steps = int(result.losses.shape[0])
     return {
         "steps": steps,
+        # Cache-hit compiles (supervisor sets JAX_COMPILATION_CACHE_DIR)
+        # measure deserialization, not compilation; see the report-level
+        # compilation_cache_dir field.
         "compile_and_first_run_s": round(compile_s, 2),
         "steady_s": round(steady_s, 3),
         "program_ms_per_step": round(program_s / steps * 1e3, 3),
@@ -124,6 +127,7 @@ def main() -> None:
     report = {
         "backend": backend,
         "regime": "V=5000 K=50 B=64 C=5 epochs=20 (bench regime)",
+        "compilation_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
         "variants": {},
     }
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)

@@ -347,6 +347,9 @@ def main(out_path: str | None = None) -> dict:
             "global step on top (server.py:417-420,472)"
         ),
         "gfedntm_compile_and_stage_s": round(compile_s, 1),
+        # Measures cache deserialization, not compilation, when the
+        # supervisor's persistent XLA cache is active:
+        "compilation_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
         "torch_federated_curve": torch_fed_curve,
         "torch_curve": torch_curve,
         "gfedntm_curve": jax_curve,

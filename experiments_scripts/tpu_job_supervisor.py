@@ -23,6 +23,11 @@ import time
 REPO = "/root/repo"
 LOG = open("/tmp/supervisor.log", "a", buffering=1)
 STALL_S = 600
+# Persistent XLA compilation cache: a relaunched job (stall kill, tunnel
+# flake) replays its compiles from disk instead of re-paying 20-40 s per
+# program over the tunnel.
+CACHE_DIR = "/tmp/jax_compile_cache"
+JOB_ENV = dict(os.environ, JAX_COMPILATION_CACHE_DIR=CACHE_DIR)
 PROBE_CMD = [sys.executable, "-c", "import jax; print(jax.default_backend())"]
 
 
@@ -76,18 +81,28 @@ def io_bytes(pid):
     the direct child's pid; bench.py and the sweep runners do their real
     work in grandchildren, whose I/O is not reflected in the parent's
     counters until reaped — a parent blocked in wait() for >STALL_S would
-    otherwise be killed as stalled while its child works (ADVICE r3)."""
-    total, found = 0, False
+    otherwise be killed as stalled while its child works (ADVICE r3).
+
+    Returns (io_total, cpu_ticks): a Mosaic compile of a large-V kernel
+    geometry is minutes of pure in-process CPU with zero read/write
+    syscalls (round 4 watched a live soak, cputime growing, get killed at
+    600 s of flat I/O mid-compile), so CPU-time growth must count as
+    liveness too. A true tunnel hang is flat on BOTH counters — the
+    plugin's re-dial loop sleeps."""
+    total, cpu, found = 0, 0, False
     for entry in os.listdir("/proc"):
         if not entry.isdigit():
             continue
         try:
             with open(f"/proc/{entry}/stat") as f:
-                # field 5 (index 4 after comm) is pgrp; comm may contain
-                # spaces, so split after the closing paren.
-                pgrp = int(f.read().rsplit(")", 1)[1].split()[2])
-            if pgrp != pid:
+                # comm may contain spaces: split after the closing paren.
+                # pgrp is index 2 of the remainder; utime/stime are
+                # indices 11/12 (cutime at 13 is deliberately excluded —
+                # it jumps when children are reaped).
+                rest = f.read().rsplit(")", 1)[1].split()
+            if int(rest[2]) != pid:
                 continue
+            cpu += int(rest[11]) + int(rest[12])
             with open(f"/proc/{entry}/io") as f:
                 d = dict(
                     line.strip().split(": ") for line in f if ": " in line
@@ -96,7 +111,7 @@ def io_bytes(pid):
             found = True
         except (OSError, ValueError, IndexError):
             continue  # raced a process exit or unreadable entry
-    return total if found else None
+    return (total, cpu) if found else None
 
 
 def run_watched(name, cmd, job_timeout, attempts=6):
@@ -109,7 +124,7 @@ def run_watched(name, cmd, job_timeout, attempts=6):
             # leave a child holding the single-tenant chip).
             proc = subprocess.Popen(
                 cmd, stdout=out, stderr=out, cwd=REPO,
-                start_new_session=True,
+                start_new_session=True, env=JOB_ENV,
             )
         t0 = time.time()
         last_io, last_change = io_bytes(proc.pid), time.time()
@@ -123,10 +138,22 @@ def run_watched(name, cmd, job_timeout, attempts=6):
                 break
             now = time.time()
             cur = io_bytes(proc.pid)
-            if cur is not None and cur != last_io:
+            if cur is not None and last_io is not None:
+                io_moved = cur[0] != last_io[0]
+                # Require REAL CPU progress — >=5% average CPU since the
+                # last liveness reset (a Mosaic compile runs near 100%),
+                # not any tick: the plugin's re-dial loop burns a few
+                # ticks per reconnect attempt, which must not keep a hung
+                # job alive forever (USER_HZ=100 ticks/s).
+                cpu_moved = (
+                    cur[1] - last_io[1] > 0.05 * (now - last_change) * 100
+                )
+                if io_moved or cpu_moved:
+                    last_io, last_change = cur, now
+            elif cur is not None:
                 last_io, last_change = cur, now
             if now - last_change > STALL_S:
-                log(f"{name}: I/O flat {STALL_S}s -> kill (stall)")
+                log(f"{name}: I/O+CPU flat {STALL_S}s -> kill (stall)")
                 _kill_group(proc)
                 break
             if now - t0 > job_timeout:

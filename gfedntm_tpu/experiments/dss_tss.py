@@ -340,6 +340,21 @@ def run_simulation(
         for arm in arms for stat in stats for agg in ("mean", "std")
     }
     t_start = time.perf_counter()
+    # elapsed_s must record cumulative compute cost, not this process's
+    # wall time: a full checkpoint-resume replays a multi-hour sweep in
+    # seconds, and overwriting the field with ~0 erases the only record of
+    # what the artifact cost to produce (round-4 review finding).
+    prior_elapsed = 0.0
+    if results_dir is not None:
+        prior_json = Path(results_dir) / "results.json"
+        if prior_json.exists():
+            try:
+                with open(prior_json, encoding="utf8") as f:
+                    prior_elapsed = float(
+                        json.load(f).get("meta", {}).get("elapsed_s", 0.0)
+                    )
+            except (ValueError, OSError):
+                prior_elapsed = 0.0
     iter_backends: list[str] = []
     stat_counts: dict[str, list[int]] = {
         f"{arm}_{stat}": [] for arm in arms for stat in stats
@@ -438,7 +453,9 @@ def run_simulation(
             "iters": cfg.iters,
             "seed": cfg.seed,
             "experiment": cfg.experiment,
-            "elapsed_s": round(time.perf_counter() - t_start, 1),
+            "elapsed_s": round(
+                prior_elapsed + time.perf_counter() - t_start, 1
+            ),
             "regime": {
                 "vocab_size": cfg.vocab_size,
                 "n_topics": cfg.n_topics,
