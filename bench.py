@@ -317,6 +317,12 @@ def _grad_oracle_f64(theta, beta, x, mask, eps=1e-5, floor=1e-10):
     return gz @ bt.T, th.T @ gz
 
 
+# K for all fused-kernel soak cases. ONE constant: the tile-label paths
+# (error rows, sweep live-case filter in soak_fused_kernel.py) resolve
+# geometry with it and must agree with the K the cases actually run.
+SOAK_K = 50
+
+
 def bench_fused_largev(
     backend: str,
     v_list=(16384, 50_000, 100_000),
@@ -353,7 +359,7 @@ def bench_fused_largev(
             out[f"V{V}_B{B}"] = _fused_case(V, B, interpret)
         except Exception as err:  # noqa: BLE001 — record, keep sweeping
             out[f"V{V}_B{B}"] = {
-                "tile_v": resolve_tile_v(V, B),
+                "tile_v": resolve_tile_v(V, B, SOAK_K),
                 "parity": False,
                 "error": f"{type(err).__name__}: {err}"[:600],
             }
@@ -371,13 +377,14 @@ def _fused_case(V: int, B: int, interpret: bool) -> dict:
         resolve_tile_v,
     )
 
-    K = 50
+    K = SOAK_K
     # The tile width the kernel will actually use for this case: the
     # VMEM-frontier clamp can silently shrink an operator-requested
     # GFEDNTM_FUSED_TILE_V at large B, so sweep rows must record the
     # resolved geometry or wider-tile labels would report baseline-tile
-    # numbers as sweep results.
-    resolved_tile_v = resolve_tile_v(V, B)
+    # numbers as sweep results. K matters: small-K cases resolve the
+    # widened (8192-cap) tiling.
+    resolved_tile_v = resolve_tile_v(V, B, K)
     rng = np.random.default_rng(0)
     theta = jnp.asarray(
         rng.dirichlet(np.ones(K), size=B).astype(np.float32)

@@ -21,11 +21,12 @@ import sys
 def _pick_tile_v_default(v: int, b: int) -> int:
     """Tile width the kernel resolves with NO operator override (the
     baseline geometry), independent of the current env state."""
+    from bench import SOAK_K
     from gfedntm_tpu.ops.fused_decoder import resolve_tile_v
 
     saved = os.environ.pop("GFEDNTM_FUSED_TILE_V", None)
     try:
-        return resolve_tile_v(v, b)
+        return resolve_tile_v(v, b, SOAK_K)
     finally:
         if saved is not None:
             os.environ["GFEDNTM_FUSED_TILE_V"] = saved
@@ -72,9 +73,11 @@ def main() -> None:
                 # requested tile back to the default geometry (large B):
                 # re-benching them would just duplicate the baseline row
                 # under a wider-tile label.
+                from bench import SOAK_K as _soak_k
                 live_cases = [
                     (v, b) for v, b in sweep_cases
-                    if resolve_tile_v(v, b) != _pick_tile_v_default(v, b)
+                    if resolve_tile_v(v, b, _soak_k)
+                    != _pick_tile_v_default(v, b)
                 ]
                 if live_cases:
                     tile_sweep[f"tile{tile}"] = bench_fused_largev(

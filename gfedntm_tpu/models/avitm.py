@@ -181,7 +181,9 @@ class AVITM:
             # (one cached probe per backend; see ops.fused_decoder).
             from gfedntm_tpu.ops.fused_decoder import kernel_health
 
-            ok, err = kernel_health(backend)
+            ok, err = kernel_health(
+                backend, b=self.batch_size, k=self.n_components
+            )
             if not ok:
                 self.logger.warning(
                     "Pallas fused decoder unavailable on backend %r (%s); "

@@ -80,12 +80,24 @@ _VMEM_TILE_ELEMS = 524_288
 _CLAMP_WARNED: set[tuple[int, int]] = set()
 
 
-def _pick_tile_v(v: int, b_pad: int = 8) -> tuple[int, int]:
+def _pick_tile_v(
+    v: int, b_pad: int = 8, k_pad: int | None = None
+) -> tuple[int, int]:
     """Pick ``(tile_v, v_pad)``. V is padded *up to a multiple of the tile*
     rather than fitting the tile to ``round_up(v, 128)`` — the round-2 picker
     did the latter, and at V=50000 (v_pad=50048, divisible by nothing above
     128) degenerated to 391 sequential 128-wide grid steps. Padding V=50000
     to 51200 costs 2.4% wasted columns and keeps the MXU on 2048-wide tiles.
+
+    When the caller supplies ``k_pad`` and the model is small-K
+    (k_pad <= 64, bounding the regime the VMEM frontier was actually
+    measured in — the frontier probe and the round-4 TPU tile sweep both
+    ran at K=50 -> k_pad=56), the default cap widens from 2048 to 8192:
+    the sweep measured frontier-wide tiles strictly faster at small batch
+    (V=50k B=64: 0.97x unfused at tile 2048 -> 1.63x at tile 8192).
+    Larger K keeps the proven 2048 cap because beta/grad tiles are
+    ``[K_pad, TILE_V]`` VMEM buffers the frontier measurement never
+    exercised.
 
     The tile is additionally capped so ``b_pad * tile_v`` stays within the
     measured Mosaic scoped-VMEM frontier (``_VMEM_TILE_ELEMS``): the
@@ -130,7 +142,8 @@ def _pick_tile_v(v: int, b_pad: int = 8) -> tuple[int, int]:
             "the unfused path.",
             b_pad, _VMEM_TILE_ELEMS,
         )
-    tile_cap = min(2048, vmem_cap)
+    wide_ok = k_pad is not None and k_pad <= 64
+    tile_cap = min(8192 if wide_ok else 2048, vmem_cap)
     override = os.environ.get("GFEDNTM_FUSED_TILE_V")
     if override:
         try:
@@ -154,13 +167,15 @@ def _pick_tile_v(v: int, b_pad: int = 8) -> tuple[int, int]:
     return tile_cap, _round_up(v, tile_cap)
 
 
-def resolve_tile_v(v: int, b: int) -> int:
-    """Public: the tile width the kernel will use for a (V, batch) case —
-    identical resolution path to ``_pad_geometry`` (same batch padding
-    rule), so sweep/bench tooling can label rows with the geometry that
-    actually runs."""
+def resolve_tile_v(v: int, b: int, k: int | None = None) -> int:
+    """Public: the tile width the kernel will use for a (V, batch[, K])
+    case — identical resolution path to ``_pad_geometry`` (same padding
+    rules), so sweep/bench tooling can label rows with the geometry that
+    actually runs. Omitting ``k`` resolves the conservative (2048-cap)
+    geometry; pass the model's K to see the small-K widened tiling."""
     b_pad = _round_up(max(b, 8), 8)
-    return _pick_tile_v(v, b_pad)[0]
+    k_pad = None if k is None else _round_up(max(k, 8), 8)
+    return _pick_tile_v(v, b_pad, k_pad)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -295,7 +310,7 @@ def _loss_kernel(
 def _pad_geometry(b: int, k: int, v: int):
     b_pad = _round_up(max(b, 8), 8)
     k_pad = _round_up(max(k, 8), 8)
-    tile_v, v_pad = _pick_tile_v(v, b_pad)
+    tile_v, v_pad = _pick_tile_v(v, b_pad, k_pad)
     return b_pad, k_pad, tile_v, v_pad
 
 
@@ -919,7 +934,9 @@ def _resolve_interpret(interpret: bool | None) -> bool:
 _KERNEL_HEALTH: dict[str, tuple[bool, str]] = {}
 
 
-def kernel_health(backend: str | None = None) -> tuple[bool, str]:
+def kernel_health(
+    backend: str | None = None, *, b: int = 8, k: int = 8
+) -> tuple[bool, str]:
     """One-time compile+run probe of the *compiled* (non-interpret) kernel.
 
     Round 2 shipped a kernel whose blockspecs passed every interpret-mode
@@ -929,13 +946,21 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
     the (B, 1) online-softmax accumulators — so ``fused_decoder="auto"``
     can fall back to the reference XLA loss instead of crashing the run.
 
-    Returns ``(ok, error_string)``; the result is cached per backend.
+    Pass the calling model's ``b`` (batch) and ``k`` (topics): the probe
+    then compiles the caller's OWN geometry class (padded batch/K and the
+    tile width those resolve, including the small-K widened tiling) — a
+    wide-tile probe failure must not disable the fused path for a large-K
+    model that would run the narrow proven geometry, and vice versa.
+
+    Returns ``(ok, error_string)``; cached per (backend, geometry).
     """
     if backend is None:
         try:
             backend = jax.default_backend()
         except RuntimeError as err:  # no usable backend at all
             return False, repr(err)
+    b_pad = _round_up(max(b, 8), 8)
+    k_pad = _round_up(max(k, 8), 8)
     # Probe at n_tiles=2 REGARDLESS of the GFEDNTM_FUSED_TILE_V override:
     # probing v = 2x the resolved tile width keeps the multi-tile Mosaic
     # lowering path exercised (a fixed v=4096 under an override >= 4096
@@ -949,15 +974,18 @@ def kernel_health(backend: str | None = None) -> tuple[bool, str]:
     # the unfused path like every other probe failure — the "auto"
     # never-crash contract — not raise out of here.
     try:
-        tile_v, _ = _pick_tile_v(1 << 30)
+        # Resolve the widest tiling the caller's geometry can reach (huge
+        # V): the probe then compiles the same (b_pad, k_pad, tile) class
+        # the caller's real training will use.
+        tile_v, _ = _pick_tile_v(1 << 30, b_pad, k_pad)
     except ValueError as err:
         return False, repr(err)
-    cache_key = f"{backend}:tile{tile_v}"
+    cache_key = f"{backend}:b{b_pad}k{k_pad}tile{tile_v}"
     cached = _KERNEL_HEALTH.get(cache_key)
     if cached is not None:
         return cached
     try:
-        b, k, v = 8, 8, 2 * tile_v  # n_tiles=2: the tiling regime
+        b, k, v = b_pad, k_pad, 2 * tile_v  # n_tiles=2: the tiling regime
         key = jax.random.PRNGKey(0)
         theta = jax.random.uniform(key, (b, k))
         beta = jax.random.normal(key, (k, v))

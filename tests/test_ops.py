@@ -314,6 +314,32 @@ class TestTilePicker:
             logger.removeHandler(handler)
         assert any("frontier" in r.getMessage() for r in records)
 
+    def test_small_k_widens_default_tile(self, monkeypatch):
+        """Round-4 TPU tile sweep: at V=50k B=64 the 2048 default tile only
+        broke even (0.97x unfused) while the frontier-wide 8192 tile ran
+        1.63x — so small-K models (the regime the frontier was measured
+        in, K=50) default to frontier-wide tiles. Large K keeps the
+        proven 2048 cap; the b_pad*tile frontier still binds."""
+        from gfedntm_tpu.ops.fused_decoder import (
+            _VMEM_TILE_ELEMS,
+            _pick_tile_v,
+            resolve_tile_v,
+        )
+
+        monkeypatch.delenv("GFEDNTM_FUSED_TILE_V", raising=False)
+        monkeypatch.delenv("GFEDNTM_FUSED_TILE_UNCLAMPED", raising=False)
+        # K=50 (k_pad=56): widened to the frontier width at B=64
+        assert _pick_tile_v(50_000, 64, 56) == (8192, 57_344)
+        assert resolve_tile_v(50_000, 64, 50) == 8192
+        # the frontier still narrows the tile as batch grows
+        tile_b256, _ = _pick_tile_v(50_000, 256, 56)
+        assert tile_b256 == 2048 and tile_b256 * 256 <= _VMEM_TILE_ELEMS
+        # past the measured regime (k_pad > 64): conservative cap
+        assert _pick_tile_v(50_000, 64, 128)[0] == 2048
+        assert _pick_tile_v(50_000, 64, 256)[0] == 2048
+        # k omitted: legacy conservative resolution is unchanged
+        assert _pick_tile_v(50_000, 64)[0] == 2048
+
     def test_override_clamped_to_frontier(self, monkeypatch):
         """An operator tile request past the frontier is clamped (not
         honored into a guaranteed compile crash), and the probe-only
@@ -375,8 +401,11 @@ class TestFailSafe:
     def test_kernel_health_caches_per_backend_and_tile(self):
         from gfedntm_tpu.ops import fused_decoder as fd
 
-        tile_v, _ = fd._pick_tile_v(1 << 30)
-        key = f"cpu:tile{tile_v}"
+        # kernel_health probes the caller's geometry class (default
+        # b=8/k=8 resolves the small-K widened tiling) and keys the cache
+        # on backend + padded geometry — mirror that resolution here.
+        tile_v, _ = fd._pick_tile_v(1 << 30, 8, 8)
+        key = f"cpu:b8k8tile{tile_v}"
         fd._KERNEL_HEALTH.pop(key, None)
         ok, err = fd.kernel_health("cpu")
         assert ok and err == ""
@@ -405,9 +434,9 @@ class TestFailSafe:
         from gfedntm_tpu.ops import fused_decoder as fd
 
         monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "8192")
-        tile_v, _ = fd._pick_tile_v(1 << 30)
+        tile_v, _ = fd._pick_tile_v(1 << 30, 8, 8)
         assert tile_v == 8192
-        key = f"cpu:tile{tile_v}"
+        key = f"cpu:b8k8tile{tile_v}"
         fd._KERNEL_HEALTH.pop(key, None)
         ok, err = fd.kernel_health("cpu")
         assert ok and err == ""
