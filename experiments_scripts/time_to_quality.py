@@ -262,6 +262,32 @@ def main(out_path: str | None = None) -> dict:
     print(f"jax arm ({backend}): {len(jax_curve)} epochs, "
           f"final TSS {jax_curve[-1]['tss']}", flush=True)
 
+    # ---- final topic quality, all three arms ----------------------------
+    # Answers whether the federated arm's lower topic diversity (seen in
+    # parity_vs_torch) is an implementation artifact or a property of the
+    # reference's per-minibatch FedAvg itself: the torch-federated arm
+    # runs the reference's own model/loss/optimizer under the same
+    # averaging, so matching diversity/NPMI here pins it on the algorithm.
+    from gfedntm_tpu.eval.metrics import npmi_coherence, topic_diversity
+
+    def topics_of(beta, id2tok):
+        top = np.argsort(-np.asarray(beta), axis=1)[:, :10]
+        return [[id2tok[int(i)] for i in row] for row in top]
+
+    final_topic_quality = {}
+    for arm, (beta, idt) in {
+        "torch_centralized": (torch_snaps[-1][1], t_id2token),
+        "torch_federated": (torch_fed_snaps[-1][1], t_id2tok_full),
+        "gfedntm_tpu_federated": (jax_snaps[-1][1], idx2token),
+    }.items():
+        tops = topics_of(beta, idt)
+        final_topic_quality[arm] = {
+            "topic_diversity_top10": round(topic_diversity(tops, 10), 4),
+            "npmi": round(npmi_coherence(tops, union_docs), 4),
+        }
+    print("final topic quality:", json.dumps(final_topic_quality),
+          flush=True)
+
     # ---- time-to-target ladder ------------------------------------------
     # The north star compares like with like: the reference's federated
     # algorithm (its compute floor) vs this framework's federated SPMD run
@@ -340,6 +366,7 @@ def main(out_path: str | None = None) -> dict:
         ),
         "baseline_tss_random": round(baseline_tss, 4),
         "joint_plateau_tss": round(plateau, 4),
+        "final_topic_quality": final_topic_quality,
         "targets": ladder,
         "torch_note": (
             "centralized fit = the reference's compute-only best case; its "
