@@ -440,13 +440,12 @@ class FederatedTrainer:
 
         # Server-side global model: the last weighted average of shared
         # leaves (identical across clients post-exchange) + client 0's
-        # non-shared leaves for completeness. One batched device_get for
-        # the whole tree: per-leaf np.asarray costs one tunnel round-trip
-        # PER LEAF (a visible slice of steady-fit wall time on TPU).
-        with phase_timer(metrics, "materialize_global"):
-            global_params = jax.device_get(
-                jax.tree.map(lambda leaf: leaf[0], params)
-            )
+        # non-shared leaves for completeness. Stays DEVICE-resident: the
+        # only in-repo consumer (make_global_model) feeds it straight back
+        # to device, and host materialization costs real tunnel time
+        # (per-leaf np.asarray was ~0.6 s/fit; even one batched device_get
+        # is ~0.12 s). Callers that want numpy apply np.asarray lazily.
+        global_params = jax.tree.map(lambda leaf: leaf[0], params)
 
         epoch_losses: list[list[float]] = []
         for c in range(C):
