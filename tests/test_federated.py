@@ -155,6 +155,26 @@ def test_one_step_exchange_matches_manual_average():
     )
 
 
+def test_make_global_model_and_device_resident_global_params():
+    """`global_params` stays device-resident (round 4: host
+    materialization cost ~0.6 s/fit in tunnel round-trips) but must
+    still (a) convert to numpy lazily, (b) equal client 0's post-psum
+    shared leaves, and (c) feed `make_global_model` -> `get_topics`."""
+    dsets, _ = _datasets(2, n_docs=32)
+    ft = FederatedTrainer(_template(num_epochs=1), n_clients=2)
+    res = ft.fit(dsets)
+
+    beta_global = np.asarray(res.global_params["beta"])  # lazy host copy
+    np.testing.assert_array_equal(
+        beta_global, np.asarray(res.client_params["beta"][0])
+    )
+
+    gm = ft.make_global_model(res)
+    gm.train_data = dsets[0]
+    topics = gm.get_topics(5)
+    assert len(topics) == K and all(len(t) == 5 for t in topics)
+
+
 def test_unequal_client_sizes_cycle_epochs():
     """Clients with different dataset sizes run the same number of global
     steps; the smaller client cycles extra epochs (federated_avitm.py:114-138
