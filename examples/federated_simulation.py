@@ -7,10 +7,19 @@ On a multi-device host each client maps to its own device; on one device the
 clients batch into a single vmapped program.
 """
 
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# FORCE_CPU=1 pins the CPU backend BEFORE any jax backend query -- on a
+# machine whose TPU tunnel is down, backend init hangs indefinitely
+# (same convention as experiments_scripts/).
+if os.environ.get("FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 

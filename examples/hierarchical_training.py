@@ -12,9 +12,15 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import jax
+# FORCE_CPU=1 pins the CPU backend BEFORE any jax backend query -- on a
+# machine whose TPU tunnel is down, backend init hangs indefinitely
+# (same convention as experiments_scripts/).
+import os
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
 from gfedntm_tpu.experiments.tm_wrapper import TMWrapper
