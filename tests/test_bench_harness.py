@@ -1,0 +1,84 @@
+"""Driver-robustness of bench.py's top-level orchestration.
+
+VERDICT r4 weak #1: the round-4 official record silently degraded to CPU
+after two tunnel timeouts while the real TPU number lived only in prose.
+The bench now (a) banks every successful live-TPU run as a committed
+artifact and (b) when live TPU is unreachable, emits that banked artifact
+with explicit ``provenance: cached`` instead of a CPU number presented as
+the round's result. These tests pin that logic (pure host-side — no jax).
+"""
+
+import json
+import os
+
+import bench as bench_mod
+
+
+def _write_artifact(path, backend="tpu", value=123456.7):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "metric": "federated_prodlda_5client_throughput",
+                "value": value,
+                "unit": "docs/s",
+                "backend": backend,
+                "captured_at_commit": "abc123def456",
+            },
+            f,
+        )
+
+
+class TestCachedFallback:
+    def test_cached_summary_marks_provenance(self, tmp_path, monkeypatch):
+        artifact = tmp_path / "bench_tpu" / "bench_latest.json"
+        _write_artifact(str(artifact))
+        monkeypatch.setattr(bench_mod, "_TPU_ARTIFACT", str(artifact))
+        summary = bench_mod._cached_tpu_summary()
+        assert summary is not None
+        assert summary["provenance"] == "cached"
+        assert summary["backend"] == "tpu"
+        assert "abc123def456"[:12] in summary["provenance_note"]
+
+    def test_no_artifact_returns_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            bench_mod, "_TPU_ARTIFACT", str(tmp_path / "missing.json")
+        )
+        assert bench_mod._cached_tpu_summary() is None
+
+    def test_cpu_artifact_rejected(self, tmp_path, monkeypatch):
+        """A banked CPU-backend record must never be served as the TPU
+        fallback — that would re-create the silent-degradation bug."""
+        artifact = tmp_path / "bench_latest.json"
+        _write_artifact(str(artifact), backend="cpu")
+        monkeypatch.setattr(bench_mod, "_TPU_ARTIFACT", str(artifact))
+        assert bench_mod._cached_tpu_summary() is None
+
+    def test_corrupt_artifact_returns_none(self, tmp_path, monkeypatch):
+        artifact = tmp_path / "bench_latest.json"
+        artifact.write_text("{not json")
+        monkeypatch.setattr(bench_mod, "_TPU_ARTIFACT", str(artifact))
+        assert bench_mod._cached_tpu_summary() is None
+
+
+class TestPersistArtifact:
+    def test_persist_writes_record(self, tmp_path, monkeypatch):
+        artifact = tmp_path / "bench_tpu" / "bench_latest.json"
+        monkeypatch.setattr(bench_mod, "_TPU_ARTIFACT", str(artifact))
+        monkeypatch.setenv("BENCH_NO_GIT", "1")
+        bench_mod._persist_tpu_artifact(
+            {"metric": "m", "value": 1.0, "backend": "tpu"}
+        )
+        record = json.loads(artifact.read_text())
+        assert record["backend"] == "tpu"
+        assert record["captured_unix_time"] > 0
+        # The banked record round-trips through the cached path.
+        summary = bench_mod._cached_tpu_summary()
+        assert summary["provenance"] == "cached"
+
+    def test_persist_never_raises(self, monkeypatch):
+        monkeypatch.setattr(
+            bench_mod, "_TPU_ARTIFACT", "/proc/definitely/not/writable.json"
+        )
+        monkeypatch.setenv("BENCH_NO_GIT", "1")
+        bench_mod._persist_tpu_artifact({"backend": "tpu"})  # must not raise
