@@ -71,25 +71,35 @@ def build_federated_program(
     tx,
     share_mask: Any,
     mesh: Mesh,
-    total_weight: float,
     family: str = "avitm",
     beta_weight: float = 1.0,
     axis_name: str = "clients",
+    conditional_exchange: bool = False,
 ):
     """Compile the whole-federation step loop.
 
     Returns ``run(params, batch_stats, opt_state, data, weights, client_ids,
-    indices, masks, step_ids, rng) -> (params, batch_stats, opt_state,
-    losses)`` where every state tree has a leading [C_pad] client axis
-    sharded over the mesh, ``indices``/``masks`` are [S, C_pad, B],
-    ``step_ids`` is the [S] vector of absolute global-step numbers (the
-    per-step RNG fold key, so checkpoint-resumed runs reproduce unresumed
-    ones), and ``losses`` is [S, C_pad].
+    indices, masks, step_ids, exchange, total_weight, rng) -> (params,
+    batch_stats, opt_state, losses)`` where every state tree has a leading
+    [C_pad] client axis sharded over the mesh, ``indices``/``masks`` are
+    [S, C_pad, B], ``step_ids`` is the [S] vector of absolute global-step
+    numbers (the per-step RNG fold key, so checkpoint-resumed runs reproduce
+    unresumed ones), ``exchange`` is the [S] bool vector saying which steps
+    end with a FedAvg exchange (all-True = the reference's per-minibatch
+    averaging; every-E = opt-in local-steps FedAvg), ``total_weight`` is the
+    runtime scalar sum of client weights (an input, NOT baked into the
+    program, so one compiled program serves differently-sized datasets), and
+    ``losses`` is [S, C_pad].
+
+    ``conditional_exchange`` statically selects whether the exchange is
+    wrapped in a ``lax.cond`` on the per-step schedule. It stays off for
+    reference-parity trainers (local_steps=1) so their hot path remains the
+    unconditioned psum.
     """
     params_mask = share_mask.get("params")
     bs_mask = share_mask.get("batch_stats")
 
-    def fedavg(tree, mask_tree, w_local):
+    def fedavg(tree, mask_tree, w_local, total_weight):
         """Weighted average of shared float leaves across ALL clients
         (psum over the mesh axis), broadcast back to the local block."""
 
@@ -109,13 +119,13 @@ def build_federated_program(
         )
 
     def shard_body(params, batch_stats, opt_state, data, weights, client_ids,
-                   indices, masks, step_ids, rng):
+                   indices, masks, step_ids, exchange, total_weight, rng):
         # Local blocks: leading axis L = C_pad / n_devices.
         w_local = weights
 
         def scan_body(carry, xs):
             params, batch_stats, opt_state = carry
-            idx_t, mask_t, step_i = xs  # [L, B], [L, B], scalar
+            idx_t, mask_t, step_i, ex_i = xs  # [L, B], [L, B], scalar, bool
 
             # vmap over the local client block; each client gathers its own
             # minibatch from its (mapped) slice of the stacked corpus.
@@ -133,16 +143,28 @@ def build_federated_program(
             )
 
             # The federated exchange: sample-weighted average of the shared
-            # subset over ICI (server.py:476-487 -> lax.psum).
-            new_p = fedavg(new_p, params_mask, w_local)
-            if bs_mask is not None and new_bs:
-                new_bs = fedavg(new_bs, bs_mask, w_local)
+            # subset over ICI (server.py:476-487 -> lax.psum). With
+            # local_steps > 1 only scheduled steps exchange (lax.cond on a
+            # replicated predicate: every device takes the same branch, so
+            # the collective stays legal and skipped steps skip the psum).
+            def do_exchange(p, bs):
+                p = fedavg(p, params_mask, w_local, total_weight)
+                if bs_mask is not None and bs:
+                    bs = fedavg(bs, bs_mask, w_local, total_weight)
+                return p, bs
+
+            if conditional_exchange:
+                new_p, new_bs = jax.lax.cond(
+                    ex_i, do_exchange, lambda p, bs: (p, bs), new_p, new_bs
+                )
+            else:
+                new_p, new_bs = do_exchange(new_p, new_bs)
             return (new_p, new_bs, new_o), loss
 
         (params, batch_stats, opt_state), losses = jax.lax.scan(
             scan_body,
             (params, batch_stats, opt_state),
-            (indices, masks, step_ids),
+            (indices, masks, step_ids, exchange),
         )
         return params, batch_stats, opt_state, losses
 
@@ -161,6 +183,8 @@ def build_federated_program(
                 P(None, axis_name),  # indices [S, C_pad, B]
                 P(None, axis_name),  # masks
                 P(),  # step_ids [S] (absolute step index: resume-stable RNG)
+                P(),  # exchange [S] (FedAvg schedule; all-True = parity)
+                P(),  # total_weight (runtime scalar: no per-dataset recompiles)
                 P(),  # rng
             ),
             out_specs=(state_spec, state_spec, state_spec, P(None, axis_name)),
@@ -187,18 +211,27 @@ class FederatedTrainer:
         max_iters: int = 25_000,
         devices: list | None = None,
         seed: int = 0,
+        local_steps: int = 1,
     ):
+        if local_steps < 1:
+            raise ValueError(f"local_steps must be >= 1, got {local_steps}")
         self.template = template
         self.n_clients = n_clients
         self.grads_to_share = tuple(grads_to_share)
         self.max_iters = max_iters
         self.seed = seed
+        # E = exchange period in minibatches. E=1 is the reference's own
+        # per-minibatch FedAvg (server.py:476-487) and stays the default;
+        # E>1 is the opt-in fix for its topic-diversity collapse (clients
+        # run E local steps between averages — FedAvg proper), shown in
+        # results/time_to_quality to recover diversity toward centralized.
+        self.local_steps = int(local_steps)
         self.mesh, self.c_pad = make_client_mesh(n_clients, devices)
         self.share_mask = build_share_mask(
             {"params": template.params, "batch_stats": template.batch_stats},
             self.grads_to_share,
         )
-        self._programs: dict[float, Any] = {}
+        self._program: Any = None
         self._staged: tuple[list, dict] | None = None
         # (key, tree): device-resident per-client initial (params,
         # batch_stats, opt_state), built on first fit and reused by later
@@ -206,17 +239,18 @@ class FederatedTrainer:
         # a template whose state is replaced (e.g. load()) re-stages.
         self._init_state: tuple | None = None
 
-    def _get_program(self, total_weight: float):
-        # Keyed by total_weight only (the one value baked into the program);
+    def _get_program(self):
+        # ONE program per trainer: total_weight is a runtime input, so
+        # differently-sized datasets reuse the same compiled program;
         # jax.jit re-specializes per segment-length shape on its own.
-        if total_weight not in self._programs:
+        if self._program is None:
             t = self.template
-            self._programs[total_weight] = build_federated_program(
+            self._program = build_federated_program(
                 t.module, t.tx, self.share_mask, self.mesh,
-                total_weight=total_weight,
                 family=t.family, beta_weight=t._beta_weight(),
+                conditional_exchange=self.local_steps != 1,
             )
-        return self._programs[total_weight]
+        return self._program
 
     def _stage_data(self, datasets: list[BowDataset], metrics=None) -> dict:
         """Stack, pad, and transfer the client corpora to device — cached
@@ -358,6 +392,14 @@ class FederatedTrainer:
         rng = jax.random.PRNGKey(self.seed + 17)
         weights_j = jnp.asarray(weights)
         ids_j = jnp.asarray(client_ids)
+        # FedAvg schedule over ABSOLUTE steps (resume-stable): step s
+        # exchanges iff (s+1) % E == 0, plus the final step always, so the
+        # returned global model is a true post-exchange average.
+        exchange = (
+            (np.arange(total_steps, dtype=np.int64) + 1) % self.local_steps
+        ) == 0
+        if total_steps:
+            exchange[total_steps - 1] = True
 
         # Segmented execution: one compiled program per segment length.
         # Without checkpointing there is exactly one segment (= the old
@@ -397,7 +439,7 @@ class FederatedTrainer:
         step = start_step
         while step < total_steps:
             n = min(seg_len, total_steps - step)
-            run = self._get_program(total_weight)
+            run = self._get_program()
             # RNG folding is per absolute step (scan xs carries step indices),
             # so resumed runs reproduce the unresumed ones exactly.
             with phase_timer(metrics, "program_segment", steps=n):
@@ -406,6 +448,8 @@ class FederatedTrainer:
                     jnp.asarray(indices[step:step + n]),
                     jnp.asarray(masks[step:step + n]),
                     jnp.arange(step, step + n),
+                    jnp.asarray(exchange[step:step + n]),
+                    jnp.asarray(total_weight, jnp.float32),
                     rng,
                 )
                 loss_chunks.append(np.asarray(seg_losses))

@@ -202,3 +202,82 @@ def test_more_clients_than_devices_pads_and_runs():
     assert res.losses.shape[1] == 3
     for leaf in jax.tree.leaves(res.client_params):
         assert np.isfinite(np.asarray(leaf, dtype=np.float64)).all()
+
+
+@pytest.mark.slow
+def test_local_steps_schedule_semantics():
+    """local_steps=E (VERDICT r4 #4): clients run E minibatches between
+    FedAvg exchanges. The E>1 trajectory must differ from per-minibatch
+    averaging, the final step always exchanges (so shared leaves end
+    identical across clients), and E=1 stays the parity default."""
+    dsets, _ = _datasets(2, n_docs=64)
+    r_parity = FederatedTrainer(_template(), n_clients=2, seed=5).fit(dsets)
+    r_local = FederatedTrainer(
+        _template(), n_clients=2, seed=5, local_steps=3
+    ).fit(dsets)
+
+    beta_parity = np.asarray(r_parity.client_params["beta"])
+    beta_local = np.asarray(r_local.client_params["beta"])
+    assert not np.allclose(beta_parity[0], beta_local[0]), (
+        "E=3 must change the trajectory vs per-minibatch averaging"
+    )
+    # Final forced exchange: shared leaves identical across clients.
+    np.testing.assert_allclose(
+        beta_local[0], beta_local[1], rtol=1e-5, atol=1e-6
+    )
+    # Same losses shape / schedule length as parity.
+    assert r_local.losses.shape == r_parity.losses.shape
+
+
+def test_local_steps_defers_exchange():
+    """With E > total_steps the only exchange is the forced final one, so
+    the run equals independent per-client training then one weighted
+    average — pinned by recomputing that average from a no-share run."""
+    dsets, _ = _datasets(2, n_docs=32)
+    t = _template(num_epochs=1, dropout=0.0, batch_size=16)
+    # 2 steps total; E=100 -> exchange only at the final step.
+    res = FederatedTrainer(t, n_clients=2, seed=3, local_steps=100).fit(dsets)
+
+    # Independent training: same template/seed but nothing shared.
+    t2 = _template(num_epochs=1, dropout=0.0, batch_size=16)
+    indep = FederatedTrainer(
+        t2, n_clients=2, seed=3, grads_to_share=(), local_steps=100
+    ).fit(dsets)
+    w = np.array([len(d) for d in dsets], np.float32)
+    expected = (
+        w[0] * np.asarray(indep.client_params["beta"][0])
+        + w[1] * np.asarray(indep.client_params["beta"][1])
+    ) / w.sum()
+    np.testing.assert_allclose(
+        np.asarray(res.client_params["beta"][0]), expected,
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_local_steps_validation():
+    with pytest.raises(ValueError):
+        FederatedTrainer(_template(), n_clients=2, local_steps=0)
+
+
+def test_one_program_serves_different_dataset_sizes():
+    """VERDICT r4 #8: total_weight is a runtime input, so two fits with the
+    same array shapes but different sample weights reuse ONE compiled
+    program (no retrace, no rebuild)."""
+    a1, _ = _datasets(1, n_docs=96, seed=1)
+    a2, _ = _datasets(1, n_docs=64, seed=2)
+    b2, _ = _datasets(1, n_docs=32, seed=3)
+    # Pad the smaller corpora to the same doc-count axis? Not needed: the
+    # staged x_bow pads to max(len) per fit, so pick sizes with equal max
+    # (96) and equal schedule length (3 steps/epoch at B=32).
+    b1, _ = _datasets(1, n_docs=96, seed=4)
+    t = _template(num_epochs=2, batch_size=32)
+    tr = FederatedTrainer(t, n_clients=2)
+    tr.fit([a1[0], a2[0]])  # total_weight 160
+    program = tr._program
+    assert program is not None
+    n_entries = program._cache_size()
+    tr.fit([b1[0], b2[0]])  # total_weight 128, same shapes
+    assert tr._program is program
+    assert program._cache_size() == n_entries, (
+        "same-shape fit with a different total_weight must not retrace"
+    )
