@@ -90,6 +90,15 @@ def main() -> None:
                     ] = [f"V{v}_B{b}" for v, b in skipped]
             finally:
                 del os.environ["GFEDNTM_FUSED_TILE_V"]
+        # bf16-storage rows (VERDICT r4 #3): beta/x streamed bf16 with f32
+        # accumulation — the HBM-traffic halver. Parity is judged at the
+        # quantized point; quantization_grad_delta reports the storage
+        # cost (see bench._fused_case).
+        bf16_table = bench_fused_largev(
+            backend,
+            cases=[(50_000, 64), (50_000, 256), (100_000, 64), (100_000, 256)],
+            storage="bfloat16",
+        )
     finally:
         if prior_tile is not None:
             os.environ["GFEDNTM_FUSED_TILE_V"] = prior_tile
@@ -112,7 +121,9 @@ def main() -> None:
         "cleared_operator_tile_override": prior_tile,
         "table": table,
         "tile_sweep": tile_sweep,
+        "bf16_storage_table": bf16_table,
         "all_parity": all(r["parity"] for r in table.values()),
+        "bf16_all_parity": all(r["parity"] for r in bf16_table.values()),
         "recommended_threshold": min(wins_b64) if wins_b64 else None,
         "threshold_rule": "min V with fused win at B=64 (reference batch)",
     }

@@ -44,6 +44,13 @@ class AVITM:
     Constructor arguments mirror ``avitm.py:23-113`` (validation included);
     ``num_data_loader_workers`` is accepted for config compatibility and
     ignored (there is no host dataloader — the corpus lives in HBM).
+
+    State contract: ``params`` / ``batch_stats`` / ``opt_state`` are
+    immutable pytrees — replace them by REBINDING the attribute (as
+    ``fit``/``load``/``_init_state`` do), never by mutating leaves in
+    place. ``FederatedTrainer`` caches device-resident initial state keyed
+    on the identity of these trees; in-place mutation would silently reuse
+    stale state across fits.
     """
 
     family = "avitm"
@@ -182,7 +189,8 @@ class AVITM:
             from gfedntm_tpu.ops.fused_decoder import kernel_health
 
             ok, err = kernel_health(
-                backend, b=self.batch_size, k=self.n_components
+                backend, b=self.batch_size, k=self.n_components,
+                storage_dtype=self.compute_dtype,
             )
             if not ok:
                 self.logger.warning(

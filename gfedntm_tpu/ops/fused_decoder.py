@@ -132,8 +132,10 @@ def _pick_tile_v(
         # The one-lane floor itself exceeds the measured frontier (b_pad >
         # 4096): no tile width is known-safe, so the compile may hit the
         # Mosaic scoped-VMEM limit. Warn rather than silently proceed —
-        # kernel_health probes at b=8 and cannot catch this, and the
-        # "auto" fused mode's runtime fallback is the recovery path.
+        # kernel_health (which probes at the caller's own b_pad/k_pad)
+        # will see the same over-frontier geometry and its compile failure
+        # degrades "auto" to the unfused path, but an explicit fused=True
+        # caller gets this warning as the only signal.
         _CLAMP_WARNED.add((-1, b_pad))
         logging.getLogger(__name__).warning(
             "fused decoder: b_pad=%d exceeds the measured scoped-VMEM "
@@ -167,14 +169,17 @@ def _pick_tile_v(
     return tile_cap, _round_up(v, tile_cap)
 
 
-def resolve_tile_v(v: int, b: int, k: int | None = None) -> int:
+def resolve_tile_v(
+    v: int, b: int, k: int | None = None, storage_dtype: str = "float32"
+) -> int:
     """Public: the tile width the kernel will use for a (V, batch[, K])
     case — identical resolution path to ``_pad_geometry`` (same padding
     rules), so sweep/bench tooling can label rows with the geometry that
     actually runs. Omitting ``k`` resolves the conservative (2048-cap)
     geometry; pass the model's K to see the small-K widened tiling."""
-    b_pad = _round_up(max(b, 8), 8)
-    k_pad = None if k is None else _round_up(max(k, 8), 8)
+    sub = 16 if storage_dtype == "bfloat16" else 8
+    b_pad = _round_up(max(b, sub), sub)
+    k_pad = None if k is None else _round_up(max(k, sub), sub)
     return _pick_tile_v(v, b_pad, k_pad)[0]
 
 
@@ -211,8 +216,10 @@ def _stats_kernel(
         s_ref[:] = jnp.zeros_like(s_ref)
 
     b_pad = theta_ref.shape[0]
+    # beta may be stored bf16 (HBM-traffic halving); all math stays f32.
     z = jnp.dot(
-        theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
+        theta_ref[:], beta_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
     )  # [B_pad, TILE_V]
 
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
@@ -286,9 +293,12 @@ def _loss_kernel(
         rd_ref[:] = jnp.zeros_like(rd_ref)
 
     b_pad = theta_ref.shape[0]
+    # beta/x may be stored bf16 (HBM-traffic halving); all math stays f32.
     z = jnp.dot(
-        theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32
+        theta_ref[:], beta_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
     )
+    x = x_ref[:].astype(jnp.float32)
     n = (z - mean_ref[:]) * jax.lax.rsqrt(var_ref[:] + eps)
     # Fully-masked (padding) rows have m = -inf sentinel, l ~ 0; force their
     # rows finite — the caller zeroes them via its sample mask anyway.
@@ -300,16 +310,31 @@ def _loss_kernel(
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
     col_ok = (col_ids + j * tile_v) < v_actual
     keep = jnp.logical_and(col_ok, row_valid)
-    contrib = jnp.where(keep, x_ref[:] * jnp.log(p + floor), 0.0)
+    contrib = jnp.where(keep, x * jnp.log(p + floor), 0.0)
     out_ref[:] += -jnp.sum(contrib, axis=1, keepdims=True)
 
-    xr = jnp.where(col_ok, x_ref[:] * (p / (p + floor)), 0.0)
+    xr = jnp.where(col_ok, x * (p / (p + floor)), 0.0)
     rd_ref[:] += jnp.sum(xr, axis=1, keepdims=True)
 
 
-def _pad_geometry(b: int, k: int, v: int):
-    b_pad = _round_up(max(b, 8), 8)
-    k_pad = _round_up(max(k, 8), 8)
+def _storage_jnp(storage_dtype: str):
+    if storage_dtype == "bfloat16":
+        return jnp.bfloat16
+    if storage_dtype == "float32":
+        return jnp.float32
+    raise ValueError(
+        f"storage_dtype must be 'float32' or 'bfloat16', got {storage_dtype!r}"
+    )
+
+
+def _pad_geometry(b: int, k: int, v: int, storage_dtype: str = "float32"):
+    # bf16 arrays tile natively at (16, 128) on TPU, so the bf16-stored
+    # beta/x blocks need their second-to-minor dims padded to 16 (f32
+    # needs 8). theta stays f32 either way; padding b/k to 16 for it too
+    # is harmless zeros.
+    sub = 16 if storage_dtype == "bfloat16" else 8
+    b_pad = _round_up(max(b, sub), sub)
+    k_pad = _round_up(max(k, sub), sub)
     tile_v, v_pad = _pick_tile_v(v, b_pad, k_pad)
     return b_pad, k_pad, tile_v, v_pad
 
@@ -335,16 +360,28 @@ def _specs(b_pad: int, k_pad: int, tile_v: int):
 # step (here) and the padded buffers are shared by pass 1, pass 2 and — via
 # the VJP residuals — the backward pass.
 # ---------------------------------------------------------------------------
-def _pad_core(theta, beta, x_bow):
+def _pad_core(theta, beta, x_bow, storage_dtype: str = "float32"):
     """Pad the three big operands. Returns ``(geom, theta_p, beta_p, x_p)``
-    with ``geom = (b, k, v, b_pad, k_pad, tile_v, v_pad)`` (static ints)."""
+    with ``geom = (b, k, v, b_pad, k_pad, tile_v, v_pad)`` (static ints).
+
+    ``storage_dtype="bfloat16"`` stores the two V-major operands (beta, x)
+    in bf16 — halving the kernel's dominant HBM traffic — while theta and
+    every in-kernel computation stay f32 (tiles are upcast in VMEM, so
+    only storage precision changes, not accumulation). BoW counts < 256
+    are exact in bf16 (8-bit mantissa); beta is quantized to ~3 decimal
+    digits, the usual mixed-precision trade."""
     b, k = theta.shape
     _, v = beta.shape
-    b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v)
+    store = _storage_jnp(storage_dtype)
+    b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v, storage_dtype)
     geom = (b, k, v, b_pad, k_pad, tile_v, v_pad)
     theta_p = jnp.zeros((b_pad, k_pad), jnp.float32).at[:b, :k].set(theta)
-    beta_p = jnp.zeros((k_pad, v_pad), jnp.float32).at[:k, :v].set(beta)
-    x_p = jnp.zeros((b_pad, v_pad), jnp.float32).at[:b, :v].set(x_bow)
+    beta_p = jnp.zeros((k_pad, v_pad), store).at[:k, :v].set(
+        beta.astype(store)
+    )
+    x_p = jnp.zeros((b_pad, v_pad), store).at[:b, :v].set(
+        x_bow.astype(store)
+    )
     return geom, theta_p, beta_p, x_p
 
 
@@ -449,11 +486,12 @@ def _fused_forward(
     eps: float,
     floor: float,
     interpret: bool,
+    storage_dtype: str = "float32",
 ):
     """Shared forward for the primal and the VJP: pad once, run both
     streaming passes. Returns ``(outputs, padded-intermediates)`` — the
     primal discards the latter, the VJP packs them into its residuals."""
-    geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow)
+    geom, theta_p, beta_p, x_p = _pad_core(theta, beta, x_bow, storage_dtype)
     b, _, v = geom[0], geom[1], geom[2]
     mask_p = _pad_mask(geom, mask)
     rmean_p, rvar_p = _pad_running(geom, run_mean, run_var)
@@ -508,7 +546,9 @@ def _grads_kernel(
         gtheta_ref[:] = jnp.zeros_like(gtheta_ref)
 
     inv_std = jax.lax.rsqrt(var_ref[:] + eps)
-    z = jnp.dot(theta_ref[:], beta_ref[:], preferred_element_type=jnp.float32)
+    # beta/x may be stored bf16 (HBM-traffic halving); all math stays f32.
+    beta_f32 = beta_ref[:].astype(jnp.float32)
+    z = jnp.dot(theta_ref[:], beta_f32, preferred_element_type=jnp.float32)
     n = (z - mean_ref[:]) * inv_std
     row_valid = l_ref[:] > 1e-20
     safe_m = jnp.where(row_valid, m_ref[:], 0.0)
@@ -518,7 +558,9 @@ def _grads_kernel(
     b_pad = theta_ref.shape[0]
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (b_pad, tile_v), 1)
     col_ok = (col_ids + j * tile_v) < v_actual
-    xr = jnp.where(col_ok, x_ref[:] * (p / (p + floor)), 0.0)
+    xr = jnp.where(
+        col_ok, x_ref[:].astype(jnp.float32) * (p / (p + floor)), 0.0
+    )
 
     g = g_ref[:]                                            # g_rl * mask
     gn = g * (p * rd_ref[:] - xr)
@@ -536,7 +578,7 @@ def _grads_kernel(
         theta_ref[:].T, gz, preferred_element_type=jnp.float32
     )
     gtheta_ref[:] += jnp.dot(
-        gz, beta_ref[:].T, preferred_element_type=jnp.float32
+        gz, beta_f32.T, preferred_element_type=jnp.float32
     )
 
 
@@ -597,7 +639,7 @@ def _pad_cotangent(geom, g_rl, mask):
 # custom-VJP wrapper
 # ---------------------------------------------------------------------------
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10)
 )
 def prodlda_recon_loss(
     theta: jax.Array,
@@ -610,6 +652,7 @@ def prodlda_recon_loss(
     eps: float = 1e-5,
     floor: float = 1e-10,
     interpret: bool | None = None,
+    storage_dtype: str = "float32",
 ):
     """Fused ``-sum(x * log(softmax(batchnorm(theta @ beta)) + floor))``.
 
@@ -619,6 +662,11 @@ def prodlda_recon_loss(
     ``track_running_stats``). ``mask`` rows equal to 0 are excluded from the
     batch statistics (MaskedBatchNorm semantics); their rl rows are
     well-defined but meaningless — callers zero them via their sample mask.
+
+    ``storage_dtype="bfloat16"`` streams beta/x through HBM in bf16 with
+    all accumulation in f32 (see ``_pad_core``) — the bandwidth-bound
+    regime's traffic halver. Gradients are computed at the quantized point
+    (standard mixed-precision semantics).
     """
     if interpret is None:
         # "axon" is the TPU chip behind the tunnel plugin — compiled Pallas,
@@ -629,18 +677,20 @@ def prodlda_recon_loss(
     outputs, _ = _fused_forward(
         theta, beta, x_bow, run_mean, run_var, mask,
         training=training, eps=eps, floor=floor, interpret=interpret,
+        storage_dtype=storage_dtype,
     )
     return outputs
 
 
 def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
-         interpret):
+         interpret, storage_dtype):
     interp = _resolve_interpret(interpret)
     if mask is None:
         mask = jnp.ones((theta.shape[0],), jnp.float32)
     outputs, pads = _fused_forward(
         theta, beta, x_bow, run_mean, run_var, mask,
         training=training, eps=eps, floor=floor, interpret=interp,
+        storage_dtype=storage_dtype,
     )
     # Residuals keep the PADDED operands so the backward re-pads nothing.
     # theta/beta (unpadded) ride along only to carry the static (b, k, v)
@@ -648,7 +698,8 @@ def _fwd(theta, beta, x_bow, run_mean, run_var, mask, training, eps, floor,
     return outputs, (theta, beta, mask) + pads
 
 
-def _bwd(training, eps, floor, interpret, residuals, cotangents):
+def _bwd(training, eps, floor, interpret, storage_dtype, residuals,
+         cotangents):
     """Streaming Pallas backward — a single V-tile pass (see _grads_kernel):
     the row-dot reduction already rode along with the forward loss pass, and
     no [B, V] array ever reaches HBM, the same property the forward has.
@@ -660,7 +711,7 @@ def _bwd(training, eps, floor, interpret, residuals, cotangents):
      l_p, rd_p) = residuals
     b, k = theta.shape
     v = beta.shape[1]
-    geom = (b, k, v) + _pad_geometry(b, k, v)
+    geom = (b, k, v) + _pad_geometry(b, k, v, storage_dtype)
     g_rl = cotangents[0]  # stats outputs are gradient-free
     g_p = _pad_cotangent(geom, g_rl, mask)
     g_theta, g_beta = _grads_p(
@@ -668,7 +719,13 @@ def _bwd(training, eps, floor, interpret, residuals, cotangents):
         mask_p, training=training, eps=eps, floor=floor,
         interpret=_resolve_interpret(interpret),
     )
-    return g_theta, g_beta, None, None, None, None
+    # Cotangent dtypes must match the PRIMAL dtypes: a bf16-compute module
+    # hands in bf16 theta, and upstream transposes (e.g. flax Dropout's
+    # div) reject an f32 cotangent against a bf16 primal.
+    return (
+        g_theta.astype(theta.dtype), g_beta.astype(beta.dtype),
+        None, None, None, None,
+    )
 
 
 prodlda_recon_loss.defvjp(_fwd, _bwd)
@@ -691,10 +748,16 @@ def prodlda_recon_loss_vsharded(
     eps: float = 1e-5,
     floor: float = 1e-10,
     interpret: bool | None = None,
+    storage_dtype: str = "float32",
 ):
     """Fused prodLDA reconstruction loss with ``beta``/``x`` sharded on V,
     for use INSIDE ``shard_map`` (VERDICT r2 task 5: compose the kernel with
     ``fit_sharded``'s GSPMD path instead of silently falling back).
+
+    ``storage_dtype="bfloat16"`` streams the local beta/x shards through
+    the Pallas kernels in bf16 (f32 accumulation) on the rows-replicated
+    branch. The rows-sharded TRAINING branch is XLA (not Pallas) and
+    ignores the knob — its traffic is dominated by the materialized z.
 
     Per device: the Pallas kernel streams the *local* V shard exactly as the
     single-device kernel does; the only cross-device work is the softmax
@@ -721,18 +784,21 @@ def prodlda_recon_loss_vsharded(
         theta, beta_local, x_local, run_mean_local, run_var_local,
         (jnp.ones((theta.shape[0],), jnp.float32) if mask is None else mask),
         model_axis, data_axis, training, eps, floor, interpret,
+        storage_dtype,
     )
 
 
 def _vsharded_replicated_fwd(
     theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-    model_axis, training, eps, floor, interp,
+    model_axis, training, eps, floor, interp, storage_dtype="float32",
 ):
     """Forward for the rows-replicated branch (batch replicated across the
     model axis): pad once, stream the local shard through the single-device
     kernels, merge the per-shard softmax partials across the V shards.
     Returns padded intermediates for the VJP alongside the outputs."""
-    geom, theta_p, beta_p, x_p = _pad_core(theta, beta_local, x_local)
+    geom, theta_p, beta_p, x_p = _pad_core(
+        theta, beta_local, x_local, storage_dtype
+    )
     b = geom[0]
     mask_p = _pad_mask(geom, mask)
     rmean_p, rvar_p = _pad_running(geom, run_mean_local, run_var_local)
@@ -800,10 +866,11 @@ def _vsharded_data_sharded_fwd(
     return rl, mean, var, m_glob, l_glob
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
 def _vsharded_impl(
     theta, beta_local, x_local, run_mean_local, run_var_local, mask,
     model_axis, data_axis, training, eps, floor, interpret,
+    storage_dtype="float32",
 ):
     interp = _resolve_interpret(interpret)
     v_local = beta_local.shape[1]
@@ -815,7 +882,7 @@ def _vsharded_impl(
         return rl, mean, var
     rl, mean_p, var_p, _, _, _, _ = _vsharded_replicated_fwd(
         theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-        model_axis, training, eps, floor, interp,
+        model_axis, training, eps, floor, interp, storage_dtype,
     )
     return rl, mean_p[0, :v_local], var_p[0, :v_local]
 
@@ -823,6 +890,7 @@ def _vsharded_impl(
 def _vsharded_vjp_fwd(
     theta, beta_local, x_local, run_mean_local, run_var_local, mask,
     model_axis, data_axis, training, eps, floor, interpret,
+    storage_dtype="float32",
 ):
     interp = _resolve_interpret(interpret)
     v_local = beta_local.shape[1]
@@ -838,7 +906,7 @@ def _vsharded_vjp_fwd(
         )
     rl, mean_p, var_p, m_glob, l_glob, rd_p, pads = _vsharded_replicated_fwd(
         theta, beta_local, x_local, run_mean_local, run_var_local, mask,
-        model_axis, training, eps, floor, interp,
+        model_axis, training, eps, floor, interp, storage_dtype,
     )
     theta_p, beta_p, x_p, mask_p = pads
     # theta/beta_local (unpadded) ride along to carry the static geometry.
@@ -849,8 +917,8 @@ def _vsharded_vjp_fwd(
 
 
 def _vsharded_vjp_bwd(
-    model_axis, data_axis, training, eps, floor, interpret, residuals,
-    cotangents,
+    model_axis, data_axis, training, eps, floor, interpret, storage_dtype,
+    residuals, cotangents,
 ):
     # shard_map transpose convention (check_vma=False): the cotangent of an
     # output that is REPLICATED along an axis arrives divided by that axis'
@@ -895,8 +963,8 @@ def _vsharded_vjp_bwd(
         gz = inv_std[None, :] * (
             gn - m * (sum_gn / cnt) - n * m * (sum_gnn / cnt)
         )
-        g_theta = gz @ beta_local.T
-        g_beta = theta.T @ gz
+        g_theta = (gz @ beta_local.T).astype(theta.dtype)
+        g_beta = (theta.T @ gz).astype(beta_local.dtype)
         return g_theta, g_beta, None, None, None, None
 
     # Rows replicated across the model axis: stream the backward through the
@@ -907,7 +975,7 @@ def _vsharded_vjp_bwd(
      m_glob, l_glob, rd_p, mask) = residuals
     b, k = theta.shape
     v = beta_local.shape[1]
-    geom = (b, k, v) + _pad_geometry(b, k, v)
+    geom = (b, k, v) + _pad_geometry(b, k, v, storage_dtype)
     rd = jax.lax.psum(rd_p, model_axis)
     g_p = _pad_cotangent(geom, g_rl, mask)
     g_theta, g_beta = _grads_p(
@@ -918,8 +986,12 @@ def _vsharded_vjp_bwd(
     # a replicated input SUMS the per-device cotangents — i.e. the transpose
     # itself is the psum. Return the local partial; psumming here too would
     # double-count by the model-axis size (caught by the op-level gradient
-    # parity tests).
-    return g_theta, g_beta, None, None, None, None
+    # parity tests). Cotangent dtypes must match the primal dtypes (bf16
+    # modules hand in bf16 theta).
+    return (
+        g_theta.astype(theta.dtype), g_beta.astype(beta_local.dtype),
+        None, None, None, None,
+    )
 
 
 _vsharded_impl.defvjp(_vsharded_vjp_fwd, _vsharded_vjp_bwd)
@@ -935,7 +1007,8 @@ _KERNEL_HEALTH: dict[str, tuple[bool, str]] = {}
 
 
 def kernel_health(
-    backend: str | None = None, *, b: int = 8, k: int = 8
+    backend: str | None = None, *, b: int = 8, k: int = 8,
+    storage_dtype: str = "float32",
 ) -> tuple[bool, str]:
     """One-time compile+run probe of the *compiled* (non-interpret) kernel.
 
@@ -959,19 +1032,21 @@ def kernel_health(
             backend = jax.default_backend()
         except RuntimeError as err:  # no usable backend at all
             return False, repr(err)
-    b_pad = _round_up(max(b, 8), 8)
-    k_pad = _round_up(max(k, 8), 8)
+    sub = 16 if storage_dtype == "bfloat16" else 8
+    b_pad = _round_up(max(b, sub), sub)
+    k_pad = _round_up(max(k, sub), sub)
     # Probe at n_tiles=2 REGARDLESS of the GFEDNTM_FUSED_TILE_V override:
     # probing v = 2x the resolved tile width keeps the multi-tile Mosaic
     # lowering path exercised (a fixed v=4096 under an override >= 4096
     # would silently degrade to a single-tile probe and could greenlight a
-    # tiling that crashes at real V). The probe runs at b=8, so the width
-    # resolved here is the WIDEST the override can produce (the VMEM
-    # frontier clamp only narrows tiles as B grows; batch-clamped runs use
-    # a narrower — smaller-working-set, better-tested — geometry than the
-    # one probed). The cache is keyed on that widest resolved width so
-    # changing the knob re-probes. A malformed override must degrade to
-    # the unfused path like every other probe failure — the "auto"
+    # tiling that crashes at real V). The probe is geometry-aware: it
+    # compiles at the CALLER's b_pad/k_pad with the widest tile that
+    # geometry can resolve (huge-V _pick_tile_v below, i.e. the same
+    # (b_pad, k_pad, tile) class the caller's real training will use —
+    # real V <= huge V only narrows the tile, a smaller working set).
+    # The cache is keyed on that resolved class so changing the knob or
+    # the batch re-probes. A malformed override must degrade to the
+    # unfused path like every other probe failure — the "auto"
     # never-crash contract — not raise out of here.
     try:
         # Resolve the widest tiling the caller's geometry can reach (huge
@@ -980,7 +1055,7 @@ def kernel_health(
         tile_v, _ = _pick_tile_v(1 << 30, b_pad, k_pad)
     except ValueError as err:
         return False, repr(err)
-    cache_key = f"{backend}:b{b_pad}k{k_pad}tile{tile_v}"
+    cache_key = f"{backend}:b{b_pad}k{k_pad}tile{tile_v}s{storage_dtype}"
     cached = _KERNEL_HEALTH.get(cache_key)
     if cached is not None:
         return cached
@@ -993,7 +1068,8 @@ def kernel_health(
 
         def probe_loss(t, bt):
             rl, _, _ = prodlda_recon_loss(
-                t, bt, x, jnp.zeros(v), jnp.ones(v), None, True
+                t, bt, x, jnp.zeros(v), jnp.ones(v), None, True,
+                storage_dtype=storage_dtype,
             )
             return jnp.sum(rl)
 

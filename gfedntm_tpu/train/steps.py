@@ -66,10 +66,19 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
     )
     m = mask.astype(jnp.float32)
     bn = batch_stats["beta_batchnorm"]
+    # bf16-compute models stream beta/x through the kernel in bf16 storage
+    # too (f32 accumulation — see _pad_core): the loss is bandwidth-bound,
+    # so halving its HBM traffic is where compute_dtype actually pays.
+    storage = (
+        "bfloat16"
+        if getattr(module, "dtype", jnp.float32) == jnp.bfloat16
+        else "float32"
+    )
     if vshard is None:
         rl, b_mean, b_var = prodlda_recon_loss(
             out.theta, params["beta"], batch["x_bow"],
             bn["running_mean"], bn["running_var"], m, True,
+            1e-5, 1e-10, None, storage,
         )
     else:
         from functools import partial
@@ -81,6 +90,7 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
             partial(
                 prodlda_recon_loss_vsharded,
                 model_axis=model_axis, data_axis=data_axis, training=True,
+                storage_dtype=storage,
             ),
             mesh=mesh,
             in_specs=(
