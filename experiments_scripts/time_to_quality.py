@@ -262,6 +262,43 @@ def main(out_path: str | None = None) -> dict:
     print(f"jax arm ({backend}): {len(jax_curve)} epochs, "
           f"final TSS {jax_curve[-1]['tss']}", flush=True)
 
+    # ---- local-steps arm (VERDICT r4 #4: the opt-in FedAvg-proper fix) --
+    # Same corpus/model/optimizer, but clients run a full local epoch
+    # (E = steps_per_epoch minibatches) between exchanges instead of the
+    # reference's per-minibatch averaging. Segment boundaries coincide
+    # with exchange boundaries, so the snapshots are post-exchange global
+    # betas.
+    local_E = steps_per_epoch
+    template_E = AVITM(
+        input_size=VOCAB, n_components=K, hidden_sizes=(100, 100),
+        batch_size=64, num_epochs=EPOCHS, lr=2e-3, momentum=0.99, seed=SEED,
+    )
+    trainer_E = FederatedTrainer(
+        template_E, n_clients=N_NODES, local_steps=local_E
+    )
+    e_snaps: list[tuple[float, np.ndarray]] = []
+
+    def snap_segment_e(step, params, batch_stats):
+        e_snaps.append(
+            (time.perf_counter(), np.asarray(params["beta"][0]).copy())
+        )
+
+    template_E.num_epochs = 1
+    trainer_E.fit(datasets)  # warmup: stage + compile (untimed arm context)
+    template_E.num_epochs = warm_template_epochs
+    e_start = time.perf_counter()
+    trainer_E.fit(
+        datasets, checkpoint_every=steps_per_epoch,
+        segment_callback=snap_segment_e,
+    )
+    local_curve = [
+        {"wall_s": round(ts - e_start, 2),
+         "tss": round(tss_of(beta, idx2token), 4)}
+        for ts, beta in e_snaps
+    ]
+    print(f"local-steps arm (E={local_E}): {len(local_curve)} epochs, "
+          f"final TSS {local_curve[-1]['tss']}", flush=True)
+
     # ---- final topic quality, all three arms ----------------------------
     # Answers whether the federated arm's lower topic diversity (seen in
     # parity_vs_torch) is an implementation artifact or a property of the
@@ -279,6 +316,7 @@ def main(out_path: str | None = None) -> dict:
         "torch_centralized": (torch_snaps[-1][1], t_id2token),
         "torch_federated": (torch_fed_snaps[-1][1], t_id2tok_full),
         "gfedntm_tpu_federated": (jax_snaps[-1][1], idx2token),
+        f"gfedntm_tpu_local_steps_E{local_E}": (e_snaps[-1][1], idx2token),
     }.items():
         tops = topics_of(beta, idt)
         final_topic_quality[arm] = {
@@ -320,6 +358,7 @@ def main(out_path: str | None = None) -> dict:
             "torch_federated_s": time_to(torch_fed_curve, target),
             "torch_centralized_s": time_to(torch_curve, target),
             "gfedntm_tpu_s": time_to(jax_curve, target),
+            "gfedntm_tpu_local_steps_s": time_to(local_curve, target),
         }
     head = ladder["95pct"]
     speedup = (
@@ -338,6 +377,53 @@ def main(out_path: str | None = None) -> dict:
     shipped_floor_s = (
         None if fed_95_steps is None else round(fed_95_steps * 3.0 * N_NODES)
     )
+
+    # ---- cold-start honesty (VERDICT r4 #7) -----------------------------
+    # The headline excludes this framework's one-time compile+stage (the
+    # torch arm's dataset prep is likewise excluded). Report the
+    # amortization-free comparison too: a user running ONE fit from a cold
+    # process pays compile_s up front. With the persistent XLA compile
+    # cache warm (the supervisor sets JAX_COMPILATION_CACHE_DIR), a cold
+    # PROCESS replays compiles from disk — measured below in a fresh
+    # subprocess so the number is a real end-to-end cold start, not this
+    # process's warm-jit state.
+    cold_95 = (
+        None if head["gfedntm_tpu_s"] is None
+        else round(compile_s + head["gfedntm_tpu_s"], 2)
+    )
+    speedup_cold = (
+        round(head["torch_federated_s"] / cold_95, 2)
+        if head["torch_federated_s"] and cold_95 else None
+    )
+    # The chip is single-tenant and THIS process holds it, so a subprocess
+    # probe on TPU would hang in backend init (round-5 review finding). On
+    # TPU the measurement runs as the separate --coldproc-only invocation
+    # (supervisor job "ttqcold", chip free, this run's compile cache warm)
+    # which patches the field below into the artifact in place.
+    if backend == "cpu" and not os.environ.get("TTQ_SKIP_COLDPROC"):
+        # No chip contention on CPU: measure in a fresh subprocess now.
+        import subprocess
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--coldproc-measure"],
+                capture_output=True, text=True, timeout=1200,
+                env=dict(os.environ),
+            )
+            line = next(
+                ln for ln in proc.stdout.splitlines()
+                if ln.startswith("COLDPROC ")
+            )
+            cold_process = json.loads(line[len("COLDPROC "):])
+        except Exception as err:  # noqa: BLE001 — context metric only
+            cold_process = {"error": repr(err)[:300]}
+    else:
+        cold_process = {
+            "skipped": (
+                "single-tenant chip held by this process; measured by the "
+                "separate --coldproc-only run (supervisor job ttqcold)"
+            )
+        }
 
     out = {
         "metric": "wall_clock_to_tss_target",
@@ -377,9 +463,29 @@ def main(out_path: str | None = None) -> dict:
         # Measures cache deserialization, not compilation, when the
         # supervisor's persistent XLA cache is active:
         "compilation_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        "cold_start": {
+            "gfedntm_cold_s_at_95pct": cold_95,
+            "headline_speedup_at_95pct_cold": speedup_cold,
+            "note": (
+                "cold = compile+stage paid up front (amortization-free "
+                "single-fit user); the headline above amortizes it, as the "
+                "torch arm's dataset prep is likewise excluded"
+            ),
+            "cold_process_warm_cache": cold_process,
+        },
+        "local_steps_fix": {
+            "E": local_E,
+            "definition": (
+                "opt-in FederatedTrainer(local_steps=E): clients run E "
+                "local minibatches between FedAvg exchanges (E = one "
+                "local epoch here); parity default E=1 unchanged"
+            ),
+            "final_tss": local_curve[-1]["tss"] if local_curve else None,
+        },
         "torch_federated_curve": torch_fed_curve,
         "torch_curve": torch_curve,
         "gfedntm_curve": jax_curve,
+        "gfedntm_local_steps_curve": local_curve,
     }
     out_path = out_path or os.path.join(
         REPO_ROOT, "results", "time_to_quality", "metrics.json"
@@ -392,5 +498,75 @@ def main(out_path: str | None = None) -> dict:
     return out
 
 
+def measure_cold_process() -> dict:
+    """Time a COLD process's corpus-gen + (stage + compile + 1-epoch fit)
+    at the ttq regime. Only meaningful when this process is fresh — called
+    via --coldproc-measure / --coldproc-only, never from a warm parent.
+    With JAX_COMPILATION_CACHE_DIR warm (e.g. right after the main ttq
+    run) the compile component is cache deserialization — the number the
+    VERDICT r4 #7 asks for."""
+    import jax
+
+    if os.environ.get("FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401 — keep import cost inside the timing
+
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+    from gfedntm_tpu.models.avitm import AVITM
+
+    t0 = time.perf_counter()
+    corpus = generate_synthetic_corpus(
+        vocab_size=VOCAB, n_topics=K, beta=ETA, alpha=ALPHA,
+        n_docs=DOCS_PER_NODE, nwords=(150, 250), n_nodes=N_NODES,
+        frozen_topics=FROZEN, seed=SEED,
+    )
+    gen_s = time.perf_counter() - t0
+    i2t = {i: f"wd{i}" for i in range(VOCAB)}
+    datasets = [
+        BowDataset(X=n.bow, idx2token=i2t) for n in corpus.nodes
+    ]
+    template = AVITM(
+        input_size=VOCAB, n_components=K, hidden_sizes=(100, 100),
+        batch_size=64, num_epochs=1, lr=2e-3, momentum=0.99, seed=SEED,
+    )
+    trainer = FederatedTrainer(template, n_clients=N_NODES)
+    t0 = time.perf_counter()
+    trainer.fit(datasets)
+    fit_s = time.perf_counter() - t0
+    return {
+        "backend": jax.default_backend(),
+        "corpus_gen_s": round(gen_s, 1),
+        "stage_compile_and_one_epoch_fit_s": round(fit_s, 1),
+        "compile_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+    }
+
+
+def coldproc_only(out_path: str | None = None) -> None:
+    """Standalone cold-process measurement; patches the existing ttq
+    artifact's cold_start.cold_process_warm_cache field in place."""
+    result = measure_cold_process()
+    out_path = out_path or os.path.join(
+        REPO_ROOT, "results", "time_to_quality", "metrics.json"
+    )
+    try:
+        with open(out_path, encoding="utf8") as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {"note": "coldproc ran before the main ttq artifact"}
+    artifact.setdefault("cold_start", {})["cold_process_warm_cache"] = result
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", encoding="utf8") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(result, indent=2))
+
+
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else None)
+    if "--coldproc-measure" in sys.argv:
+        print("COLDPROC " + json.dumps(measure_cold_process()), flush=True)
+    elif "--coldproc-only" in sys.argv:
+        args = [a for a in sys.argv[1:] if not a.startswith("--")]
+        coldproc_only(args[0] if args else None)
+    else:
+        main(sys.argv[1] if len(sys.argv) > 1 else None)
