@@ -3,6 +3,11 @@ scoring — the reference's centralized-baseline workflow
 (`experiments/dss_tss/run_simulation.py` single-iteration slice).
 
 Run: python examples/centralized_training.py
+
+On a machine whose TPU tunnel is down, jax backend init hangs
+indefinitely — set FORCE_CPU=1 to pin the CPU backend first:
+
+    FORCE_CPU=1 python examples/centralized_training.py
 """
 
 import os

@@ -5,6 +5,11 @@ equivalent of the reference's docker-compose federation.
 Run: python examples/federated_simulation.py
 On a multi-device host each client maps to its own device; on one device the
 clients batch into a single vmapped program.
+
+On a machine whose TPU tunnel is down, jax backend init hangs
+indefinitely — set FORCE_CPU=1 to pin the CPU backend first:
+
+    FORCE_CPU=1 python examples/federated_simulation.py
 """
 
 import os

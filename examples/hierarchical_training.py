@@ -4,6 +4,11 @@ HTM-DS) driven natively: train a father model, expand one of its topics
 into a child model on the topic-restricted subcorpus.
 
 Run: python examples/hierarchical_training.py
+
+On a machine whose TPU tunnel is down, jax backend init hangs
+indefinitely — set FORCE_CPU=1 to pin the CPU backend first:
+
+    FORCE_CPU=1 python examples/hierarchical_training.py
 """
 
 import sys

@@ -350,9 +350,17 @@ def run_simulation(
         if prior_json.exists():
             try:
                 with open(prior_json, encoding="utf8") as f:
-                    prior_elapsed = float(
-                        json.load(f).get("meta", {}).get("elapsed_s", 0.0)
-                    )
+                    prior_meta = json.load(f).get("meta", {})
+                # Accumulate only if the prior run is THIS experiment/regime
+                # (round-4 advisor finding: a from-scratch rerun or a
+                # different experiment written into the same dir would
+                # inherit and compound an unrelated elapsed_s, overstating
+                # the artifact's compute-cost provenance).
+                if (
+                    prior_meta.get("experiment") == cfg.experiment
+                    and prior_meta.get("seed") == cfg.seed
+                ):
+                    prior_elapsed = float(prior_meta.get("elapsed_s", 0.0))
             except (ValueError, OSError):
                 prior_elapsed = 0.0
     iter_backends: list[str] = []
