@@ -73,7 +73,7 @@ def build_federated_program(
     mesh: Mesh,
     family: str = "avitm",
     beta_weight: float = 1.0,
-    axis_name: str = "clients",
+    axis_name: "str | tuple[str, ...]" = "clients",
     conditional_exchange: bool = False,
 ):
     """Compile the whole-federation step loop.
@@ -95,7 +95,15 @@ def build_federated_program(
     wrapped in a ``lax.cond`` on the per-step schedule. It stays off for
     reference-parity trainers (local_steps=1) so their hot path remains the
     unconditioned psum.
+
+    ``axis_name`` may be a TUPLE of mesh axes (e.g. ``("slice",
+    "clients")`` from :func:`gfedntm_tpu.parallel.mesh
+    .make_slice_client_mesh`): the client blocks are then sharded over the
+    flattened product of those axes and the FedAvg psum spans all of them
+    — intra-slice over ICI, cross-slice over DCN — with no other change to
+    the program (SURVEY §7.2 item 7, multi-slice scale-out).
     """
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     params_mask = share_mask.get("params")
     bs_mask = share_mask.get("batch_stats")
 
@@ -107,7 +115,7 @@ def build_federated_program(
             if not shared or not jnp.issubdtype(leaf.dtype, jnp.floating):
                 return leaf
             weighted = jnp.tensordot(w_local, leaf, axes=1)  # sum over local C
-            avg = jax.lax.psum(weighted, axis_name) / total_weight
+            avg = jax.lax.psum(weighted, axes) / total_weight
             return jnp.broadcast_to(avg, leaf.shape)
 
         return jax.tree.map(mix, tree, mask_tree)
@@ -168,7 +176,7 @@ def build_federated_program(
         )
         return params, batch_stats, opt_state, losses
 
-    state_spec = P(axis_name)
+    state_spec = P(axes)
     run = jax.jit(
         jax.shard_map(
             shard_body,
@@ -180,14 +188,14 @@ def build_federated_program(
                 state_spec,  # data dict
                 state_spec,  # weights [C_pad]
                 state_spec,  # client_ids [C_pad]
-                P(None, axis_name),  # indices [S, C_pad, B]
-                P(None, axis_name),  # masks
+                P(None, axes),  # indices [S, C_pad, B]
+                P(None, axes),  # masks
                 P(),  # step_ids [S] (absolute step index: resume-stable RNG)
                 P(),  # exchange [S] (FedAvg schedule; all-True = parity)
                 P(),  # total_weight (runtime scalar: no per-dataset recompiles)
                 P(),  # rng
             ),
-            out_specs=(state_spec, state_spec, state_spec, P(None, axis_name)),
+            out_specs=(state_spec, state_spec, state_spec, P(None, axes)),
             check_vma=False,
         )
     )
@@ -212,6 +220,7 @@ class FederatedTrainer:
         devices: list | None = None,
         seed: int = 0,
         local_steps: int = 1,
+        mesh: Mesh | None = None,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -226,7 +235,22 @@ class FederatedTrainer:
         # run E local steps between averages — FedAvg proper), shown in
         # results/time_to_quality to recover diversity toward centralized.
         self.local_steps = int(local_steps)
-        self.mesh, self.c_pad = make_client_mesh(n_clients, devices)
+        if mesh is not None:
+            if devices is not None:
+                raise ValueError(
+                    "pass either devices= or mesh=, not both (an explicit "
+                    "mesh already fixes its device set)"
+                )
+            # Explicit (possibly multi-axis) client mesh, e.g. the 2-D
+            # (slice, clients) mesh of make_slice_client_mesh: client
+            # blocks shard over the flattened axes and the FedAvg psum
+            # spans all of them (ICI within a slice, DCN across slices).
+            self.mesh = mesh
+            n_used = int(mesh.devices.size)
+            self.c_pad = -(-n_clients // n_used) * n_used
+        else:
+            self.mesh, self.c_pad = make_client_mesh(n_clients, devices)
+        self._axes = tuple(self.mesh.axis_names)
         self.share_mask = build_share_mask(
             {"params": template.params, "batch_stats": template.batch_stats},
             self.grads_to_share,
@@ -248,6 +272,7 @@ class FederatedTrainer:
             self._program = build_federated_program(
                 t.module, t.tx, self.share_mask, self.mesh,
                 family=t.family, beta_weight=t._beta_weight(),
+                axis_name=self._axes,
                 conditional_exchange=self.local_steps != 1,
             )
         return self._program
@@ -378,7 +403,7 @@ class FederatedTrainer:
         if self._init_state is None or any(
             a is not b for a, b in zip(self._init_state[0], init_src)
         ):
-            sharding = NamedSharding(self.mesh, P("clients"))
+            sharding = NamedSharding(self.mesh, P(self._axes))
             self._init_state = (init_src, jax.tree.map(
                 lambda leaf: jax.device_put(leaf, sharding),
                 tuple(

@@ -69,6 +69,33 @@ def distributed_client_mesh(
     return make_client_mesh(n_clients, jax.devices(), axis_name)
 
 
+def make_slice_client_mesh(
+    n_slices: int,
+    devices_per_slice: int,
+    devices: list | None = None,
+    axis_names: tuple[str, str] = ("slice", "clients"),
+) -> Mesh:
+    """2-D ``(slice, clients)`` mesh for multi-slice federations
+    (SURVEY §7.2 item 7): each TPU slice hosts a block of clients; the
+    FedAvg exchange psums over BOTH axes, so the all-reduce decomposes
+    into an intra-slice reduction over ICI plus a cross-slice reduction
+    over DCN — XLA's standard hierarchical lowering for a mesh whose
+    outer axis crosses slice boundaries. On real multi-slice hardware the
+    device array's outer axis must follow slice topology (one row per
+    slice, e.g. from ``jax.experimental.mesh_utils
+    .create_hybrid_device_mesh``); for the CPU-mesh dryrun any reshape
+    exercises the same program."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_slices * devices_per_slice
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for a {n_slices}x{devices_per_slice} "
+            f"(slice, clients) mesh, have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(n_slices, devices_per_slice)
+    return Mesh(grid, axis_names)
+
+
 def stack_and_pad(arrays: list[np.ndarray], c_pad: int) -> np.ndarray:
     """Stack per-client arrays along a new leading axis, padding ragged doc
     counts with zero rows and missing clients with zero blocks."""

@@ -78,3 +78,68 @@ def test_distributed_mesh_explicit_args_forwarded(monkeypatch):
         "process_id": 0,
     }
     assert mesh.devices.size >= 1
+
+
+class TestSliceClientMesh:
+    """Multi-slice (slice, clients) federation (SURVEY §7.2 item 7)."""
+
+    def test_fedavg_spans_both_axes(self):
+        """On a 2x2 (slice, clients) mesh the exchange must produce
+        identical shared params across ALL four clients — including the
+        pair separated by the slice (DCN-modeled) axis — and match the
+        1-D clients-mesh run bit-for-bit (same schedule seeds, same
+        math, different collective decomposition)."""
+        import jax
+        import numpy as np
+
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+        from gfedntm_tpu.parallel.mesh import make_slice_client_mesh
+
+        V, K, B, docs, C = 48, 3, 8, 12, 4
+        rng = np.random.default_rng(0)
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(docs, V)).astype(np.float32),
+                idx2token={i: f"wd{i}" for i in range(V)},
+            )
+            for _ in range(C)
+        ]
+
+        def template():
+            return AVITM(
+                input_size=V, n_components=K, hidden_sizes=(8, 8),
+                batch_size=B, num_epochs=2, seed=0,
+            )
+
+        mesh = make_slice_client_mesh(2, 2, jax.devices()[:4])
+        assert mesh.axis_names == ("slice", "clients")
+        res_ms = FederatedTrainer(template(), n_clients=C, mesh=mesh).fit(
+            datasets
+        )
+        beta = np.asarray(res_ms.client_params["beta"])
+        for c in range(1, C):
+            np.testing.assert_allclose(beta[0], beta[c], rtol=1e-5,
+                                       atol=1e-6)
+
+        res_1d = FederatedTrainer(
+            template(), n_clients=C, devices=jax.devices()[:4]
+        ).fit(datasets)
+        np.testing.assert_allclose(
+            beta, np.asarray(res_1d.client_params["beta"]),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            res_ms.losses, res_1d.losses, rtol=1e-5, atol=1e-5
+        )
+
+    def test_rejects_insufficient_devices(self):
+        import jax
+        import pytest as _pytest
+
+        from gfedntm_tpu.parallel.mesh import make_slice_client_mesh
+
+        with _pytest.raises(ValueError):
+            # explicit 2-device list: independent of the host's device count
+            make_slice_client_mesh(2, 2, jax.devices()[:2])
