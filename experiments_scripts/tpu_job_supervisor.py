@@ -209,6 +209,8 @@ def main():
          7200, 3, None),
         ("noniid", [py, "experiments_scripts/run_noniid_full.py"],
          3600, 3, None),
+        ("realtext", [py, "experiments_scripts/run_realtext_federated.py"],
+         5400, 2, None),
         ("presets24", [py, "experiments_scripts/run_presets_24.py"],
          3600, 3, None),
     ]
