@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_components", type=int, default=None)
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--local_steps", type=int, default=1,
+                   help="simulate mode: minibatches per client between "
+                        "FedAvg exchanges (1 = the reference's "
+                        "per-minibatch averaging; >1 = FedAvg proper, the "
+                        "opt-in fix for its topic-diversity collapse)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -263,6 +268,7 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
         grads_to_share=cfg.federation.grads_to_share,
         max_iters=cfg.federation.max_iters,
         seed=cfg.train.seed,
+        local_steps=getattr(args, "local_steps", 1),
     )
     with phase_timer(metrics, "federated_fit", n_clients=n_clients):
         result = trainer.fit(datasets, metrics=metrics)
