@@ -48,7 +48,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 N_NODES, VOCAB, K, DOCS_PER_NODE = 5, 5000, 50, 2000
 ETA, ALPHA, FROZEN = 0.01, 0.1, 5
-EPOCHS = 100
+# TTQ_EPOCHS shrinks the run for harness smoke tests ONLY — artifacts
+# committed as evidence use the default 100.
+EPOCHS = int(os.environ.get("TTQ_EPOCHS", "100"))
 SEED = 0
 
 

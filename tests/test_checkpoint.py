@@ -128,3 +128,51 @@ def test_metrics_logger_jsonl(tmp_path):
     lines = [json.loads(ln) for ln in open(path)]
     assert lines[0]["event"] == "epoch" and lines[0]["loss"] == 1.5
     assert lines[1]["event"] == "phase" and lines[1]["seconds"] >= 0
+
+
+def test_local_steps_resume_bitwise(tmp_path):
+    """The E>1 exchange schedule is indexed by ABSOLUTE step, so a
+    checkpoint-resumed run must reproduce the uninterrupted one exactly
+    even when the resume point falls between exchanges."""
+    import numpy as np
+
+    from gfedntm_tpu.federated.trainer import FederatedTrainer
+
+    datasets = _datasets()
+    # 2 clients x 12 docs, B=8 -> 2 steps/epoch; 4 epochs = 8 steps.
+    # E=3: exchanges END of absolute steps 2, 5, and 7 (forced final).
+    full = FederatedTrainer(
+        _template(), n_clients=2, seed=1, local_steps=3
+    ).fit(datasets)
+
+    ckpt = str(tmp_path / "ck")
+    tr_a = FederatedTrainer(
+        _template(), n_clients=2, seed=1, local_steps=3
+    )
+    # Stop after 2 segments of 3 steps (absolute step 6 — mid-period).
+    stop = {"n": 0}
+
+    class _Stop(Exception):
+        pass
+
+    def cb(step, params, batch_stats):
+        stop["n"] += 1
+        if stop["n"] == 2:
+            raise _Stop()
+
+    try:
+        tr_a.fit(datasets, checkpoint_dir=ckpt, checkpoint_every=3,
+                 segment_callback=cb)
+    except _Stop:
+        pass
+
+    tr_b = FederatedTrainer(
+        _template(), n_clients=2, seed=1, local_steps=3
+    )
+    resumed = tr_b.fit(datasets, checkpoint_dir=ckpt, checkpoint_every=3,
+                       resume=True)
+    np.testing.assert_array_equal(resumed.losses, full.losses)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.client_params["beta"]),
+        np.asarray(full.client_params["beta"]),
+    )
