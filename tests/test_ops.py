@@ -687,3 +687,44 @@ class TestBf16Storage:
                 theta, beta, x, rm, rv, None, True, 1e-5, 1e-10, True,
                 "float16",
             )
+
+
+class TestBf16FederatedPath:
+    """compute_dtype='bfloat16' + the fused kernel through the WHOLE
+    federated trainer (interpret mode): the bf16-storage path must match
+    the unfused bf16 trajectory — pins the module-boundary dtype flow
+    (cotangents, BN stats) the kernel-level tests can't see."""
+
+    def test_bf16_fused_federated_matches_unfused(self):
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(7)
+        V, docs, C = 130, 16, 2
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(docs, V)).astype(np.float32),
+                idx2token={i: f"wd{i}" for i in range(V)},
+            )
+            for _ in range(C)
+        ]
+        results = {}
+        for fused in (True, False):
+            template = AVITM(
+                input_size=V, n_components=3, hidden_sizes=(8, 8),
+                batch_size=8, num_epochs=1, seed=0, fused_decoder=fused,
+                compute_dtype="bfloat16",
+            )
+            trainer = FederatedTrainer(template, n_clients=C)
+            results[fused] = trainer.fit(datasets)
+        # bf16 matmuls dominate the noise floor; the fused/unfused delta
+        # must sit inside it (storage quantization = the same bf16 cast
+        # the unfused path's matmuls already apply to their inputs).
+        np.testing.assert_allclose(
+            np.asarray(results[True].client_params["beta"]),
+            np.asarray(results[False].client_params["beta"]),
+            rtol=5e-2, atol=5e-2,
+        )
+        assert np.isfinite(results[True].losses).all()
+        assert np.isfinite(results[False].losses).all()
