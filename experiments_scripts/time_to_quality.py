@@ -565,6 +565,7 @@ def coldproc_only(out_path: str | None = None) -> None:
 
 
 if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
     if "--coldproc-measure" in sys.argv:
         print("COLDPROC " + json.dumps(measure_cold_process()), flush=True)
     elif "--coldproc-only" in sys.argv:
