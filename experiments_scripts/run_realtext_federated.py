@@ -11,9 +11,10 @@ shape as the reference's docker-compose federation
 (``/root/reference/docker-compose.yaml:21-149``).
 
 Arms: centralized (context ceiling), federated parity (per-minibatch
-FedAvg, the reference algorithm), and federated local_steps=1-epoch (the
-opt-in FedAvg-proper fix) — all scored with NPMI / topic diversity /
-inverted RBO against the pooled corpus, plus top-10 topics in real words.
+FedAvg, the reference algorithm), and federated local_steps at 1-epoch
+and 5-epoch exchange periods (the opt-in FedAvg-proper fix) — all scored
+with NPMI / topic diversity / inverted RBO against the pooled corpus,
+plus top-10 topics in real words.
 
 Usage: python experiments_scripts/run_realtext_federated.py [out_json]
 Writes results/realtext_federated/metrics.json (default).
@@ -143,6 +144,7 @@ def main() -> None:
     for arm_name, local_steps in (
         ("federated_parity", 1),
         ("federated_local_steps", steps_per_epoch),
+        ("federated_local_steps_5ep", 5 * steps_per_epoch),
     ):
         template = AVITM(
             input_size=V, n_components=K, hidden_sizes=(50, 50),
