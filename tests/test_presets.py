@@ -14,7 +14,25 @@ def test_registry_covers_the_five_baseline_configs():
         "prodlda_5client_20ng",
         "combinedtm_5client",
         "noniid_fos_5client",
+        # beyond-baseline: the offline real-text federation
+        "realtext_docstrings_5client",
     }
+
+
+@pytest.mark.slow
+def test_realtext_docstrings_preset_smoke():
+    """Tiny-scale end-to-end: extraction -> consensus -> federated fit ->
+    real-word topics (the always-available real-text preset)."""
+    res = presets.realtext_docstrings_5client(
+        scale=0.02, n_components=5, local_steps=2
+    )
+    assert res.summary["n_clients"] == 5
+    assert np.isfinite(res.summary["final_mean_loss"])
+    m = res.summary["metrics"]
+    assert -1.0 <= m["npmi"] <= 1.0
+    topics = res.extras["topics"]
+    assert len(topics) == 5
+    assert all(not w.isdigit() for t in topics for w in t)
 
 
 @pytest.mark.slow
