@@ -82,3 +82,66 @@ class TestPersistArtifact:
         )
         monkeypatch.setenv("BENCH_NO_GIT", "1")
         bench_mod._persist_tpu_artifact({"backend": "tpu"})  # must not raise
+
+
+class TestMainOrchestration:
+    """End-to-end driver-path decisions of bench.main(): live success
+    banks the artifact; a dead tunnel escalates deadlines then emits the
+    cached artifact instead of a CPU number."""
+
+    def _run_main(self, monkeypatch, capsys, phase_results, backend="axon",
+                  artifact_dir=None):
+        calls = []
+
+        def fake_run_phase(phase, bk, timeout_s, retries=1):
+            calls.append((phase, bk, timeout_s))
+            return phase_results.pop(0) if phase_results else None
+
+        monkeypatch.setattr(bench_mod, "_probe_backend", lambda: backend)
+        monkeypatch.setattr(bench_mod, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(bench_mod.sys, "argv", ["bench.py"])
+        monkeypatch.setenv("BENCH_NO_GIT", "1")
+        if artifact_dir is not None:
+            monkeypatch.setattr(
+                bench_mod, "_TPU_ARTIFACT",
+                str(artifact_dir / "bench_latest.json"),
+            )
+        bench_mod.main()
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        return json.loads(out), calls
+
+    def test_live_tpu_success_banks_artifact(self, monkeypatch, capsys,
+                                             tmp_path):
+        summary = {"metric": "m", "value": 9.0, "backend": "tpu"}
+        fused = {"V16384_B64": {"parity": True}}
+        result, calls = self._run_main(
+            monkeypatch, capsys, [dict(summary), fused],
+            artifact_dir=tmp_path,
+        )
+        assert result["provenance"] == "live"
+        assert result["fused_largev"] == fused
+        banked = json.loads((tmp_path / "bench_latest.json").read_text())
+        assert banked["backend"] == "tpu"
+        assert banked["fused_largev"] == fused  # re-banked after fused phase
+
+    def test_dead_tunnel_escalates_then_uses_cached(self, monkeypatch,
+                                                    capsys, tmp_path):
+        _write_artifact(str(tmp_path / "bench_latest.json"), value=777.0)
+        result, calls = self._run_main(
+            monkeypatch, capsys, [None, None], artifact_dir=tmp_path,
+        )
+        assert result["provenance"] == "cached"
+        assert result["value"] == 777.0
+        # two live attempts on the TPU backend, second with 2x deadline
+        assert [c[1] for c in calls] == ["axon", "axon"]
+        assert calls[1][2] == 2 * calls[0][2]
+
+    def test_dead_tunnel_no_artifact_degrades_to_cpu(self, monkeypatch,
+                                                     capsys, tmp_path):
+        cpu_summary = {"metric": "m", "value": 1.0, "backend": "cpu"}
+        result, calls = self._run_main(
+            monkeypatch, capsys, [None, None, cpu_summary, None],
+            artifact_dir=tmp_path / "missing",
+        )
+        assert result["provenance"] == "live-cpu-degraded"
+        assert result["backend"] == "cpu"
