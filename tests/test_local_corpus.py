@@ -1,0 +1,92 @@
+"""Docstring-corpus extractor unit tests (data/local_corpus.py)."""
+
+import numpy as np
+
+from gfedntm_tpu.data.local_corpus import (
+    DocstringCorpusConfig,
+    build_docstring_corpus,
+    clean_docstring,
+)
+
+
+class TestCleanDocstring:
+    def test_drops_doctest_lines(self):
+        text = "Adds numbers.\n\n>>> add(1, 2)\n3\n... more\nKeeps prose."
+        tokens = clean_docstring(text)
+        assert "adds" in tokens and "keeps" in tokens and "prose" in tokens
+        assert "more" not in tokens  # continuation line dropped
+
+    def test_drops_rst_field_lists_and_unwraps_roles(self):
+        text = (
+            "Uses :func:`numpy.mean` internally.\n"
+            ":param x: the input value\n"
+            ":returns: nothing\n"
+        )
+        tokens = clean_docstring(text)
+        assert "numpy" in tokens and "mean" in tokens
+        assert "param" not in tokens and "returns" not in tokens
+
+    def test_splits_identifiers_on_underscores(self):
+        assert clean_docstring("calls load_state_dict eagerly") == [
+            "calls", "load", "state", "dict", "eagerly"
+        ]
+
+    def test_only_alpha_tokens_len3(self):
+        tokens = clean_docstring("x = 42 the CPU busy at 3pm (90%) ok")
+        assert tokens == ["the", "cpu", "busy"]
+
+
+class TestBuildCorpus:
+    def test_extraction_from_synthetic_tree(self, tmp_path):
+        pkg = tmp_path / "alpha"
+        pkg.mkdir()
+        body = " ".join(["alpha prose word tokens here"] * 12)
+        (pkg / "mod.py").write_text(f'"""{body}"""\n')
+        other = tmp_path / "beta"
+        other.mkdir()
+        (other / "mod.py").write_text(f'"""{body} beta"""\n')
+        (tmp_path / "ignored_pkg").mkdir()
+        (tmp_path / "ignored_pkg" / "mod.py").write_text(f'"""{body}"""\n')
+
+        cfg = DocstringCorpusConfig(
+            site_packages=str(tmp_path),
+            client_groups={"a": ("alpha",), "b": ("beta",)},
+            min_words=10, min_tokens=10, docs_per_client=10,
+        )
+        clients, info = build_docstring_corpus(cfg)
+        assert [len(c.documents) for c in clients] == [1, 1]
+        assert info["per_client"]["a"]["extracted"] == 1
+        # non-grouped package pruned, never scanned
+        assert info["total_docs"] == 2
+
+    def test_dedup_across_files(self, tmp_path):
+        pkg = tmp_path / "alpha"
+        pkg.mkdir()
+        body = " ".join(["identical docstring content words"] * 12)
+        (pkg / "m1.py").write_text(f'"""{body}"""\n')
+        (pkg / "m2.py").write_text(f'"""{body}"""\n')
+        cfg = DocstringCorpusConfig(
+            site_packages=str(tmp_path),
+            client_groups={"a": ("alpha",)},
+            min_words=10, min_tokens=10, docs_per_client=10,
+        )
+        clients, info = build_docstring_corpus(cfg)
+        assert len(clients[0].documents) == 1  # duplicate dropped
+
+    def test_deterministic_for_fixed_seed(self, tmp_path):
+        pkg = tmp_path / "alpha"
+        pkg.mkdir()
+        for i, word in enumerate(
+            ("apple", "banana", "cherry", "damson", "elder", "feijoa")
+        ):
+            body = " ".join([f"{word} unique prose content words"] * 12)
+            (pkg / f"m{i}.py").write_text(f'"""{body}"""\n')
+        cfg = DocstringCorpusConfig(
+            site_packages=str(tmp_path),
+            client_groups={"a": ("alpha",)},
+            min_words=10, min_tokens=10, docs_per_client=3, seed=5,
+        )
+        c1, _ = build_docstring_corpus(cfg)
+        c2, _ = build_docstring_corpus(cfg)
+        assert c1[0].documents == c2[0].documents
+        assert len(c1[0].documents) == 3
