@@ -1,0 +1,52 @@
+"""Real-text federation on the offline docstring corpus with the
+local-steps FedAvg fix — the end-to-end flow of
+``results/realtext_federated/``, scaled down to run in a couple of
+minutes.
+
+The corpus needs no downloads: it is extracted from the installed Python
+libraries' docstrings, one client per package family (math, deep
+learning, cloud RPC, NLP, data analysis) — a genuinely non-IID split in
+the same sense as the reference's fieldsOfStudy partitioning
+(`docker-compose.yaml:21-149`). ``local_steps`` controls the FedAvg
+exchange period: 1 reproduces the reference's per-minibatch averaging
+(and its topic-diversity collapse); a few local epochs between exchanges
+recovers centralized-level coherence (see
+results/realtext_federated/metrics.json).
+
+Run: python examples/realtext_federation.py
+
+On a machine whose TPU tunnel is down, jax backend init hangs
+indefinitely — set FORCE_CPU=1 to pin the CPU backend first:
+
+    FORCE_CPU=1 python examples/realtext_federation.py
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if os.environ.get("FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from gfedntm_tpu.presets import realtext_docstrings_5client
+
+# scale=0.1 -> 300 docs/client, 10 epochs; local_steps = 2 local epochs
+# between exchanges (at 300 docs and batch 64 that is 2 * 5 steps).
+res = realtext_docstrings_5client(scale=0.1, n_components=10, local_steps=10)
+
+print("clients:", res.summary["n_clients"],
+      "vocab:", res.summary["vocab_size"],
+      "steps:", res.summary["global_steps"])
+print("metrics:", res.summary["metrics"])
+for i, topic in enumerate(res.extras["topics"][:5]):
+    print(f"topic {i}:", " ".join(topic))
+print(
+    "\nNOTE: scale=0.1 is a smoke demo (300 docs/client, 10 epochs) — "
+    "coherence needs the full corpus. Full-scale evidence: "
+    "results/realtext_federated/metrics.json (federated local_steps "
+    "NPMI +0.21, centralized +0.20)."
+)

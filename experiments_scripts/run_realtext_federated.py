@@ -141,11 +141,25 @@ def main() -> None:
         1, -(-max(len(d) for d in consensus.datasets) // 64)
     )
 
-    for arm_name, local_steps in (
-        ("federated_parity", 1),
-        ("federated_local_steps", steps_per_epoch),
-        ("federated_local_steps_5ep", 5 * steps_per_epoch),
-    ):
+    # REALTEXT_ARMS: comma-list of exchange periods E (in minibatches) to
+    # sweep; default = parity, one local epoch, five local epochs.
+    arms_env = os.environ.get("REALTEXT_ARMS")
+    if arms_env:
+        arm_list = []
+        for e_str in arms_env.split(","):
+            e_val = int(e_str)
+            name = (
+                "federated_parity" if e_val == 1
+                else f"federated_local_steps_E{e_val}"
+            )
+            arm_list.append((name, e_val))
+    else:
+        arm_list = [
+            ("federated_parity", 1),
+            ("federated_local_steps", steps_per_epoch),
+            ("federated_local_steps_5ep", 5 * steps_per_epoch),
+        ]
+    for arm_name, local_steps in arm_list:
         template = AVITM(
             input_size=V, n_components=K, hidden_sizes=(50, 50),
             batch_size=64, num_epochs=epochs, lr=2e-3, momentum=0.99,

@@ -285,9 +285,16 @@ def realtext_docstrings_5client(
     (results/realtext_federated: E = 5 local epochs reaches centralized
     NPMI on this corpus; E=1 reproduces the reference algorithm's
     diversity collapse)."""
+    import os
+
     from gfedntm_tpu.data.local_corpus import (
         DocstringCorpusConfig,
         build_docstring_corpus,
+    )
+    from gfedntm_tpu.data.preproc import (
+        PreprocConfig,
+        load_wordlist,
+        preprocess_corpus,
     )
     from gfedntm_tpu.federated.consensus import run_vocab_consensus
     from gfedntm_tpu.federated.trainer import FederatedTrainer
@@ -298,6 +305,30 @@ def realtext_docstrings_5client(
             docs_per_client=max(100, int(3000 * scale)), seed=seed
         )
     )
+    # Same preprocessing as results/realtext_federated: shared df table
+    # over the pooled corpus (one filtered vocabulary for all clients),
+    # English stopwords, then split back per client. no_below scales down
+    # with the corpus so tiny smoke runs keep a usable vocabulary.
+    stop = load_wordlist(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "wordlists", "english_generic.json",
+        )
+    )
+    pooled = [d for c in clients for d in c.documents]
+    bounds = np.cumsum([0] + [len(c.documents) for c in clients])
+    prep = preprocess_corpus(
+        pooled,
+        PreprocConfig(
+            min_lemas=10, no_below=max(3, int(20 * scale)), no_above=0.3,
+            keep_n=10_000, stopwords=stop,
+        ),
+    )
+    docs_by_client: list[list[str]] = [[] for _ in clients]
+    for pos, idx in enumerate(prep.kept_indices):
+        c = int(np.searchsorted(bounds, idx, side="right") - 1)
+        docs_by_client[c].append(" ".join(prep.docs[pos]))
+    clients = [RawCorpus(documents=d) for d in docs_by_client]
     consensus = run_vocab_consensus(clients, max_features=10_000)
     template = AVITM(
         input_size=len(consensus.global_vocab), n_components=n_components,
