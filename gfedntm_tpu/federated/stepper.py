@@ -124,11 +124,27 @@ class FederatedStepper:
         )
         self._step_in_epoch = 0
 
+    @property
+    def steps_remaining(self) -> int:
+        """Scheduled minibatch steps left in the num_epochs budget — lets
+        a local_steps>1 round truncate so its LAST exchanged step is the
+        final scheduled one (never training past the budget)."""
+        if self._schedule is None or self.finished:
+            return 0
+        per = self._schedule.steps_per_epoch
+        return (
+            (self.model.num_epochs - self.current_epoch) * per
+            - self._step_in_epoch
+        )
+
     # ---- the two protocol steps --------------------------------------------
-    def train_mb_delta(self) -> dict[str, np.ndarray]:
+    def train_mb_delta(self, snapshot: bool = True) -> dict[str, np.ndarray]:
         """One local forward/backward/optimizer step on the current minibatch;
         returns the post-step shared-parameter snapshot
-        (``federated_avitm.py:51-83`` / ``federated_ctm.py:50-114``)."""
+        (``federated_avitm.py:51-83`` / ``federated_ctm.py:50-114``).
+        ``snapshot=False`` skips the host-side snapshot copy and returns
+        ``{}`` — for the aggregate-free intermediate steps of a
+        local_steps>1 round, where only the last step is exchanged."""
         if self._schedule is None:
             raise RuntimeError("pre_fit must be called before stepping")
         m = self.model
@@ -141,7 +157,7 @@ class FederatedStepper:
         self.loss = float(loss)
         self._last_batch_size = float(self._schedule.mask[self._step_in_epoch].sum())
         self._pending_step = True
-        return self.get_gradients()
+        return self.get_gradients() if snapshot else {}
 
     def get_gradients(self) -> dict[str, np.ndarray]:
         """Flat ``{path: array}`` snapshot of the shared subset
@@ -182,11 +198,25 @@ class FederatedStepper:
         if not self._pending_step:
             raise RuntimeError(
                 "delta_update_fit requires a preceding train_mb_delta "
-                "(one aggregate per local step)"
+                "(one aggregate per exchanged step)"
             )
         self._pending_step = False
         self.set_gradients(averaged)
+        return self._advance_accounting()
 
+    def advance_local(self) -> StepStatus:
+        """Advance past the current minibatch WITHOUT applying an
+        aggregate — the intermediate steps of a local_steps=E>1 round
+        (FedAvg proper: only the round's last step is followed by
+        ``delta_update_fit``)."""
+        if not self._pending_step:
+            raise RuntimeError(
+                "advance_local requires a preceding train_mb_delta"
+            )
+        self._pending_step = False
+        return self._advance_accounting()
+
+    def _advance_accounting(self) -> StepStatus:
         # Accounting for the minibatch just processed (intended semantics of
         # the reference's self.X bug, SURVEY.md §2.5 item 2).
         self.train_loss += self.loss

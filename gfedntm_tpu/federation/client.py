@@ -43,14 +43,31 @@ class FederatedClientServicer:
         self._lock = threading.Lock()
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
-        """One local minibatch step; reply with the post-step shared subset
-        (``getGradient``, ``client.py:77-133``)."""
+        """The round's local step(s); reply with the post-step shared
+        subset (``getGradient``, ``client.py:77-133``). ``local_steps``
+        <= 1 is the reference's one-minibatch round; E > 1 runs E-1
+        aggregate-free local steps first (FedAvg proper) — only the
+        final step's snapshot is exchanged, and the following
+        ApplyAggregate accounts it."""
         with self._lock:
+            requested = max(1, int(request.local_steps or 1))
+            # Truncate the round to the remaining epoch budget so the
+            # exchanged step is always the FINAL scheduled one — the SPMD
+            # trainer's forced-final-exchange semantics; never train past
+            # num_epochs. Intermediate steps skip the host snapshot (only
+            # the last step is exchanged).
+            n_run = max(1, min(requested, self.stepper.steps_remaining))
+            losses = []
+            for _ in range(n_run - 1):
+                self.stepper.train_mb_delta(snapshot=False)
+                losses.append(self.stepper.loss)
+                self.stepper.advance_local()
             snapshot = self.stepper.train_mb_delta()
+            losses.append(self.stepper.loss)
             return pb.StepReply(
                 client_id=self.client_id,
                 shared=codec.flatdict_to_bundle(snapshot),
-                loss=self.stepper.loss,
+                loss=float(sum(losses) / len(losses)),
                 nr_samples=self.stepper._last_batch_size,
                 current_mb=self.stepper.current_mb,
                 current_epoch=self.stepper.current_epoch,

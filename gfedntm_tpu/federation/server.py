@@ -73,7 +73,10 @@ class FederatedServer:
         logger: logging.Logger | None = None,
         metrics=None,
         poll_workers: int = 16,
+        local_steps: int = 1,
     ):
+        if local_steps < 1:
+            raise ValueError(f"local_steps must be >= 1, got {local_steps}")
         self.family = family
         self.model_kwargs = dict(model_kwargs or {})
         self.grads_to_share = tuple(grads_to_share)
@@ -82,6 +85,10 @@ class FederatedServer:
         self.logger = logger or logging.getLogger("FederatedServer")
         self.metrics = metrics
         self.poll_workers = poll_workers
+        # FedAvg exchange period in local minibatches (1 = the reference's
+        # per-minibatch averaging; E>1 = FedAvg proper — the same knob as
+        # FederatedTrainer.local_steps, carried to clients per StepRequest).
+        self.local_steps = int(local_steps)
 
         self.federation = Federation(min_clients=min_clients)
         self.template: AVITM | None = None
@@ -257,8 +264,17 @@ class FederatedServer:
                     stub = self._stub_for(stubs, rec)
                     if stub is None:
                         raise RuntimeError("client has no serving address")
+                    # Deadline scales with the round's local-step count:
+                    # the stub default (120 s) covers ONE minibatch + the
+                    # first-poll jit compile; an E-step round multiplies
+                    # the compute part (2 s/step allowance is ~10x the
+                    # observed CPU step time at test scale).
                     return rec, stub.TrainStep(
-                        pb.StepRequest(global_iter=iteration)
+                        pb.StepRequest(
+                            global_iter=iteration,
+                            local_steps=self.local_steps,
+                        ),
+                        timeout=120.0 + 2.0 * self.local_steps,
                     )
                 except Exception as exc:
                     self.logger.warning(

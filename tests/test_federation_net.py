@@ -364,3 +364,57 @@ def test_client_rejoin_after_drop(tmp_path):
     server.stop()
     cl_a.shutdown()
     cl_b2.shutdown()
+
+
+@pytest.mark.slow
+def test_grpc_federation_local_steps(tmp_path):
+    """E>1 over the wire: the server's StepRequest carries local_steps,
+    each client runs E-1 aggregate-free local steps (advance_local) per
+    round, and the run completes with server artifacts — the network
+    analogue of FederatedTrainer(local_steps=E)."""
+    model_kwargs = dict(
+        n_components=4, hidden_sizes=(16, 16), batch_size=8, num_epochs=2,
+        seed=0,
+    )
+    server = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=model_kwargs,
+        max_iters=500, save_dir=str(tmp_path / "server"), local_steps=3,
+    )
+    server_addr = server.start("[::]:0")
+    corpora = _make_corpora(2)
+    clients = [
+        Client(
+            client_id=c + 1, corpus=corpora[c], server_address=server_addr,
+            max_features=80, save_dir=str(tmp_path / f"client{c + 1}"),
+        )
+        for c in range(2)
+    ]
+    threads = [
+        threading.Thread(target=cl.run, daemon=True) for cl in clients
+    ]
+    for t in threads:
+        t.start()
+    assert server.wait_done(timeout=300), "E=3 federation did not finish"
+    for t in threads:
+        t.join(timeout=60)
+
+    for cl in clients:
+        assert cl.stopped.is_set()
+        assert cl.results is not None
+        # budget is exact: rounds truncate so no client trains past
+        # num_epochs (the SPMD forced-final-exchange semantics)
+        assert cl.stepper.current_epoch == model_kwargs["num_epochs"]
+        spe = -(-len(cl.stepper.model.train_data) // model_kwargs["batch_size"])
+        assert cl.stepper.current_mb == spe * model_kwargs["num_epochs"]
+    assert np.isfinite(server.global_betas).all()
+    # E=3 with 3-5 steps/epoch x 2 epochs -> far fewer exchange rounds
+    # than minibatches: the server iterated at most ceil(10/3)+1 rounds.
+    assert server.global_iterations <= 5
+    server.stop()
+    for cl in clients:
+        cl.shutdown()
+
+
+def test_server_rejects_invalid_local_steps():
+    with pytest.raises(ValueError):
+        FederatedServer(min_clients=1, local_steps=0)
