@@ -64,8 +64,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--local_steps", type=int, default=1,
-                   help="simulate mode: minibatches per client between "
-                        "FedAvg exchanges (1 = the reference's "
+                   help="minibatches per client between "
+                        "FedAvg exchanges, simulate AND server modes (1 = the reference's "
                         "per-minibatch averaging; >1 = FedAvg proper, the "
                         "opt-in fix for its topic-diversity collapse)")
     p.add_argument("--verbose", action="store_true")
@@ -170,6 +170,7 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         grads_to_share=cfg.federation.grads_to_share,
         max_iters=cfg.federation.max_iters,
         save_dir=args.save_dir,
+        local_steps=getattr(args, "local_steps", 1),
     )
     port = args.listen_port if args.listen_port is not None else 50051
     server.start(f"[::]:{port}")
