@@ -143,3 +143,37 @@ class TestSliceClientMesh:
         with _pytest.raises(ValueError):
             # explicit 2-device list: independent of the host's device count
             make_slice_client_mesh(2, 2, jax.devices()[:2])
+
+    def test_more_clients_than_multislice_devices(self):
+        """6 clients on a 2x2 (slice, clients) mesh: c_pad rounds to 8,
+        blocks of 2 clients per device, padding clients are no-ops."""
+        import jax
+        import numpy as np
+
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+        from gfedntm_tpu.parallel.mesh import make_slice_client_mesh
+
+        V, C = 48, 6
+        rng = np.random.default_rng(1)
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(10, V)).astype(np.float32),
+                idx2token={i: f"wd{i}" for i in range(V)},
+            )
+            for _ in range(C)
+        ]
+        mesh = make_slice_client_mesh(2, 2, jax.devices()[:4])
+        trainer = FederatedTrainer(
+            AVITM(input_size=V, n_components=3, hidden_sizes=(8, 8),
+                  batch_size=8, num_epochs=1, seed=0),
+            n_clients=C, mesh=mesh,
+        )
+        assert trainer.c_pad == 8
+        res = trainer.fit(datasets)
+        assert res.losses.shape[1] == C
+        beta = np.asarray(res.client_params["beta"])
+        for c in range(1, C):
+            np.testing.assert_allclose(beta[0], beta[c], rtol=1e-5,
+                                       atol=1e-6)
