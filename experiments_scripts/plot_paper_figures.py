@@ -155,11 +155,69 @@ def plot_federated(out: str, model_paths: list[str]):
     print(f"wrote {out}")
 
 
+def plot_e_sweep(out: str, sweep_jsons: list[str]):
+    """Exchange-period sweep (results/realtext_federated/e_sweep*.json):
+    NPMI and topic diversity vs local_steps E. Two measures on different
+    scales -> two panels sharing x (never a dual axis); the centralized
+    ceiling is a dashed reference line; identity is carried by color AND
+    linestyle/markers plus direct labels."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    points: dict[int, dict] = {}
+    centralized = None
+    spe = None
+    for path in sweep_jsons:
+        data = json.load(open(path))
+        for name, arm in data["arms"].items():
+            if name == "centralized":
+                centralized = arm
+                continue
+            e_val = int(arm.get("local_steps", 0))
+            if e_val:
+                points[e_val] = arm
+    es = sorted(points)
+    fig, axs = plt.subplots(1, 2, figsize=(8, 3), sharex=True)
+    for ax, metric, label in (
+        (axs[0], "npmi", "NPMI coherence"),
+        (axs[1], "topic_diversity", "Topic diversity (top-10)"),
+    ):
+        ys = [points[e][metric] for e in es]
+        ax.plot(es, ys, "o-", color="tab:blue", lw=2, ms=6,
+                label="federated (local_steps=E)")
+        if centralized is not None:
+            ax.axhline(centralized[metric], color="tab:green", ls="--",
+                       lw=2, label="centralized ceiling")
+        ax.axvline(47, color="gray", ls=":", lw=1)
+        ax.text(47, ax.get_ylim()[1], "1 local epoch ", fontsize=7,
+                color="gray", va="top", ha="right", rotation=90)
+        ax.set_xscale("log", base=2)
+        ax.set_xlabel("exchange period E (minibatches, log2)")
+        ax.set_ylabel(label)
+        ax.grid(True, linestyle=":", alpha=0.6)
+    # Direct-label the parity point (the reference's algorithm) once.
+    axs[0].annotate(
+        "reference parity (E=1)", (es[0], points[es[0]]["npmi"]),
+        textcoords="offset points", xytext=(6, 8), fontsize=7,
+    )
+    axs[0].legend(fontsize=8, loc="center left")
+    fig.suptitle(
+        "Real-text federation: FedAvg exchange period vs topic quality",
+        fontsize=10,
+    )
+    fig.tight_layout()
+    fig.savefig(out, dpi=300, bbox_inches="tight")
+    print(f"wrote {out}")
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("figure", choices=["dss_tss", "federated"])
+    p.add_argument("figure", choices=["dss_tss", "federated", "e_sweep"])
     p.add_argument("out")
-    p.add_argument("models", nargs="*", help="npz artifacts (federated)")
+    p.add_argument("models", nargs="*",
+                   help="npz artifacts (federated) / sweep jsons (e_sweep)")
     p.add_argument("--eta", help="eta-sweep results.json")
     p.add_argument("--frozen", help="frozen-sweep results.json")
     args = p.parse_args()
@@ -168,6 +226,10 @@ def main() -> None:
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     if args.figure == "dss_tss":
         plot_dss_tss(args.out, args.eta, args.frozen)
+    elif args.figure == "e_sweep":
+        if not args.models:
+            raise SystemExit("e_sweep figure needs sweep json paths")
+        plot_e_sweep(args.out, args.models)
     else:
         if not args.models:
             raise SystemExit("federated figure needs npz model paths")
