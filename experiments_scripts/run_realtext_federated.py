@@ -44,6 +44,7 @@ def main() -> None:
     )
     logging.basicConfig(level=logging.WARNING)
     scale = float(os.environ.get("REALTEXT_SCALE", "1.0"))
+    seed = int(os.environ.get("REALTEXT_SEED", "0"))
 
     import jax
 
@@ -77,7 +78,7 @@ def main() -> None:
     t0 = time.perf_counter()
     clients_raw, info = build_docstring_corpus(
         DocstringCorpusConfig(
-            docs_per_client=max(200, int(3000 * scale)),
+            docs_per_client=max(200, int(3000 * scale)), seed=seed,
         )
     )
     extract_s = time.perf_counter() - t0
@@ -107,6 +108,7 @@ def main() -> None:
     names = list(info["per_client"].keys())
     report: dict = {
         "backend": backend,
+        "seed": seed,
         "corpus": {
             "source": "site-packages docstrings (offline; "
                       "data/local_corpus.py)",
@@ -163,10 +165,11 @@ def main() -> None:
         template = AVITM(
             input_size=V, n_components=K, hidden_sizes=(50, 50),
             batch_size=64, num_epochs=epochs, lr=2e-3, momentum=0.99,
-            seed=0,
+            seed=seed,
         )
         trainer = FederatedTrainer(
-            template, n_clients=len(clients), local_steps=local_steps
+            template, n_clients=len(clients), local_steps=local_steps,
+            seed=seed,
         )
         t0 = time.perf_counter()
         result = trainer.fit(consensus.datasets)
@@ -193,7 +196,7 @@ def main() -> None:
     )
     model = AVITM(
         input_size=input_size, n_components=K, hidden_sizes=(50, 50),
-        batch_size=64, num_epochs=epochs, lr=2e-3, momentum=0.99, seed=0,
+        batch_size=64, num_epochs=epochs, lr=2e-3, momentum=0.99, seed=seed,
     )
     t0 = time.perf_counter()
     model.fit(train_data, val_data)
