@@ -96,6 +96,27 @@ def make_slice_client_mesh(
     return Mesh(grid, axis_names)
 
 
+def distributed_slice_client_mesh(
+    axis_names: tuple[str, str] = ("slice", "clients"),
+) -> Mesh:
+    """Real-pod construction of the multi-slice client mesh: one mesh row
+    per PROCESS (devices grouped by ``process_index``, so the outer axis
+    crosses host/slice boundaries and its collectives ride DCN), local
+    devices along the inner ``clients`` axis (ICI). Call after
+    ``jax.distributed.initialize`` (see :func:`distributed_client_mesh`);
+    on a single process this degenerates to a 1 x n_devices mesh —
+    equivalent to the 1-D clients mesh."""
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_proc = max(1, jax.process_count())
+    if len(devices) % n_proc != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not divide evenly over "
+            f"{n_proc} processes"
+        )
+    grid = np.array(devices).reshape(n_proc, len(devices) // n_proc)
+    return Mesh(grid, axis_names)
+
+
 def stack_and_pad(arrays: list[np.ndarray], c_pad: int) -> np.ndarray:
     """Stack per-client arrays along a new leading axis, padding ragged doc
     counts with zero rows and missing clients with zero blocks."""

@@ -177,3 +177,35 @@ class TestSliceClientMesh:
         for c in range(1, C):
             np.testing.assert_allclose(beta[0], beta[c], rtol=1e-5,
                                        atol=1e-6)
+
+    def test_distributed_slice_client_mesh_single_process(self):
+        """Single process: 1 x n_devices grid — the degenerate slice
+        axis; the trainer accepts it like any multi-axis mesh."""
+        import jax
+        import numpy as np
+
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+        from gfedntm_tpu.parallel.mesh import distributed_slice_client_mesh
+
+        mesh = distributed_slice_client_mesh()
+        assert mesh.axis_names == ("slice", "clients")
+        assert mesh.devices.shape == (1, len(jax.devices()))
+
+        V, C = 48, 2
+        rng = np.random.default_rng(2)
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(10, V)).astype(np.float32),
+                idx2token={i: f"wd{i}" for i in range(V)},
+            )
+            for _ in range(C)
+        ]
+        res = FederatedTrainer(
+            AVITM(input_size=V, n_components=3, hidden_sizes=(8, 8),
+                  batch_size=8, num_epochs=1, seed=0),
+            n_clients=C, mesh=mesh,
+        ).fit(datasets)
+        beta = np.asarray(res.client_params["beta"])
+        np.testing.assert_allclose(beta[0], beta[1], rtol=1e-5, atol=1e-6)
