@@ -59,6 +59,7 @@ def _run_federation(
     model_kwargs: dict[str, Any],
     num_epochs: int,
     contextual: bool = False,
+    local_steps: int = 1,
 ) -> PresetResult:
     from gfedntm_tpu.federated.consensus import run_vocab_consensus
     from gfedntm_tpu.federated.trainer import FederatedTrainer
@@ -72,7 +73,9 @@ def _run_federation(
         template = CombinedTM(**kwargs)
     else:
         template = AVITM(**kwargs)
-    trainer = FederatedTrainer(template, n_clients=len(corpora))
+    trainer = FederatedTrainer(
+        template, n_clients=len(corpora), local_steps=local_steps
+    )
     result = trainer.fit(consensus.datasets)
     summary = {
         "n_clients": len(corpora),
@@ -193,6 +196,7 @@ def noniid_fos_5client(
     fos_column: str = "fieldsOfStudy",
     n_components: int = 50,
     compute_metrics: bool = True,
+    local_steps: int = 1,
 ) -> PresetResult:
     """Config 5: non-IID FOS-partitioned real corpus, 5 clients (the
     collab_vs_non_collab regime); one client per category of the parquet's
@@ -246,8 +250,10 @@ def noniid_fos_5client(
         dict(n_components=n_components, hidden_sizes=(50, 50), batch_size=64,
              seed=seed),
         num_epochs=max(2, int(100 * scale)),
+        local_steps=local_steps,
     )
     res.summary["fos_categories"] = fos_categories
+    res.summary["local_steps"] = local_steps
     if compute_metrics:
         from gfedntm_tpu.eval.metrics import (
             inverted_rbo,
