@@ -145,3 +145,18 @@ class TestMainOrchestration:
         )
         assert result["provenance"] == "live-cpu-degraded"
         assert result["backend"] == "cpu"
+
+    def test_cpu_degradation_cites_committed_tpu_evidence(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """With no banked artifact, the degraded record must point at the
+        strongest committed TPU evidence (step_time_probe) so the
+        official capture is self-describing."""
+        cpu_summary = {"metric": "m", "value": 1.0, "backend": "cpu"}
+        result, _ = self._run_main(
+            monkeypatch, capsys, [None, None, cpu_summary, None],
+            artifact_dir=tmp_path / "missing",
+        )
+        ev = result.get("strongest_committed_tpu_evidence")
+        assert ev is not None and ev["backend"] == "tpu"
+        assert ev["docs_per_s"] > 0
