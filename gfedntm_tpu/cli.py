@@ -6,6 +6,11 @@ reference cannot express: ``--id`` omitted runs the WHOLE federation as one
 SPMD program on the local device mesh (``simulate``), where the gRPC
 hub-and-spoke collapses into ``lax.psum`` over ICI.
 
+A fourth entry point reads telemetry instead of producing it:
+``python -m gfedntm_tpu.cli summarize <metrics.jsonl>`` renders a run
+report (phase breakdown, p50/p95/p99 step time, bytes moved per round,
+slowest client) from the JSONL stream every role writes to its save dir.
+
 Data paths mirror ``main.py:138-152``: synthetic ``.npz`` archives (node
 ``id-1`` of a multi-node archive) or real ``.parquet`` filtered by ``--fos``.
 Hyperparameters come from a reference-format INI (``--config``,
@@ -33,6 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
             "TPU-native federated neural topic modeling. --id 0: federation "
             "server; --id N: network client; no --id: whole federation as "
             "one SPMD program."
+        ),
+        epilog=(
+            "Subcommand: 'summarize <metrics.jsonl>' renders a telemetry "
+            "report from a run's JSONL stream (see README 'Telemetry')."
         ),
     )
     p.add_argument("--id", type=int, default=None,
@@ -162,7 +171,9 @@ def _load_corpora(args: argparse.Namespace):
 def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     """``--id 0``: network federation server (``main.py:27-95``)."""
     from gfedntm_tpu.federation.server import FederatedServer
+    from gfedntm_tpu.utils.observability import MetricsLogger
 
+    metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
     server = FederatedServer(
         min_clients=args.min_clients_federation,
         family=args.model_type,
@@ -171,12 +182,14 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         max_iters=cfg.federation.max_iters,
         save_dir=args.save_dir,
         local_steps=getattr(args, "local_steps", 1),
+        metrics=metrics,
     )
     port = args.listen_port if args.listen_port is not None else 50051
     server.start(f"[::]:{port}")
     logging.info("server on port %d; waiting for federation", port)
     server.wait_done()
     server.stop()
+    metrics.close()
     return 0
 
 
@@ -200,6 +213,10 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
     port = (
         args.listen_port if args.listen_port is not None else 50051 + args.id
     )
+    from gfedntm_tpu.utils.observability import MetricsLogger
+
+    save_dir = os.path.join(args.save_dir, f"client{args.id}")
+    metrics = MetricsLogger(os.path.join(save_dir, "metrics.jsonl"))
     client = Client(
         client_id=args.id,
         corpus=corpus,
@@ -207,10 +224,12 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         listen_address=f"[::]:{port}",
         max_features=cfg.data.max_features,
         stop_words=cfg.data.stop_words,
-        save_dir=os.path.join(args.save_dir, f"client{args.id}"),
+        save_dir=save_dir,
+        metrics=metrics,
     )
     client.run()
     client.shutdown()
+    metrics.close()
     return 0
 
 
@@ -319,12 +338,53 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
             betas, synthetic.topic_vectors
         )
     metrics.log("summary", **summary)
+    metrics.snapshot_registry()
     metrics.close()
     print(json.dumps(summary))
     return 0
 
 
+# ---- telemetry report (`summarize` subcommand) ------------------------------
+
+def run_summarize(argv: list[str]) -> int:
+    """``summarize <metrics.jsonl>``: render a run report from the telemetry
+    stream (phase breakdown, p50/p95/p99 step time, bytes per round,
+    slowest client); ``--json <path>`` also writes the aggregate dict."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu summarize",
+        description="Render a run report from a telemetry metrics.jsonl.",
+    )
+    p.add_argument("path", help="path to a run's metrics.jsonl")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the aggregated summary dict as JSON")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.utils.observability import (
+        format_report,
+        read_metrics,
+        summarize_metrics,
+    )
+
+    try:
+        records = read_metrics(args.path)
+    except FileNotFoundError:
+        raise SystemExit(f"no such metrics file: {args.path}")
+    summary = summarize_metrics(records)
+    if args.json_out:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
+        )
+        with open(args.json_out, "w") as fh:
+            json.dump(summary, fh, indent=1, default=float)
+    print(format_report(summary))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "summarize":
+        return run_summarize(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -27,6 +27,7 @@ the tracked loss (bug 3).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -76,9 +77,16 @@ class FederatedStepper:
         model: AVITM,
         grads_to_share: tuple[str, ...] = SHARE_ALL,
         epoch_snapshot_dir: str | None = None,
+        metrics=None,
     ):
         self.model = model
         self.grads_to_share = tuple(grads_to_share)
+        # Optional MetricsLogger: per-step wall-time histogram
+        # ("stepper_step_s", host-synced — includes the loss device fetch)
+        # plus first-step compile capture via the jit wrapper. None = every
+        # hook is a no-op (zero overhead).
+        self.metrics = metrics
+        self._first_step_done = False
         # When set, a model snapshot (variables + config) is written at every
         # epoch end during federated training — the reference does this for
         # CTM (``federated_ctm.py:150-159``); here any stepped model may
@@ -89,7 +97,8 @@ class FederatedStepper:
             self.grads_to_share,
         )
         self._step_fn = build_train_step(
-            model.module, model.tx, model.family, model._beta_weight()
+            model.module, model.tx, model.family, model._beta_weight(),
+            metrics=metrics, label="train_step",
         )
         self._flat_mask = flatten_dict(self.share_mask, sep="/")
         self._shared_keys = frozenset(
@@ -148,6 +157,7 @@ class FederatedStepper:
         if self._schedule is None:
             raise RuntimeError("pre_fit must be called before stepping")
         m = self.model
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
         idx = jnp.asarray(self._schedule.indices[self._step_in_epoch])
         mask = jnp.asarray(self._schedule.mask[self._step_in_epoch])
         m.params, m.batch_stats, m.opt_state, loss = self._step_fn(
@@ -155,6 +165,17 @@ class FederatedStepper:
             m._next_rng(),
         )
         self.loss = float(loss)
+        if self.metrics is not None:
+            # float(loss) above is the host sync, so this is true per-step
+            # wall time (dispatch + device execution), not async dispatch.
+            # The first step is trace+compile dominated — timed_jit already
+            # logged it as jit_compile; keep it out of the steady-state
+            # histogram so p95/p99 reflect real step time.
+            if self._first_step_done:
+                self.metrics.registry.histogram("stepper_step_s").observe(
+                    time.perf_counter() - t0
+                )
+            self._first_step_done = True
         self._last_batch_size = float(self._schedule.mask[self._step_in_epoch].sum())
         self._pending_step = True
         return self.get_gradients() if snapshot else {}

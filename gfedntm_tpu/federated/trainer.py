@@ -27,6 +27,7 @@ blocks (exact no-ops); ragged batches are padded and masked (mask-aware loss
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -256,6 +257,10 @@ class FederatedTrainer:
             self.grads_to_share,
         )
         self._program: Any = None
+        # Segment lengths already run through the program: jax.jit
+        # re-specializes per segment-length shape, so the FIRST run at each
+        # length is compile-dominated — captured as a jit_compile event.
+        self._compiled_lengths: set[int] = set()
         self._staged: tuple[list, dict] | None = None
         # (key, tree): device-resident per-client initial (params,
         # batch_stats, opt_state), built on first fit and reused by later
@@ -467,7 +472,8 @@ class FederatedTrainer:
             run = self._get_program()
             # RNG folding is per absolute step (scan xs carries step indices),
             # so resumed runs reproduce the unresumed ones exactly.
-            with phase_timer(metrics, "program_segment", steps=n):
+            t0 = time.perf_counter()
+            try:
                 params, batch_stats, opt_state, seg_losses = run(
                     params, batch_stats, opt_state, data, weights_j, ids_j,
                     jnp.asarray(indices[step:step + n]),
@@ -478,6 +484,29 @@ class FederatedTrainer:
                     rng,
                 )
                 loss_chunks.append(np.asarray(seg_losses))
+            finally:
+                # Logged even when the segment raises (OOM/interrupt), so a
+                # crashed run keeps its in-flight segment timing.
+                seg_s = time.perf_counter() - t0
+                if metrics is not None:
+                    metrics.log("phase", phase="program_segment",
+                                seconds=seg_s, steps=n)
+            if metrics is not None:
+                # First-run-at-this-length compile capture, then the
+                # per-segment average step time histogram ("trainer_step_s";
+                # np.asarray above host-syncs, so seg_s is real wall time —
+                # scan steps are opaque to the host, so the histogram's
+                # resolution is one observation per segment).
+                if n not in self._compiled_lengths:
+                    metrics.log(
+                        "jit_compile", what="federated_program",
+                        seconds=seg_s, steps=n,
+                    )
+                else:
+                    metrics.registry.histogram("trainer_step_s").observe(
+                        seg_s / max(n, 1)
+                    )
+            self._compiled_lengths.add(n)
             step += n
             if metrics is not None:
                 metrics.log(
@@ -504,6 +533,9 @@ class FederatedTrainer:
                     "losses": np.concatenate(loss_chunks, axis=0),
                 }, force=True)
             manager.close()
+
+        if metrics is not None:
+            metrics.snapshot_registry(step=total_steps)
 
         losses = np.concatenate(loss_chunks, axis=0)[:, :C]
 

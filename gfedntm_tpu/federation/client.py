@@ -26,20 +26,26 @@ from gfedntm_tpu.federation import codec, rpc
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.federated.stepper import FederatedStepper
+from gfedntm_tpu.utils.observability import span
 
 
 class FederatedClientServicer:
     """The in-client gRPC service the server polls during training
     (``FederatedClientServer``, ``client.py:43-185``). A lock serializes
     access to the stepper — the reference relies on the server never
-    overlapping requests (SURVEY.md §5 race note); here it is enforced."""
+    overlapping requests (SURVEY.md §5 race note); here it is enforced.
+
+    ``metrics`` (optional MetricsLogger) feeds codec byte/latency telemetry
+    and a per-poll round counter; the wrapped stepper carries its own
+    step-time histograms."""
 
     def __init__(self, client_id: int, stepper: FederatedStepper,
-                 on_stop, logger: logging.Logger):
+                 on_stop, logger: logging.Logger, metrics=None):
         self.client_id = client_id
         self.stepper = stepper
         self.on_stop = on_stop
         self.logger = logger
+        self.metrics = metrics
         self._lock = threading.Lock()
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
@@ -64,9 +70,13 @@ class FederatedClientServicer:
                 self.stepper.advance_local()
             snapshot = self.stepper.train_mb_delta()
             losses.append(self.stepper.loss)
+            if self.metrics is not None:
+                self.metrics.registry.counter("client_polls").inc()
             return pb.StepReply(
                 client_id=self.client_id,
-                shared=codec.flatdict_to_bundle(snapshot),
+                shared=codec.flatdict_to_bundle(
+                    snapshot, metrics=self.metrics
+                ),
                 loss=float(sum(losses) / len(losses)),
                 nr_samples=self.stepper._last_batch_size,
                 current_mb=self.stepper.current_mb,
@@ -85,7 +95,9 @@ class FederatedClientServicer:
                     client_id=self.client_id, finished=True,
                     current_epoch=self.stepper.current_epoch,
                 )
-            average = codec.bundle_to_flatdict(request.shared)
+            average = codec.bundle_to_flatdict(
+                request.shared, metrics=self.metrics
+            )
             status = self.stepper.delta_update_fit(average)
             if status.epoch_ended:
                 self.logger.info(
@@ -118,6 +130,7 @@ class Client:
         save_dir: str | None = None,
         setup_timeout: float = 3600.0,
         logger: logging.Logger | None = None,
+        metrics=None,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -130,6 +143,9 @@ class Client:
         self.save_dir = save_dir
         self.setup_timeout = setup_timeout
         self.logger = logger or logging.getLogger(f"Client{client_id}")
+        # Optional MetricsLogger: join-phase spans, RPC/codec registry
+        # metrics, and the stepper's step-time histograms all flow into it.
+        self.metrics = metrics
 
         self.stepper: FederatedStepper | None = None
         self.global_vocab: Vocabulary | None = None
@@ -149,20 +165,24 @@ class Client:
     def join_federation(self) -> None:
         """Phases 1-2 of the client lifecycle (``client.py:378-507``)."""
         channel = rpc.make_channel(self.server_address)
-        self._federation_stub = rpc.ServiceStub(channel, "gfedntm.Federation")
+        self._federation_stub = rpc.ServiceStub(
+            channel, "gfedntm.Federation",
+            metrics=self.metrics, peer="server",
+        )
 
         # 1. local vocabulary -> server (client.py:358-406)
-        local_vocab = build_vocabulary(
-            self.corpus.documents, max_features=self.max_features,
-            stop_words=self.stop_words,
-        )
-        self._federation_stub.OfferVocab(
-            pb.VocabOffer(
-                client_id=self.client_id,
-                tokens=list(local_vocab.tokens),
-                nr_samples=float(len(self.corpus)),
+        with span(self.metrics, "offer_vocab", client=self.client_id):
+            local_vocab = build_vocabulary(
+                self.corpus.documents, max_features=self.max_features,
+                stop_words=self.stop_words,
             )
-        )
+            self._federation_stub.OfferVocab(
+                pb.VocabOffer(
+                    client_id=self.client_id,
+                    tokens=list(local_vocab.tokens),
+                    nr_samples=float(len(self.corpus)),
+                )
+            )
 
         # 2. blocking wait for consensus + replicated init (client.py:408-507)
         # GetGlobalSetup blocks server-side until the vocabulary quorum is
@@ -170,30 +190,33 @@ class Client:
         # 120 s per-RPC default — clients routinely join minutes apart
         # (the reference's hard 120 s consensus wait is a documented defect,
         # SURVEY.md §2.5 item 9).
-        setup = self._federation_stub.GetGlobalSetup(
-            pb.JoinRequest(client_id=self.client_id),
-            timeout=self.setup_timeout,
-        )
-        self.global_vocab = Vocabulary(tuple(setup.vocab))
-        hyper = json.loads(setup.hyperparams_json)
-        model = build_template_model(
-            hyper["family"], len(self.global_vocab), hyper["kwargs"]
-        )
-        # Overwrite the locally-initialized state with the server's
-        # replicated init (NNUpdate/AdamUpdate semantics, client.py:498-503).
-        variables = codec.bundle_to_tree(
-            {"params": model.params, "batch_stats": model.batch_stats},
-            setup.init_variables,
-        )
-        model.params = variables["params"]
-        model.batch_stats = variables["batch_stats"]
-        model.opt_state = codec.bundle_to_tree(
-            model.opt_state, setup.init_opt_state
-        )
+        with span(self.metrics, "get_setup", client=self.client_id):
+            setup = self._federation_stub.GetGlobalSetup(
+                pb.JoinRequest(client_id=self.client_id),
+                timeout=self.setup_timeout,
+            )
+            self.global_vocab = Vocabulary(tuple(setup.vocab))
+            hyper = json.loads(setup.hyperparams_json)
+            model = build_template_model(
+                hyper["family"], len(self.global_vocab), hyper["kwargs"]
+            )
+            # Overwrite the locally-initialized state with the server's
+            # replicated init (NNUpdate/AdamUpdate semantics,
+            # client.py:498-503).
+            variables = codec.bundle_to_tree(
+                {"params": model.params, "batch_stats": model.batch_stats},
+                setup.init_variables, metrics=self.metrics,
+            )
+            model.params = variables["params"]
+            model.batch_stats = variables["batch_stats"]
+            model.opt_state = codec.bundle_to_tree(
+                model.opt_state, setup.init_opt_state, metrics=self.metrics,
+            )
 
         # 3. re-vectorize the local corpus against the GLOBAL vocabulary
         # (client.py:460-468) and build the dataset
-        X = vectorize(self.corpus.documents, self.global_vocab)
+        with span(self.metrics, "revectorize", client=self.client_id):
+            X = vectorize(self.corpus.documents, self.global_vocab)
         if hyper["family"] == "ctm":
             if self.corpus.embeddings is None:
                 raise ValueError("CTM federation requires embeddings")
@@ -224,15 +247,18 @@ class Client:
         self.stepper = FederatedStepper(
             model, grads_to_share=tuple(hyper["grads_to_share"]),
             epoch_snapshot_dir=snapshot_dir,
+            metrics=self.metrics,
         )
-        self.stepper.pre_fit(self.dataset)
+        with span(self.metrics, "pre_fit", client=self.client_id):
+            self.stepper.pre_fit(self.dataset)
 
     def serve_training(self) -> None:
         """Start the in-client servicer and signal readiness
         (``__start_client_server`` + ``__send_ready_for_training``,
         ``client.py:282-319,509-532``)."""
         servicer = FederatedClientServicer(
-            self.client_id, self.stepper, self._on_stop, self.logger
+            self.client_id, self.stepper, self._on_stop, self.logger,
+            metrics=self.metrics,
         )
         self._grpc_server = rpc.make_server(max_workers=4)
         rpc.add_service(
@@ -262,13 +288,16 @@ class Client:
         (thresholded thetas + betas + topics, ``client.py:173-183`` →
         ``get_results_model``)."""
         try:
-            self.results = self.stepper.get_results_model(self.save_dir)
+            with span(self.metrics, "finalize", client=self.client_id):
+                self.results = self.stepper.get_results_model(self.save_dir)
         except Exception:
             self.logger.exception(
                 "client %d finalization failed", self.client_id
             )
             raise
         finally:
+            if self.metrics is not None:
+                self.metrics.snapshot_registry(client=self.client_id)
             self.stopped.set()
 
     def shutdown(self, grace: float = 0.5) -> None:

@@ -15,12 +15,15 @@ names are verified, not used for reordering).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Mapping
 
 import jax
 import numpy as np
 
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
+
+from gfedntm_tpu.utils.observability import DEFAULT_BYTE_BUCKETS
 
 # dtype whitelist (superset of the reference's float32/float64/int64,
 # auxiliary_functions.py:24-35; int32/bool appear in optax/BatchNorm state).
@@ -49,16 +52,44 @@ def record_to_array(record: pb.TensorRecord) -> np.ndarray:
     return arr.reshape(tuple(record.shape)).copy()
 
 
+def _note_codec(metrics, op: str, bundle: pb.TensorBundle,
+                seconds: float) -> None:
+    """Feed codec telemetry (seconds + serialized bytes per bundle) into a
+    MetricsLogger's registry. Registry-only: one federation round encodes/
+    decodes per client per step, so per-call JSONL events would dominate the
+    stream; totals surface via ``metrics_snapshot``."""
+    reg = metrics.registry
+    nbytes = bundle.ByteSize()
+    reg.histogram(f"codec_{op}_s").observe(seconds)
+    reg.histogram(
+        "codec_bundle_bytes", buckets=DEFAULT_BYTE_BUCKETS
+    ).observe(nbytes)
+    reg.counter(f"codec_{op}d_bytes").inc(nbytes)
+    reg.counter(f"codec_{op}_calls").inc()
+
+
 # ---- flat {name: array} dicts (the shared-subset snapshots) ----------------
 
-def flatdict_to_bundle(tensors: Mapping[str, np.ndarray]) -> pb.TensorBundle:
-    return pb.TensorBundle(
+def flatdict_to_bundle(
+    tensors: Mapping[str, np.ndarray], metrics=None
+) -> pb.TensorBundle:
+    t0 = time.perf_counter() if metrics is not None else 0.0
+    bundle = pb.TensorBundle(
         tensors=[array_to_record(k, v) for k, v in sorted(tensors.items())]
     )
+    if metrics is not None:
+        _note_codec(metrics, "encode", bundle, time.perf_counter() - t0)
+    return bundle
 
 
-def bundle_to_flatdict(bundle: pb.TensorBundle) -> dict[str, np.ndarray]:
-    return {r.name: record_to_array(r) for r in bundle.tensors}
+def bundle_to_flatdict(
+    bundle: pb.TensorBundle, metrics=None
+) -> dict[str, np.ndarray]:
+    t0 = time.perf_counter() if metrics is not None else 0.0
+    out = {r.name: record_to_array(r) for r in bundle.tensors}
+    if metrics is not None:
+        _note_codec(metrics, "decode", bundle, time.perf_counter() - t0)
+    return out
 
 
 # ---- arbitrary pytrees (params / batch_stats / optax state) ----------------
@@ -68,19 +99,24 @@ def _leaf_names(tree: Any) -> list[str]:
     return [jax.tree_util.keystr(p) for p, _ in paths]
 
 
-def tree_to_bundle(tree: Any) -> pb.TensorBundle:
+def tree_to_bundle(tree: Any, metrics=None) -> pb.TensorBundle:
     """Serialize every array leaf of ``tree`` in flatten order."""
+    t0 = time.perf_counter() if metrics is not None else 0.0
     names = _leaf_names(tree)
     leaves = jax.tree_util.tree_leaves(tree)
-    return pb.TensorBundle(
+    bundle = pb.TensorBundle(
         tensors=[array_to_record(n, l) for n, l in zip(names, leaves)]
     )
+    if metrics is not None:
+        _note_codec(metrics, "encode", bundle, time.perf_counter() - t0)
+    return bundle
 
 
-def bundle_to_tree(template: Any, bundle: pb.TensorBundle) -> Any:
+def bundle_to_tree(template: Any, bundle: pb.TensorBundle, metrics=None) -> Any:
     """Rebuild a pytree with ``template``'s structure from a bundle produced
     by :func:`tree_to_bundle` on a structurally-identical tree. Leaf names
     are checked to catch template/wire mismatches early."""
+    t0 = time.perf_counter() if metrics is not None else 0.0
     leaves, treedef = jax.tree_util.tree_flatten(template)
     records = list(bundle.tensors)
     if len(records) != len(leaves):
@@ -102,4 +138,7 @@ def bundle_to_tree(template: Any, bundle: pb.TensorBundle) -> Any:
                 f"template {tmpl.shape}"
             )
         new_leaves.append(arr.astype(tmpl.dtype))
-    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+    out = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    if metrics is not None:
+        _note_codec(metrics, "decode", bundle, time.perf_counter() - t0)
+    return out

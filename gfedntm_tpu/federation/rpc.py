@@ -10,6 +10,7 @@ messages (``main.py:218-242``: 250 MB caps + keepalive).
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import grpc
@@ -61,15 +62,61 @@ def add_service(server: grpc.Server, service_name: str, impl: Any) -> None:
     )
 
 
-def _with_deadline(fn, default_timeout: float | None):
+def _with_deadline(fn, default_timeout: float | None, metrics=None,
+                   service: str = "", method: str = "", peer: str = ""):
     """Apply a default gRPC deadline: a deadline-less unary call on an
     unconnectable channel blocks forever (no RST ⇒ no error), which would
-    hang the training thread on the first unreachable client."""
+    hang the training thread on the first unreachable client.
+
+    With a ``metrics`` logger, each call also feeds the telemetry registry:
+    per-method latency histograms (``rpc_s/<Service>.<Method>``), call/byte
+    counters, and deadline-expiry counters. Successful calls stay out of
+    the JSONL stream (they surface via ``metrics_snapshot``); failures are
+    logged as individual ``rpc`` events — they are rare and diagnostic."""
+    if metrics is not None:
+        reg = metrics.registry
+        short = service.rsplit(".", 1)[-1]
+        hist = reg.histogram(f"rpc_s/{short}.{method}")
+        calls = reg.counter("rpc_calls")
+        errors = reg.counter("rpc_errors")
+        deadline_expired = reg.counter("rpc_deadline_expired")
+        bytes_sent = reg.counter("rpc_bytes_sent")
+        bytes_recv = reg.counter("rpc_bytes_recv")
 
     def call(request, timeout: float | None = None, **kwargs):
         if timeout is None:
             timeout = default_timeout
-        return fn(request, timeout=timeout, **kwargs)
+        if metrics is None:
+            return fn(request, timeout=timeout, **kwargs)
+        t0 = time.perf_counter()
+        calls.inc()
+        bytes_sent.inc(request.ByteSize())
+        try:
+            response = fn(request, timeout=timeout, **kwargs)
+        except Exception as exc:
+            # Failures stay OUT of the latency histogram — a deadline
+            # expiry observes the timeout constant, not a latency, and
+            # would dominate the report's p95/p99. The rpc event below
+            # carries the duration instead.
+            dt = time.perf_counter() - t0
+            errors.inc()
+            code = (
+                exc.code().name
+                if isinstance(exc, grpc.RpcError) and callable(
+                    getattr(exc, "code", None)
+                )
+                else type(exc).__name__
+            )
+            if code == "DEADLINE_EXCEEDED":
+                deadline_expired.inc()
+            metrics.log(
+                "rpc", service=service, method=method, seconds=dt,
+                ok=False, code=code, peer=peer,
+            )
+            raise
+        hist.observe(time.perf_counter() - t0)
+        bytes_recv.inc(response.ByteSize())
+        return response
 
     return call
 
@@ -81,13 +128,17 @@ class ServiceStub:
 
     Every call carries a default deadline (the reference's 120 s
     phase-transition timeout, ``server.py:237``); pass ``timeout=`` per call
-    to override."""
+    to override. ``metrics`` (a
+    :class:`~gfedntm_tpu.utils.observability.MetricsLogger`) turns on
+    per-call latency/byte instrumentation; ``peer`` labels error events."""
 
     def __init__(
         self,
         channel: grpc.Channel,
         service_name: str,
         default_timeout: float | None = 120.0,
+        metrics=None,
+        peer: str = "",
     ):
         for method, (req_cls, resp_cls) in SERVICES[service_name].items():
             setattr(
@@ -100,6 +151,10 @@ class ServiceStub:
                         response_deserializer=resp_cls.FromString,
                     ),
                     default_timeout,
+                    metrics=metrics,
+                    service=service_name,
+                    method=method,
+                    peer=peer,
                 ),
             )
 

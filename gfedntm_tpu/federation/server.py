@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -36,6 +37,7 @@ from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.registry import Federation
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.models.ctm import CTM
+from gfedntm_tpu.utils.observability import span
 
 
 def build_template_model(
@@ -60,6 +62,13 @@ class FederatedServer:
     Parameters mirror the reference CLI surface (``main.py:187-205``):
     ``min_clients`` (= --min_clients_federation), ``family`` + ``model_kwargs``
     (= --model_type + INI hyperparams), ``max_iters``.
+
+    ``metrics`` is an optional
+    :class:`~gfedntm_tpu.utils.observability.MetricsLogger`: each round then
+    emits nested ``round → {poll, average, push}`` spans (bytes moved,
+    slowest client), per-client poll-latency histograms and staleness
+    gauges, RPC/codec registry metrics, and a final ``metrics_snapshot``.
+    The logger is driven from poll/push worker threads — it is thread-safe.
     """
 
     def __init__(
@@ -89,6 +98,10 @@ class FederatedServer:
         # per-minibatch averaging; E>1 = FedAvg proper — the same knob as
         # FederatedTrainer.local_steps, carried to clients per StepRequest).
         self.local_steps = int(local_steps)
+
+        # Clients whose compile-dominated first poll has been seen (and
+        # excluded from the poll-latency/straggler stats).
+        self._poll_warmed: set[int] = set()
 
         self.federation = Federation(min_clients=min_clients)
         self.template: AVITM | None = None
@@ -179,9 +192,12 @@ class FederatedServer:
             hyperparams_json=json.dumps(hyper),
             init_variables=codec.tree_to_bundle(
                 {"params": self.template.params,
-                 "batch_stats": self.template.batch_stats}
+                 "batch_stats": self.template.batch_stats},
+                metrics=self.metrics,
             ),
-            init_opt_state=codec.tree_to_bundle(self.template.opt_state),
+            init_opt_state=codec.tree_to_bundle(
+                self.template.opt_state, metrics=self.metrics
+            ),
         )
 
     def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
@@ -230,10 +246,56 @@ class FederatedServer:
             if entry is not None:
                 entry[1].close()
             channel = rpc.make_channel(rec.address)
-            stub = rpc.ServiceStub(channel, "gfedntm.FederationClient")
+            stub = rpc.ServiceStub(
+                channel, "gfedntm.FederationClient",
+                metrics=self.metrics, peer=f"client{rec.client_id}",
+            )
             entry = (rec.address, channel, stub)
             stubs[rec.client_id] = entry
         return entry[2]
+
+    def _note_round_poll(self, round_sp, polled, replies) -> None:
+        """Straggler/staleness telemetry for one round's poll results:
+        per-client poll-latency histograms, slowest-client gauges (annotated
+        onto the round span too), per-client staleness-in-minibatches
+        gauges, and the round's pulled payload bytes."""
+        reg = self.metrics.registry
+        slowest_id, slowest_s = None, -1.0
+        for rec, reply, lat in polled:
+            if reply is None:
+                # A failed poll's latency is the deadline constant, not a
+                # straggler signal; the drop is already recorded via the
+                # rpc error event + mark_dropped.
+                continue
+            if rec.client_id not in self._poll_warmed:
+                # The client's first poll carries its jit trace+compile —
+                # already captured as a jit_compile event client-side; in
+                # the straggler stats it would just name whichever client
+                # compiled slowest.
+                self._poll_warmed.add(rec.client_id)
+                continue
+            reg.histogram("client_poll_s").observe(lat)
+            reg.histogram(f"client_poll_s/client{rec.client_id}").observe(lat)
+            if lat > slowest_s:
+                slowest_id, slowest_s = rec.client_id, lat
+        if slowest_id is not None:
+            reg.gauge("round_slowest_client_id").set(slowest_id)
+            reg.gauge("round_slowest_client_s").set(slowest_s)
+            round_sp.annotate(
+                slowest_client=slowest_id, slowest_s=slowest_s
+            )
+        if replies:
+            max_mb = max(reply.current_mb for _rec, reply in replies)
+            for rec, reply in replies:
+                reg.gauge(f"client_staleness_mb/client{rec.client_id}").set(
+                    max_mb - reply.current_mb
+                )
+            round_sp.annotate(
+                clients=len(replies),
+                bytes_pulled=sum(
+                    reply.shared.ByteSize() for _rec, reply in replies
+                ),
+            )
 
     def _run_training(self) -> None:
         try:
@@ -241,6 +303,11 @@ class FederatedServer:
         except Exception:  # pragma: no cover - defensive
             self.logger.exception("federated training loop failed")
         finally:
+            # Snapshot in the failure path too: a crashed run's metrics.jsonl
+            # must still carry its cumulative RPC/codec/step-time state —
+            # those are exactly the runs telemetry exists to debug.
+            if self.metrics is not None:
+                self.metrics.snapshot_registry(rounds=self.global_iterations)
             self._stopping.set()
             self.training_done.set()
 
@@ -252,86 +319,115 @@ class FederatedServer:
             self.federation.total_weight(),
         )
 
+        m = self.metrics
         for iteration in range(self.max_iters):
             active = self.federation.active_clients()
             if not active:
                 break
 
-            # 1. concurrent poll: one local step per client
-            def poll(rec):
-                addr = rec.address  # snapshot: rejoin may change it mid-RPC
-                try:
-                    stub = self._stub_for(stubs, rec)
-                    if stub is None:
-                        raise RuntimeError("client has no serving address")
-                    # Deadline scales with the round's local-step count:
-                    # the stub default (120 s) covers ONE minibatch + the
-                    # first-poll jit compile; an E-step round multiplies
-                    # the compute part (2 s/step allowance is ~10x the
-                    # observed CPU step time at test scale).
-                    return rec, stub.TrainStep(
-                        pb.StepRequest(
-                            global_iter=iteration,
-                            local_steps=self.local_steps,
-                        ),
-                        timeout=120.0 + 2.0 * self.local_steps,
-                    )
-                except Exception as exc:
-                    self.logger.warning(
-                        "dropping client %d after failed TrainStep: %s",
-                        rec.client_id, exc,
-                    )
-                    self.federation.mark_dropped(rec.client_id, addr)
-                    return rec, None
+            with span(m, "round", round=iteration) as round_sp:
+                # 1. concurrent poll: one local step per client. The round
+                # span is handed down explicitly — pool threads don't
+                # inherit the loop thread's contextvars.
+                def poll(rec):
+                    addr = rec.address  # snapshot: rejoin may change it mid-RPC
+                    t0 = time.perf_counter()
+                    try:
+                        stub = self._stub_for(stubs, rec)
+                        if stub is None:
+                            raise RuntimeError("client has no serving address")
+                        # Deadline scales with the round's local-step count:
+                        # the stub default (120 s) covers ONE minibatch + the
+                        # first-poll jit compile; an E-step round multiplies
+                        # the compute part (2 s/step allowance is ~10x the
+                        # observed CPU step time at test scale).
+                        reply = stub.TrainStep(
+                            pb.StepRequest(
+                                global_iter=iteration,
+                                local_steps=self.local_steps,
+                            ),
+                            timeout=120.0 + 2.0 * self.local_steps,
+                        )
+                        return rec, reply, time.perf_counter() - t0
+                    except Exception as exc:
+                        self.logger.warning(
+                            "dropping client %d after failed TrainStep: %s",
+                            rec.client_id, exc,
+                        )
+                        self.federation.mark_dropped(rec.client_id, addr)
+                        # A rejoin is a fresh process that must re-jit, so
+                        # its first poll is compile-dominated again.
+                        self._poll_warmed.discard(rec.client_id)
+                        return rec, None, time.perf_counter() - t0
 
-            replies = [
-                r for r in pool.map(poll, active) if r[1] is not None
-            ]
-            if not replies:
-                break
+                with span(m, "poll", parent=round_sp, clients=len(active)):
+                    polled = list(pool.map(poll, active))
+                replies = [
+                    (rec, reply) for rec, reply, _lat in polled
+                    if reply is not None
+                ]
+                if m is not None:
+                    self._note_round_poll(round_sp, polled, replies)
+                if not replies:
+                    break
 
-            # 2. sample-weighted average over the shared subset, weighted by
-            # each client's total corpus size (server.py:476-487). The
-            # denominator is THIS round's contributors — clients that
-            # finished early or were dropped must not dilute the average.
-            snapshots = [
-                (rec.nr_samples, codec.bundle_to_flatdict(reply.shared))
-                for rec, reply in replies
-            ]
-            round_weight = float(sum(w for w, _ in snapshots))
-            keys = snapshots[0][1].keys()
-            average = {
-                k: sum(w * s[k] for w, s in snapshots) / round_weight
-                for k in keys
-            }
-            self.last_average = average
-            agg = pb.Aggregate(shared=codec.flatdict_to_bundle(average))
+                # 2. sample-weighted average over the shared subset, weighted
+                # by each client's total corpus size (server.py:476-487). The
+                # denominator is THIS round's contributors — clients that
+                # finished early or were dropped must not dilute the average.
+                with span(m, "average", parent=round_sp):
+                    snapshots = [
+                        (rec.nr_samples,
+                         codec.bundle_to_flatdict(reply.shared, metrics=m))
+                        for rec, reply in replies
+                    ]
+                    round_weight = float(sum(w for w, _ in snapshots))
+                    keys = snapshots[0][1].keys()
+                    average = {
+                        k: sum(w * s[k] for w, s in snapshots) / round_weight
+                        for k in keys
+                    }
+                    self.last_average = average
+                    agg = pb.Aggregate(
+                        shared=codec.flatdict_to_bundle(average, metrics=m)
+                    )
 
-            # 3. concurrent push + progress bookkeeping
-            def push(item):
-                rec, reply = item
-                addr = rec.address
-                try:
-                    ack = stubs[rec.client_id][2].ApplyAggregate(agg)
-                    self.federation.update_progress(
-                        rec.client_id, reply.current_mb, reply.current_epoch,
-                        reply.loss, finished=ack.finished,
-                    )
-                except Exception as exc:
-                    self.logger.warning(
-                        "dropping client %d after failed ApplyAggregate: %s",
-                        rec.client_id, exc,
-                    )
-                    self.federation.update_progress(
-                        rec.client_id, reply.current_mb, reply.current_epoch,
-                        reply.loss, finished=False,
-                    )
-                    self.federation.mark_dropped(rec.client_id, addr)
+                # 3. concurrent push + progress bookkeeping
+                def push(item):
+                    rec, reply = item
+                    addr = rec.address
+                    try:
+                        ack = stubs[rec.client_id][2].ApplyAggregate(agg)
+                        self.federation.update_progress(
+                            rec.client_id, reply.current_mb,
+                            reply.current_epoch, reply.loss,
+                            finished=ack.finished,
+                        )
+                    except Exception as exc:
+                        self.logger.warning(
+                            "dropping client %d after failed ApplyAggregate: %s",
+                            rec.client_id, exc,
+                        )
+                        self.federation.update_progress(
+                            rec.client_id, reply.current_mb,
+                            reply.current_epoch, reply.loss, finished=False,
+                        )
+                        self.federation.mark_dropped(rec.client_id, addr)
+                        self._poll_warmed.discard(rec.client_id)
 
-            list(pool.map(push, replies))
+                with span(m, "push", parent=round_sp, clients=len(replies)):
+                    list(pool.map(push, replies))
+                if m is not None:
+                    round_sp.annotate(
+                        bytes_pushed=agg.ByteSize() * len(replies)
+                    )
             self.global_iterations = iteration + 1
-            if self.metrics is not None and iteration % 50 == 0:
-                self.metrics.log(
+            if m is not None and iteration % 50 == 0:
+                # Periodic snapshot alongside the progress event so even a
+                # SIGKILLed run keeps registry state no older than 50 rounds
+                # (summarize reads the LAST snapshot of each metric).
+                m.snapshot_registry(rounds=iteration + 1)
+                m.log(
                     "federated_iteration", iteration=iteration,
                     mean_loss=float(
                         np.mean([r.loss for _, r in replies])

@@ -27,6 +27,7 @@ from gfedntm_tpu.models.losses import (
     gaussian_kl,
 )
 from gfedntm_tpu.models.networks import DecoderNetwork
+from gfedntm_tpu.utils.observability import timed_jit
 
 
 def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
@@ -215,6 +216,8 @@ def build_train_epoch(
     family: str = "avitm",
     beta_weight: float = 1.0,
     vshard=None,
+    metrics=None,
+    label: str = "train_epoch",
 ):
     """Returns jitted ``(params, batch_stats, opt_state, data, indices, masks,
     rng) -> (params, batch_stats, opt_state, losses[S])``.
@@ -222,6 +225,10 @@ def build_train_epoch(
     ``data`` is a dict of device arrays ({'x_bow': [N,V], optional 'x_ctx',
     'labels'}); ``indices``/``masks`` are [S, B] (see
     ``data.datasets.make_epoch_schedule``).
+
+    ``metrics`` (an observability MetricsLogger) wraps the returned program
+    for compile capture: the first call is logged as a ``jit_compile``
+    event, later dispatch latencies feed ``jit_dispatch_s/<label>``.
     """
 
     def train_epoch(params, batch_stats, opt_state, data, indices, masks, rng):
@@ -248,7 +255,7 @@ def build_train_epoch(
         )
         return params, batch_stats, opt_state, losses
 
-    return jax.jit(train_epoch)
+    return timed_jit(jax.jit(train_epoch), metrics, label)
 
 
 def build_train_step(
@@ -256,6 +263,8 @@ def build_train_step(
     tx: optax.GradientTransformation,
     family: str = "avitm",
     beta_weight: float = 1.0,
+    metrics=None,
+    label: str = "train_step",
 ):
     """Jitted ONE-minibatch step: ``(params, batch_stats, opt_state, data,
     idx[B], mask[B], rng) -> (params, batch_stats, opt_state, loss)``.
@@ -263,7 +272,8 @@ def build_train_step(
     The externally-stepped federation protocol (``train_mb_delta``,
     ``federated_avitm.py:51-83``) drives this once per server poll; the
     whole-epoch ``lax.scan`` programs above stay the fast path for
-    single-program training."""
+    single-program training. ``metrics`` adds first-call compile capture
+    (see :func:`~gfedntm_tpu.utils.observability.timed_jit`)."""
 
     def train_step(params, batch_stats, opt_state, data, idx, mask, rng):
         rngs = {
@@ -276,11 +286,12 @@ def build_train_step(
             batch, mask, rngs,
         )
 
-    return jax.jit(train_step)
+    return timed_jit(jax.jit(train_step), metrics, label)
 
 
 def build_eval_epoch(
-    module: DecoderNetwork, family: str = "avitm", beta_weight: float = 1.0
+    module: DecoderNetwork, family: str = "avitm", beta_weight: float = 1.0,
+    metrics=None, label: str = "eval_epoch",
 ):
     """Jitted validation epoch: eval-mode forward (running BN stats, fresh
     reparam draws — ``avitm.py:295-319`` semantics), per-step summed losses."""
@@ -303,10 +314,11 @@ def build_eval_epoch(
         )
         return losses
 
-    return jax.jit(eval_epoch)
+    return timed_jit(jax.jit(eval_epoch), metrics, label)
 
 
-def build_infer_theta(module: DecoderNetwork, n_samples: int = 20):
+def build_infer_theta(module: DecoderNetwork, n_samples: int = 20,
+                      metrics=None, label: str = "infer_theta"):
     """Jitted MC doc-topic inference (``avitm.py:470-523``): average of
     ``n_samples`` reparameterized theta draws per document, batched via scan,
     samples via vmap (all MC passes share one data load — the reference
@@ -336,7 +348,7 @@ def build_infer_theta(module: DecoderNetwork, n_samples: int = 20):
         _, thetas = jax.lax.scan(body, None, (indices, jnp.arange(steps)))
         return thetas.reshape(-1, thetas.shape[-1])
 
-    return jax.jit(infer)
+    return timed_jit(jax.jit(infer), metrics, label)
 
 
 def init_variables(

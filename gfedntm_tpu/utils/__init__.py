@@ -1,6 +1,16 @@
 from gfedntm_tpu.utils import observability as observability
 from gfedntm_tpu.utils import serialization as serialization
-from gfedntm_tpu.utils.observability import MetricsLogger, phase_timer, trace
+from gfedntm_tpu.utils.observability import (
+    MetricRegistry,
+    MetricsLogger,
+    format_report,
+    phase_timer,
+    span,
+    summarize_metrics,
+    timed_jit,
+    trace,
+    validate_record,
+)
 from gfedntm_tpu.utils.serialization import (
     load_variables,
     save_model_as_npz,

@@ -1,55 +1,344 @@
-"""Structured metrics + profiling (first-class, unlike the reference).
+"""Federation-wide telemetry: structured events, spans, metrics, reports.
 
 The reference's telemetry is log-line based (per-minibatch loss strings,
 ``federated_avitm.py:109``) with a vestigial ``GRPC_TRACE`` constant and no
-profiler hooks (SURVEY.md §5). Here:
+profiler hooks (SURVEY.md §5). Here telemetry is a first-class subsystem —
+the substrate every perf/robustness PR reports against:
 
-- :class:`MetricsLogger` — structured JSONL event stream (one object per
-  line: step/epoch metrics, phase timings) plus an in-memory record, so
-  experiments and dashboards read one format.
-- :func:`phase_timer` — wall-clock timing of named phases (consensus,
-  compile, train, inference) pushed into the logger.
+- :class:`MetricsLogger` — thread-safe structured JSONL event stream (one
+  object per line), flushed eagerly so a crashed run keeps its telemetry.
+  Every logger carries a :class:`MetricRegistry` whose cumulative state
+  snapshots into the same stream (``metrics_snapshot`` events).
+- :func:`span` — hierarchical timing contexts (parent/child ids, monotonic
+  durations) so a run decomposes into round → client → {poll, average,
+  push, local_step}. Nesting is implicit within a thread (contextvars) and
+  explicit (``parent=``) across threads.
+- :class:`MetricRegistry` — counters, gauges, and fixed-bucket histograms
+  (step time, RPC latency, payload bytes) with percentile estimation.
+- :func:`validate_record` — schema lint for the event stream, so new events
+  can't silently drift from the documented schema (README "Telemetry").
+- :func:`summarize_metrics` / :func:`format_report` — the ``summarize`` CLI
+  subcommand's engine: phase breakdown, p50/p95/p99 step time, bytes moved
+  per round, slowest client.
+- :func:`phase_timer` — wall-phase timing (consensus, compile, train).
 - :func:`trace` — ``jax.profiler`` trace context for TPU timeline capture
   (view in TensorBoard / xprof).
+
+Every hook is a no-op when no logger is passed (``logger=None``), so
+un-instrumented hot paths pay nothing. Durations come from
+``time.perf_counter`` (monotonic — NTP steps cannot produce negative phase
+times); wall-clock ``time.time()`` appears only as the ``time`` event
+timestamp field.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import contextvars
+import itertools
 import json
 import os
+import threading
 import time
 from typing import Any, Iterator
 
+# ---- event schema -----------------------------------------------------------
+
+#: Required fields per event name, beyond the implicit ``event`` + ``time``.
+#: Extra fields are always allowed; MISSING required fields (or an event name
+#: absent from this table, under strict validation) are schema drift.
+EVENT_SCHEMAS: dict[str, frozenset[str]] = {
+    # timing
+    "phase": frozenset({"phase", "seconds"}),
+    "span": frozenset({"name", "span_id", "parent_id", "seconds"}),
+    "jit_compile": frozenset({"what", "seconds"}),
+    # registry state
+    "metrics_snapshot": frozenset({"metrics"}),
+    # RPC failures (successes aggregate into registry histograms only)
+    "rpc": frozenset({"service", "method", "seconds", "ok"}),
+    # training progress
+    "resume": frozenset({"step"}),
+    "epoch": frozenset({"epoch"}),
+    "federated_segment": frozenset({"step", "mean_loss"}),
+    "federated_iteration": frozenset({"iteration", "mean_loss"}),
+    "summary": frozenset(),
+    # bench stream (bench.py emits through the same logger/schema)
+    "bench_summary": frozenset({"backend"}),
+    "bench_result": frozenset({"metric", "value", "unit", "backend"}),
+}
+
+
+def validate_record(record: Any, strict: bool = True) -> dict[str, Any]:
+    """Schema-lint one event record; returns it unchanged or raises
+    ``ValueError``. ``strict=False`` lets unknown event names pass (their
+    ``event``/``time`` envelope is still checked)."""
+    if not isinstance(record, dict):
+        raise ValueError(f"record must be a dict, got {type(record).__name__}")
+    event = record.get("event")
+    if not isinstance(event, str) or not event:
+        raise ValueError(f"record needs a non-empty 'event' str: {record!r}")
+    if not isinstance(record.get("time"), (int, float)):
+        raise ValueError(f"record {event!r} needs a numeric 'time' field")
+    required = EVENT_SCHEMAS.get(event)
+    if required is None:
+        if strict:
+            raise ValueError(
+                f"unknown event {event!r}: register it in "
+                "observability.EVENT_SCHEMAS (and README 'Telemetry')"
+            )
+        return record
+    missing = required - record.keys()
+    if missing:
+        raise ValueError(
+            f"event {event!r} missing required fields {sorted(missing)}"
+        )
+    return record
+
+
+# ---- metric registry --------------------------------------------------------
+
+#: Exponential-ish latency edges, 100 µs .. 5 min (upper-inclusive buckets).
+DEFAULT_TIME_BUCKETS_S: tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+#: Payload-size edges, 256 B .. 256 MB (the gRPC message cap).
+DEFAULT_BYTE_BUCKETS: tuple[float, ...] = tuple(
+    256.0 * 4.0 ** i for i in range(11)
+)
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-value-wins gauge."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float | None = None
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram with upper-inclusive edges.
+
+    ``counts[i]`` counts observations ``v <= edges[i]`` (first matching
+    bucket); ``counts[-1]`` is the overflow bucket. Percentiles are
+    estimated by linear interpolation inside the selected bucket, clamped
+    to the observed [min, max] — exact at the tracked extremes, bucket-
+    resolution elsewhere.
+    """
+
+    __slots__ = ("name", "edges", "counts", "count", "sum", "min", "max",
+                 "_lock")
+
+    def __init__(self, name: str, buckets: tuple[float, ...] | None = None):
+        self.name = name
+        self.edges = tuple(sorted(buckets or DEFAULT_TIME_BUCKETS_S))
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.edges, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            if not self.count:
+                return {
+                    "type": "histogram", "count": 0, "sum": 0.0,
+                    "edges": list(self.edges), "counts": list(self.counts),
+                }
+            return {
+                "type": "histogram",
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "edges": list(self.edges),
+                "counts": list(self.counts),
+            }
+
+    def quantile(self, q: float) -> float | None:
+        return quantile_from_snapshot(self.snapshot(), q)
+
+
+def quantile_from_snapshot(snap: dict[str, Any], q: float) -> float | None:
+    """Estimate the ``q``-quantile (0..1) from a histogram snapshot dict
+    (the serialized form inside ``metrics_snapshot`` events)."""
+    n = snap.get("count", 0)
+    if not n:
+        return None
+    edges, counts = snap["edges"], snap["counts"]
+    lo_all, hi_all = snap["min"], snap["max"]
+    target = max(q, 0.0) * n
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c and cum + c >= target:
+            lo = lo_all if i == 0 else edges[i - 1]
+            hi = edges[i] if i < len(edges) else hi_all
+            lo = min(max(lo, lo_all), hi_all)
+            hi = max(min(hi, hi_all), lo)
+            frac = (target - cum) / c
+            return lo + frac * (hi - lo)
+        cum += c
+    return hi_all
+
+
+class MetricRegistry:
+    """Get-or-create store of named counters/gauges/histograms; thread-safe.
+
+    The first creation fixes a histogram's buckets; later ``histogram``
+    calls for the same name return the existing instance (their ``buckets``
+    argument is ignored).
+    """
+
+    def __init__(self):
+        self._metrics: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(
+        self, name: str, buckets: tuple[float, ...] | None = None
+    ) -> Histogram:
+        return self._get(name, Histogram, buckets)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in sorted(metrics)}
+
+
+# ---- structured event log ---------------------------------------------------
 
 class MetricsLogger:
     """Append-only structured metrics. ``path=None`` keeps records in memory
     only (tests); otherwise each event is one JSON line, flushed eagerly so
-    a crashed run keeps its telemetry."""
+    a crashed run keeps its telemetry.
 
-    def __init__(self, path: str | None = None):
+    Thread-safe: the federation server's training loop drives one logger
+    from many poll/push worker threads, and interleaved JSONL lines would
+    corrupt the stream. ``validate=True`` schema-lints every record at log
+    time (tests; see :func:`validate_record`).
+    """
+
+    def __init__(self, path: str | None = None, validate: bool = False,
+                 mode: str = "a", keep_records: bool | None = None):
         self.path = path
+        self.validate = validate
+        # In-memory retention is for in-process consumers (.events(), tests,
+        # bench phase accounting). Default: retain only when there is no
+        # file — a long path-backed server run would otherwise accumulate
+        # every round's span events for the process lifetime.
+        self.keep_records = (
+            path is None if keep_records is None else bool(keep_records)
+        )
         self.records: list[dict[str, Any]] = []
+        self.registry = MetricRegistry()
+        self._lock = threading.Lock()
         self._fh = None
         if path is not None:
+            if mode not in ("a", "w"):
+                raise ValueError(f"mode must be 'a' or 'w', got {mode!r}")
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._fh = open(path, "a")
+            self._fh = open(path, mode)
 
     def log(self, event: str, **fields: Any) -> dict[str, Any]:
         record = {"event": event, "time": time.time(), **fields}
-        self.records.append(record)
-        if self._fh is not None:
-            self._fh.write(json.dumps(record, default=float) + "\n")
-            self._fh.flush()
+        if self.validate:
+            validate_record(record)
+        # Serialize outside the lock; append + write inside it so lines
+        # never interleave and records keeps file order.
+        line = (
+            json.dumps(record, default=float) if self.path is not None
+            else None
+        )
+        with self._lock:
+            if self.keep_records:
+                self.records.append(record)
+            if self._fh is not None and line is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
         return record
 
     def events(self, event: str) -> list[dict[str, Any]]:
+        if not self.keep_records:
+            raise RuntimeError(
+                "events() needs in-memory retention: construct with "
+                "keep_records=True (or path=None), or read the JSONL file "
+                "via read_metrics()"
+            )
         return [r for r in self.records if r["event"] == event]
 
+    def snapshot_registry(self, **fields: Any) -> dict[str, Any] | None:
+        """Dump the registry's cumulative state into the event stream."""
+        snap = self.registry.snapshot()
+        if not snap:
+            return None
+        return self.log("metrics_snapshot", metrics=snap, **fields)
+
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self) -> "MetricsLogger":
         return self
@@ -57,6 +346,122 @@ class MetricsLogger:
     def __exit__(self, *exc) -> None:
         self.close()
 
+
+# ---- hierarchical spans -----------------------------------------------------
+
+_SPAN_IDS = itertools.count(1)
+_CURRENT_SPAN: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "gfedntm_current_span", default=None
+)
+
+
+class Span:
+    """One timed region of a run. Logs a ``span`` event on exit with its
+    monotonic duration, id, parent id, and any annotated attributes.
+
+    Within a thread, nesting is implicit (contextvars). Work handed to a
+    pool thread does NOT inherit the submitting thread's context — pass the
+    enclosing span explicitly: ``span(logger, "poll", parent=round_span)``.
+    """
+
+    __slots__ = ("logger", "name", "fields", "span_id", "parent_id",
+                 "_parent", "_token", "_t0")
+
+    def __init__(self, logger: MetricsLogger, name: str, parent: Any,
+                 fields: dict[str, Any]):
+        self.logger = logger
+        self.name = name
+        self.fields = dict(fields)
+        self.span_id = next(_SPAN_IDS)
+        self.parent_id: int | None = None
+        self._parent = parent
+        self._token = None
+        self._t0 = 0.0
+
+    def annotate(self, **fields: Any) -> "Span":
+        """Attach attributes that become fields of the logged span event."""
+        self.fields.update(fields)
+        return self
+
+    def __enter__(self) -> "Span":
+        if self._parent is not None:
+            self.parent_id = getattr(self._parent, "span_id", self._parent)
+        else:
+            cur = _CURRENT_SPAN.get()
+            self.parent_id = cur.span_id if cur is not None else None
+        self._token = _CURRENT_SPAN.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        seconds = time.perf_counter() - self._t0
+        _CURRENT_SPAN.reset(self._token)
+        self.logger.log(
+            "span", name=self.name, span_id=self.span_id,
+            parent_id=self.parent_id, seconds=seconds,
+            ok=exc_type is None, **self.fields,
+        )
+
+
+class _NullSpan:
+    """No-op span returned for ``logger=None`` call sites (zero overhead)."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+
+    def annotate(self, **fields: Any) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(logger: MetricsLogger | None, name: str, parent: Any = None,
+         **fields: Any):
+    """Hierarchical timing context; a no-op when ``logger`` is None."""
+    if logger is None:
+        return _NULL_SPAN
+    return Span(logger, name, parent, fields)
+
+
+# ---- jit wrappers -----------------------------------------------------------
+
+def timed_jit(fn, logger: MetricsLogger | None, what: str):
+    """Wrap a jitted callable for compile-time capture: the FIRST call
+    (trace + compile dominated) is logged as a ``jit_compile`` event; later
+    calls feed the ``jit_dispatch_s/<what>`` histogram. Note that jax's
+    async dispatch means post-compile durations measure dispatch, not device
+    execution, and a later re-specialization (new shapes) is not separated
+    out. Passthrough when ``logger`` is None."""
+    if logger is None:
+        return fn
+    hist = logger.registry.histogram(f"jit_dispatch_s/{what}")
+    state = {"first": True}
+    lock = threading.Lock()
+
+    def wrapper(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        with lock:
+            first, state["first"] = state["first"], False
+        if first:
+            logger.log("jit_compile", what=what, seconds=dt)
+        else:
+            hist.observe(dt)
+        return out
+
+    return wrapper
+
+
+# ---- phase timing + profiler ------------------------------------------------
 
 @contextlib.contextmanager
 def phase_timer(
@@ -83,3 +488,278 @@ def trace(log_dir: str | None) -> Iterator[None]:
 
     with jax.profiler.trace(log_dir):
         yield
+
+
+# ---- run summaries (the `summarize` CLI subcommand's engine) ----------------
+
+def read_metrics(path: str) -> list[dict[str, Any]]:
+    """Parse a ``metrics.jsonl`` file; blank lines are skipped, malformed
+    lines raise (a corrupt stream should be loud, not silently partial)."""
+    records = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as err:
+                raise ValueError(f"{path}:{lineno}: bad JSONL line: {err}")
+    return records
+
+
+def _agg(groups: dict, key: str, seconds: float) -> None:
+    g = groups.setdefault(key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+    g["count"] += 1
+    g["total_s"] += seconds
+    g["max_s"] = max(g["max_s"], seconds)
+
+
+def _hist_stats(snap: dict[str, Any]) -> dict[str, Any]:
+    count = snap.get("count", 0)
+    out: dict[str, Any] = {"count": count}
+    if count:
+        out["mean_s"] = snap["sum"] / count
+        for q, label in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
+            out[label] = quantile_from_snapshot(snap, q)
+        out["min_s"], out["max_s"] = snap["min"], snap["max"]
+    return out
+
+
+def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
+    """Aggregate a run's event stream into a report dict (see
+    :func:`format_report` for the rendered form)."""
+    times = [r["time"] for r in records
+             if isinstance(r.get("time"), (int, float))]
+    event_counts: dict[str, int] = {}
+    phases: dict[str, dict] = {}
+    spans: dict[str, dict] = {}
+    rounds = {"count": 0, "total_s": 0.0, "bytes_pulled": 0.0,
+              "bytes_pushed": 0.0}
+    slowest: dict[Any, dict] = {}
+    compile_events: list[dict[str, Any]] = []
+    rpc_errors: list[dict[str, Any]] = []
+    last_snapshots: dict[str, dict] = {}
+    summary_event: dict[str, Any] | None = None
+
+    for r in records:
+        event = r.get("event", "?")
+        event_counts[event] = event_counts.get(event, 0) + 1
+        if event == "phase":
+            _agg(phases, str(r.get("phase", "?")), float(r.get("seconds", 0)))
+        elif event == "span":
+            name = str(r.get("name", "?"))
+            secs = float(r.get("seconds", 0))
+            _agg(spans, name, secs)
+            if name == "round":
+                rounds["count"] += 1
+                rounds["total_s"] += secs
+                rounds["bytes_pulled"] += float(r.get("bytes_pulled", 0))
+                rounds["bytes_pushed"] += float(r.get("bytes_pushed", 0))
+                cid = r.get("slowest_client")
+                if cid is not None:
+                    s = slowest.setdefault(
+                        cid, {"rounds_slowest": 0, "max_poll_s": 0.0}
+                    )
+                    s["rounds_slowest"] += 1
+                    s["max_poll_s"] = max(
+                        s["max_poll_s"], float(r.get("slowest_s", 0))
+                    )
+        elif event == "jit_compile":
+            compile_events.append(
+                {"what": r.get("what"), "seconds": r.get("seconds")}
+            )
+        elif event == "rpc" and not r.get("ok", True):
+            rpc_errors.append(r)
+        elif event == "metrics_snapshot":
+            # Registries are cumulative, so the LAST snapshot mentioning a
+            # metric carries its totals.
+            for name, snap in (r.get("metrics") or {}).items():
+                last_snapshots[name] = snap
+        elif event == "summary":
+            summary_event = {
+                k: v for k, v in r.items() if k not in ("event", "time")
+            }
+
+    step_time = {
+        name: _hist_stats(snap)
+        for name, snap in last_snapshots.items()
+        if snap.get("type") == "histogram" and name.endswith("step_s")
+        and snap.get("count")
+    }
+    rpc = {
+        name.split("/", 1)[1]: _hist_stats(snap)
+        for name, snap in last_snapshots.items()
+        if name.startswith("rpc_s/") and snap.get("count")
+    }
+    # Every other populated histogram (codec encode/decode seconds, bundle
+    # bytes, client poll latency, jit dispatch, ...): no histogram this
+    # stream records may be write-only in the summary.
+    other_hists = {
+        name: _hist_stats(snap)
+        for name, snap in last_snapshots.items()
+        if snap.get("type") == "histogram" and snap.get("count")
+        and not (name.endswith("step_s") or name.startswith("rpc_s/"))
+    }
+    counters = {
+        name: snap["value"] for name, snap in last_snapshots.items()
+        if snap.get("type") == "counter"
+    }
+    gauges = {
+        name: snap["value"] for name, snap in last_snapshots.items()
+        if snap.get("type") == "gauge"
+    }
+
+    return {
+        "events_total": len(records),
+        "wall_seconds": (max(times) - min(times)) if times else 0.0,
+        "event_counts": dict(sorted(event_counts.items())),
+        "phases": phases,
+        "spans": spans,
+        "rounds": rounds,
+        "slowest_clients": slowest,
+        "step_time": step_time,
+        "rpc": rpc,
+        "histograms": other_hists,
+        "rpc_errors": len(rpc_errors),
+        "counters": counters,
+        "gauges": gauges,
+        "compile": compile_events,
+        "summary": summary_event,
+    }
+
+
+def _fmt_s(seconds: float | None) -> str:
+    if seconds is None:
+        return "-"
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.0f} µs"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.2f} ms"
+    return f"{seconds:.2f} s"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def format_report(s: dict[str, Any]) -> str:
+    """Render a :func:`summarize_metrics` dict as a human-readable report."""
+    lines = [
+        f"run summary: {s['events_total']} events over "
+        f"{s['wall_seconds']:.2f} s wall clock",
+    ]
+
+    wall = s["wall_seconds"] or float("inf")
+    breakdown = dict(s["phases"])
+    for name, g in s["spans"].items():
+        breakdown.setdefault(f"span:{name}", g)
+    if breakdown:
+        lines.append("")
+        lines.append("phase breakdown:")
+        lines.append(f"  {'phase':<24}{'total':>12}{'count':>8}{'%wall':>8}")
+        for name, g in sorted(
+            breakdown.items(), key=lambda kv: -kv[1]["total_s"]
+        ):
+            pct = 100.0 * g["total_s"] / wall if wall else 0.0
+            lines.append(
+                f"  {name:<24}{_fmt_s(g['total_s']):>12}{g['count']:>8}"
+                f"{pct:>7.1f}%"
+            )
+
+    if s["step_time"]:
+        lines.append("")
+        lines.append("step time:")
+        lines.append(
+            f"  {'source':<24}{'count':>8}{'mean':>12}{'p50':>12}"
+            f"{'p95':>12}{'p99':>12}"
+        )
+        for name, st in sorted(s["step_time"].items()):
+            lines.append(
+                f"  {name:<24}{st['count']:>8}{_fmt_s(st['mean_s']):>12}"
+                f"{_fmt_s(st['p50_s']):>12}{_fmt_s(st['p95_s']):>12}"
+                f"{_fmt_s(st['p99_s']):>12}"
+            )
+
+    if s["rpc"]:
+        lines.append("")
+        lines.append("rpc latency:")
+        lines.append(
+            f"  {'method':<32}{'count':>8}{'mean':>12}{'p50':>12}{'p95':>12}"
+        )
+        for name, st in sorted(s["rpc"].items()):
+            lines.append(
+                f"  {name:<32}{st['count']:>8}{_fmt_s(st['mean_s']):>12}"
+                f"{_fmt_s(st['p50_s']):>12}{_fmt_s(st['p95_s']):>12}"
+            )
+        deadline = s["counters"].get("rpc_deadline_expired", 0)
+        errors = s["counters"].get("rpc_errors", 0)
+        lines.append(
+            f"  errors: {errors:.0f} ({deadline:.0f} deadline expiries), "
+            f"rpc error events: {s['rpc_errors']}"
+        )
+
+    if s.get("histograms"):
+        lines.append("")
+        lines.append("other distributions (codec, poll, dispatch, ...):")
+        lines.append(
+            f"  {'name':<32}{'count':>8}{'mean':>12}{'p50':>12}{'p95':>12}"
+        )
+        for name, st in sorted(s["histograms"].items()):
+            fmt = _fmt_bytes if "bytes" in name else _fmt_s
+            lines.append(
+                f"  {name:<32}{st['count']:>8}{fmt(st['mean_s']):>12}"
+                f"{fmt(st['p50_s']):>12}{fmt(st['p95_s']):>12}"
+            )
+
+    rounds = s["rounds"]
+    if rounds["count"]:
+        per = rounds["count"]
+        lines.append("")
+        lines.append(
+            f"federation rounds: {per} "
+            f"(mean {_fmt_s(rounds['total_s'] / per)}/round)"
+        )
+        lines.append(
+            f"  bytes moved: {_fmt_bytes(rounds['bytes_pulled'])} pulled, "
+            f"{_fmt_bytes(rounds['bytes_pushed'])} pushed "
+            f"({_fmt_bytes((rounds['bytes_pulled'] + rounds['bytes_pushed']) / per)}"
+            "/round)"
+        )
+        if s["slowest_clients"]:
+            worst = max(
+                s["slowest_clients"].items(),
+                key=lambda kv: kv[1]["rounds_slowest"],
+            )
+            lines.append(
+                f"  slowest client: {worst[0]} (straggler in "
+                f"{worst[1]['rounds_slowest']}/{per} rounds, max poll "
+                f"{_fmt_s(worst[1]['max_poll_s'])})"
+            )
+
+    enc = s["counters"].get("codec_encoded_bytes")
+    dec = s["counters"].get("codec_decoded_bytes")
+    if enc is not None or dec is not None:
+        lines.append("")
+        lines.append(
+            f"codec: {_fmt_bytes(enc or 0)} encoded "
+            f"({s['counters'].get('codec_encode_calls', 0):.0f} bundles), "
+            f"{_fmt_bytes(dec or 0)} decoded "
+            f"({s['counters'].get('codec_decode_calls', 0):.0f} bundles)"
+        )
+
+    if s["compile"]:
+        lines.append("")
+        lines.append("compile capture (first-call trace+compile+run):")
+        for c in s["compile"]:
+            lines.append(f"  {c['what']}: {_fmt_s(c['seconds'])}")
+
+    if s["summary"]:
+        lines.append("")
+        lines.append(f"run result: {json.dumps(s['summary'], default=str)}")
+
+    return "\n".join(lines)

@@ -1,0 +1,478 @@
+"""Telemetry subsystem tests (tier-1): span nesting/ids, histogram bucket
+edges + quantile estimation, thread-safe concurrent logging, schema lint,
+`summarize` on a golden metrics.jsonl, and a 2-client in-process federated
+smoke run asserting the full event set (round spans, RPC latency, codec
+bytes, step-time histograms) renders through the CLI report."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.utils.observability import (
+    DEFAULT_BYTE_BUCKETS,
+    Histogram,
+    MetricRegistry,
+    MetricsLogger,
+    format_report,
+    quantile_from_snapshot,
+    read_metrics,
+    span,
+    summarize_metrics,
+    timed_jit,
+    validate_record,
+)
+
+
+# ---- spans -----------------------------------------------------------------
+
+class TestSpans:
+    def test_nesting_ids_and_order(self):
+        log = MetricsLogger(validate=True)
+        with span(log, "round", round=3) as outer:
+            with span(log, "poll") as inner:
+                pass
+        assert outer.parent_id is None
+        assert inner.parent_id == outer.span_id
+        assert inner.span_id != outer.span_id
+        # children exit (and log) before their parent
+        events = log.events("span")
+        assert [e["name"] for e in events] == ["poll", "round"]
+        assert events[1]["round"] == 3
+        assert all(e["seconds"] >= 0 for e in events)
+
+    def test_sibling_spans_share_parent(self):
+        log = MetricsLogger()
+        with span(log, "round") as r:
+            with span(log, "poll") as a:
+                pass
+            with span(log, "push") as b:
+                pass
+        assert a.parent_id == r.span_id and b.parent_id == r.span_id
+
+    def test_explicit_parent_across_threads(self):
+        """Pool threads don't inherit contextvars; parent= carries the
+        hierarchy across the boundary (the server's poll/push workers)."""
+        log = MetricsLogger()
+        seen = {}
+
+        with span(log, "round") as r:
+            def worker():
+                with span(log, "poll", parent=r) as p:
+                    seen["parent"] = p.parent_id
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["parent"] == r.span_id
+
+    def test_annotate_and_failure_flag(self):
+        log = MetricsLogger()
+        with pytest.raises(RuntimeError):
+            with span(log, "round") as r:
+                r.annotate(clients=2)
+                raise RuntimeError("boom")
+        (ev,) = log.events("span")
+        assert ev["clients"] == 2 and ev["ok"] is False
+
+    def test_null_span_without_logger(self):
+        s = span(None, "anything")
+        with s as inner:
+            inner.annotate(a=1)
+        assert inner.span_id is None and inner.parent_id is None
+
+
+# ---- metric registry --------------------------------------------------------
+
+class TestHistogram:
+    def test_bucket_edges_are_upper_inclusive(self):
+        h = Histogram("t", buckets=(1.0, 2.0, 4.0))
+        for v in (1.0, 1.5, 2.0, 4.0, 4.0001, 100.0):
+            h.observe(v)
+        snap = h.snapshot()
+        # v <= edge lands in that bucket; beyond the last edge overflows
+        assert snap["counts"] == [1, 2, 1, 2]
+        assert snap["count"] == 6
+        assert snap["min"] == 1.0 and snap["max"] == 100.0
+
+    def test_quantiles_from_uniform_observations(self):
+        h = Histogram("t")  # default time buckets
+        for ms in range(1, 101):  # 1..100 ms uniform
+            h.observe(ms / 1000.0)
+        p50, p95 = h.quantile(0.5), h.quantile(0.95)
+        assert 0.025 <= p50 <= 0.075
+        assert 0.080 <= p95 <= 0.100
+        assert h.quantile(0.99) <= 0.100  # clamped to observed max
+
+    def test_quantile_from_serialized_snapshot(self):
+        h = Histogram("bytes", buckets=DEFAULT_BYTE_BUCKETS)
+        for _ in range(10):
+            h.observe(2048)
+        snap = json.loads(json.dumps(h.snapshot()))  # JSONL round-trip
+        assert quantile_from_snapshot(snap, 0.5) == pytest.approx(2048)
+        assert quantile_from_snapshot({"count": 0}, 0.5) is None
+
+    def test_registry_get_or_create_and_type_guard(self):
+        reg = MetricRegistry()
+        c = reg.counter("n")
+        c.inc()
+        c.inc(2.5)
+        assert reg.counter("n") is c and c.value == 3.5
+        reg.gauge("g").set(7)
+        assert reg.gauge("g").value == 7.0
+        with pytest.raises(TypeError):
+            reg.histogram("n")
+        snap = reg.snapshot()
+        assert snap["n"] == {"type": "counter", "value": 3.5}
+        assert snap["g"] == {"type": "gauge", "value": 7.0}
+
+
+# ---- logger: thread safety + schema ----------------------------------------
+
+class TestLogger:
+    def test_concurrent_logging_keeps_stream_intact(self, tmp_path):
+        """Interleaved writes from worker threads (the federation server's
+        poll/push pool) must produce one valid JSON object per line."""
+        path = str(tmp_path / "metrics.jsonl")
+        n_threads, n_each = 8, 200
+        with MetricsLogger(path, keep_records=True) as log:
+            def work(tid):
+                for i in range(n_each):
+                    log.log("epoch", epoch=i, thread=tid)
+
+            threads = [
+                threading.Thread(target=work, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(log.records) == n_threads * n_each
+        records = read_metrics(path)  # raises on any corrupt line
+        assert len(records) == n_threads * n_each
+        for r in records:
+            validate_record(r)
+
+    def test_path_backed_logger_skips_retention_by_default(self, tmp_path):
+        """A long path-backed run must not accumulate every event in memory;
+        in-process consumers opt in via keep_records=True (or path=None)."""
+        path = str(tmp_path / "m.jsonl")
+        with MetricsLogger(path) as log:
+            log.log("epoch", epoch=0)
+            assert log.records == []
+            with pytest.raises(RuntimeError, match="keep_records"):
+                log.events("epoch")
+        assert len(read_metrics(path)) == 1
+        mem = MetricsLogger()
+        mem.log("epoch", epoch=1)
+        assert mem.events("epoch")[0]["epoch"] == 1
+
+    def test_validate_record_schema_lint(self):
+        validate_record({"event": "phase", "time": 1.0, "phase": "x",
+                         "seconds": 0.5})
+        with pytest.raises(ValueError, match="missing required"):
+            validate_record({"event": "phase", "time": 1.0, "phase": "x"})
+        with pytest.raises(ValueError, match="unknown event"):
+            validate_record({"event": "not_a_real_event", "time": 1.0})
+        # envelope is still checked under non-strict
+        validate_record({"event": "future_event", "time": 1.0}, strict=False)
+        with pytest.raises(ValueError):
+            validate_record({"event": "", "time": 1.0}, strict=False)
+        with pytest.raises(ValueError):
+            validate_record({"event": "phase", "phase": "x", "seconds": 1.0})
+
+    def test_validating_logger_rejects_drift(self):
+        log = MetricsLogger(validate=True)
+        with pytest.raises(ValueError):
+            log.log("unregistered_event", x=1)
+
+    def test_timed_jit_compile_capture(self):
+        log = MetricsLogger()
+        calls = []
+        fn = timed_jit(lambda x: calls.append(x) or x * 2, log, "train_step")
+        assert fn(3) == 6 and fn(4) == 8 and fn(5) == 10
+        (compile_ev,) = log.events("jit_compile")
+        assert compile_ev["what"] == "train_step"
+        hist = log.registry.histogram("jit_dispatch_s/train_step")
+        assert hist.count == 2  # first call went to jit_compile instead
+
+    def test_timed_jit_noop_without_logger(self):
+        fn = lambda x: x  # noqa: E731
+        assert timed_jit(fn, None, "x") is fn
+
+
+# ---- summarize on a golden stream ------------------------------------------
+
+def _golden_records():
+    """A deterministic miniature run: 2 rounds of a 2-client federation
+    plus a registry snapshot — the documented event set."""
+    h_edges = [0.001, 0.01, 0.1, 1.0]
+    rec = []
+    t = 1_700_000_000.0
+
+    def ev(event, **fields):
+        nonlocal t
+        t += 0.25
+        rec.append({"event": event, "time": t, **fields})
+
+    ev("phase", phase="consensus", seconds=0.5)
+    ev("jit_compile", what="train_step", seconds=2.0)
+    sid = 0
+    for rnd in range(2):
+        base = sid
+        ev("span", name="poll", span_id=base + 2, parent_id=base + 1,
+           seconds=0.08, ok=True, clients=2)
+        ev("span", name="average", span_id=base + 3, parent_id=base + 1,
+           seconds=0.01, ok=True)
+        ev("span", name="push", span_id=base + 4, parent_id=base + 1,
+           seconds=0.04, ok=True, clients=2)
+        ev("span", name="round", span_id=base + 1, parent_id=None,
+           seconds=0.2, ok=True, round=rnd, clients=2,
+           bytes_pulled=4096, bytes_pushed=2048,
+           slowest_client=2, slowest_s=0.07)
+        sid += 4
+    ev("rpc", service="gfedntm.FederationClient", method="TrainStep",
+       seconds=0.5, ok=False, code="DEADLINE_EXCEEDED", peer="client1")
+    ev("metrics_snapshot", metrics={
+        "stepper_step_s": {
+            "type": "histogram", "count": 100, "sum": 5.0,
+            "min": 0.02, "max": 0.4, "edges": h_edges,
+            "counts": [0, 0, 90, 10],
+        },
+        "rpc_s/FederationClient.TrainStep": {
+            "type": "histogram", "count": 4, "sum": 0.2,
+            "min": 0.03, "max": 0.09, "edges": h_edges,
+            "counts": [0, 0, 4, 0],
+        },
+        "rpc_deadline_expired": {"type": "counter", "value": 1},
+        "rpc_errors": {"type": "counter", "value": 1},
+        "codec_encoded_bytes": {"type": "counter", "value": 8192},
+        "codec_decoded_bytes": {"type": "counter", "value": 4096},
+        "codec_encode_calls": {"type": "counter", "value": 4},
+        "codec_decode_calls": {"type": "counter", "value": 4},
+    })
+    ev("summary", n_clients=2, final_mean_loss=12.5)
+    return rec
+
+
+class TestSummarize:
+    def test_golden_records_validate(self):
+        for r in _golden_records():
+            validate_record(r)
+
+    def test_summary_aggregates(self):
+        s = summarize_metrics(_golden_records())
+        assert s["rounds"]["count"] == 2
+        assert s["rounds"]["bytes_pulled"] == 8192
+        assert s["rounds"]["bytes_pushed"] == 4096
+        assert s["slowest_clients"][2]["rounds_slowest"] == 2
+        assert s["phases"]["consensus"]["total_s"] == 0.5
+        assert s["spans"]["poll"]["count"] == 2
+        st = s["step_time"]["stepper_step_s"]
+        assert st["count"] == 100
+        assert 0.01 < st["p50_s"] <= 0.1
+        assert st["p99_s"] <= 0.4
+        assert s["rpc"]["FederationClient.TrainStep"]["count"] == 4
+        assert s["rpc_errors"] == 1
+        assert s["counters"]["rpc_deadline_expired"] == 1
+        assert s["compile"] == [{"what": "train_step", "seconds": 2.0}]
+        assert s["summary"]["final_mean_loss"] == 12.5
+
+    def test_cli_summarize_renders_report(self, tmp_path, capsys):
+        from gfedntm_tpu.cli import main
+
+        path = tmp_path / "metrics.jsonl"
+        with path.open("w") as fh:
+            for r in _golden_records():
+                fh.write(json.dumps(r) + "\n")
+        json_out = tmp_path / "summary.json"
+        rc = main(["summarize", str(path), "--json", str(json_out)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "phase breakdown" in out
+        assert "p95" in out and "p99" in out
+        assert "stepper_step_s" in out
+        assert "federation rounds: 2" in out
+        assert "slowest client: 2" in out
+        assert "deadline expiries" in out
+        assert "encoded" in out
+        loaded = json.loads(json_out.read_text())
+        assert loaded["rounds"]["count"] == 2
+
+    def test_cli_summarize_missing_file(self):
+        from gfedntm_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="no such metrics file"):
+            main(["summarize", "/nonexistent/metrics.jsonl"])
+
+    def test_read_metrics_rejects_corrupt_line(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        path.write_text('{"event": "phase"}\n{not json\n')
+        with pytest.raises(ValueError, match="bad JSONL"):
+            read_metrics(str(path))
+
+
+# ---- end-to-end: instrumented 2-client federated round ----------------------
+
+def _tiny_corpora(n_clients=2, docs=10, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(40)]
+    from gfedntm_tpu.data.loaders import RawCorpus
+
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+class TestFederatedSmokeTelemetry:
+    def test_two_client_round_emits_expected_event_set(self, tmp_path):
+        """An in-process 2-client federation writes one metrics.jsonl with
+        round-scoped spans, RPC latency + codec byte registry state, and
+        step-time histogram snapshots — and `summarize` renders it."""
+        from gfedntm_tpu.cli import main as cli_main
+        from gfedntm_tpu.federation.client import Client
+        from gfedntm_tpu.federation.server import FederatedServer
+
+        path = str(tmp_path / "metrics.jsonl")
+        # ONE logger shared by the server and both in-process clients:
+        # exactly the concurrent multi-writer regime it must survive.
+        metrics = MetricsLogger(path, validate=True)
+        model_kwargs = dict(
+            n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=1,
+            seed=0,
+        )
+        server = FederatedServer(
+            min_clients=2, family="avitm", model_kwargs=model_kwargs,
+            max_iters=50, save_dir=str(tmp_path / "server"),
+            metrics=metrics,
+        )
+        addr = server.start("[::]:0")
+        clients = [
+            Client(
+                client_id=c + 1, corpus=corpus, server_address=addr,
+                max_features=40, save_dir=str(tmp_path / f"client{c + 1}"),
+                metrics=metrics,
+            )
+            for c, corpus in enumerate(_tiny_corpora())
+        ]
+        threads = [
+            threading.Thread(target=c.run, daemon=True) for c in clients
+        ]
+        for t in threads:
+            t.start()
+        assert server.wait_done(timeout=300.0)
+        for t in threads:
+            t.join(timeout=60.0)
+        for c in clients:
+            c.shutdown()
+        server.stop()
+        metrics.close()
+
+        records = read_metrics(path)
+        for r in records:
+            validate_record(r)
+        by_event = {}
+        for r in records:
+            by_event.setdefault(r["event"], []).append(r)
+
+        # round-scoped span hierarchy
+        spans = {s["name"]: s for s in by_event["span"]}
+        for name in ("round", "poll", "average", "push"):
+            assert name in spans, f"missing {name} span"
+        rounds = [s for s in by_event["span"] if s["name"] == "round"]
+        polls = [s for s in by_event["span"] if s["name"] == "poll"]
+        round_ids = {s["span_id"] for s in rounds}
+        assert all(p["parent_id"] in round_ids for p in polls)
+        assert any("bytes_pulled" in s and s["bytes_pulled"] > 0
+                   for s in rounds)
+        assert any(s.get("slowest_client") in (1, 2) for s in rounds)
+
+        # client-side join/finalize spans + compile capture
+        assert "get_setup" in spans and "finalize" in spans
+        compiles = {c["what"] for c in by_event["jit_compile"]}
+        assert "train_step" in compiles
+
+        # cumulative registry state: RPC latency, codec bytes, step times
+        merged = {}
+        for snap_ev in by_event["metrics_snapshot"]:
+            merged.update(snap_ev["metrics"])
+        assert merged["rpc_s/FederationClient.TrainStep"]["count"] > 0
+        assert merged["codec_encoded_bytes"]["value"] > 0
+        assert merged["codec_decoded_bytes"]["value"] > 0
+        assert merged["stepper_step_s"]["count"] > 0
+        assert merged["client_poll_s"]["count"] > 0
+        assert "round_slowest_client_id" in merged
+        staleness = [k for k in merged if k.startswith("client_staleness_mb/")]
+        assert staleness
+
+        # and the CLI report renders from it
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(["summarize", path])
+        assert rc == 0
+        out = buf.getvalue()
+        assert "federation rounds:" in out
+        assert "rpc latency" in out
+        assert "stepper_step_s" in out
+        assert "bytes moved" in out
+        # non-step/non-rpc histograms (codec, poll latency) render too
+        assert "other distributions" in out
+        assert "client_poll_s" in out and "codec_encode_s" in out
+
+    def test_uninstrumented_federation_unchanged(self):
+        """metrics=None everywhere -> the no-op path: stubs/codec/stepper
+        hooks must add nothing and require nothing."""
+        from gfedntm_tpu.federation import codec
+
+        bundle = codec.flatdict_to_bundle({"a": np.ones(3, np.float32)})
+        out = codec.bundle_to_flatdict(bundle)
+        np.testing.assert_array_equal(out["a"], np.ones(3, np.float32))
+
+
+class TestTrainerTelemetry:
+    def test_spmd_fit_emits_step_histogram_and_compile(self):
+        """FederatedTrainer.fit: first fit captures the program compile;
+        a second fit (compiled program reused) feeds trainer_step_s; both
+        snapshot into the stream."""
+        from gfedntm_tpu.data.datasets import BowDataset
+        from gfedntm_tpu.federated.trainer import FederatedTrainer
+        from gfedntm_tpu.models.avitm import AVITM
+
+        rng = np.random.default_rng(0)
+        datasets = [
+            BowDataset(
+                X=rng.integers(0, 3, size=(12, 16)).astype(np.float32),
+                idx2token={i: str(i) for i in range(16)},
+            )
+            for _ in range(2)
+        ]
+        import jax
+
+        if not hasattr(jax, "shard_map"):
+            # Same environment gap that fails the seed's test_federated.py
+            # suite on old CPU-only jax; the SPMD program can't build at all.
+            pytest.skip("jax.shard_map unavailable in this environment")
+        template = AVITM(
+            input_size=16, n_components=3, hidden_sizes=(8,), batch_size=8,
+            num_epochs=2, seed=0,
+        )
+        trainer = FederatedTrainer(template, n_clients=2, seed=0)
+        log = MetricsLogger(validate=True)
+        trainer.fit(datasets, metrics=log)
+        compiles = log.events("jit_compile")
+        assert any(c["what"] == "federated_program" for c in compiles)
+        assert log.events("metrics_snapshot")
+        # steady-state fit at the same segment length: no new compile event,
+        # per-segment average step time lands in the histogram
+        trainer.fit(datasets, metrics=log)
+        assert len(log.events("jit_compile")) == len(compiles)
+        snap = log.events("metrics_snapshot")[-1]["metrics"]
+        assert snap["trainer_step_s"]["count"] >= 1
