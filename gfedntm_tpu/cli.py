@@ -77,6 +77,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "FedAvg exchanges, simulate AND server modes (1 = the reference's "
                         "per-minibatch averaging; >1 = FedAvg proper, the "
                         "opt-in fix for its topic-diversity collapse)")
+    # Fault tolerance (README "Fault tolerance"): round checkpoint/resume,
+    # probation/quorum semantics, and the client liveness watchdog.
+    p.add_argument("--resume", action="store_true",
+                   help="server mode: restore round state from the latest "
+                        "checkpoint under save_dir and continue from that "
+                        "round while clients rejoin")
+    p.add_argument("--checkpoint_every", type=int, default=25,
+                   help="server mode: persist round state every K rounds "
+                        "(0 disables)")
+    p.add_argument("--probation_rounds", type=int, default=3,
+                   help="server mode: consecutive failed rounds before a "
+                        "suspect client is permanently dropped")
+    p.add_argument("--quorum_fraction", type=float, default=0.5,
+                   help="server mode: minimum fraction of unfinished "
+                        "clients that must answer for a round's average "
+                        "to count")
+    p.add_argument("--liveness_timeout", type=float, default=300.0,
+                   help="client mode: self-finalize if no server activity "
+                        "arrives within this many seconds (0 disables)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -183,7 +202,16 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         save_dir=args.save_dir,
         local_steps=getattr(args, "local_steps", 1),
         metrics=metrics,
+        checkpoint_every=getattr(args, "checkpoint_every", 25),
+        probation_rounds=getattr(args, "probation_rounds", 3),
+        quorum_fraction=getattr(args, "quorum_fraction", 0.5),
     )
+    if getattr(args, "resume", False):
+        try:
+            round_idx = server.restore_from_checkpoint()
+        except FileNotFoundError as err:
+            raise SystemExit(f"--resume: {err}")
+        logging.info("resuming federation from round %d", round_idx)
     port = args.listen_port if args.listen_port is not None else 50051
     server.start(f"[::]:{port}")
     logging.info("server on port %d; waiting for federation", port)
@@ -226,6 +254,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         stop_words=cfg.data.stop_words,
         save_dir=save_dir,
         metrics=metrics,
+        liveness_timeout=getattr(args, "liveness_timeout", 300.0),
     )
     client.run()
     client.shutdown()

@@ -11,4 +11,9 @@ from gfedntm_tpu.federation import codec as codec
 from gfedntm_tpu.federation import rpc as rpc
 from gfedntm_tpu.federation.client import Client, FederatedClientServicer
 from gfedntm_tpu.federation.registry import ClientRecord, Federation
+from gfedntm_tpu.federation.resilience import (
+    FaultInjector,
+    FaultSpec,
+    RetryPolicy,
+)
 from gfedntm_tpu.federation.server import FederatedServer, build_template_model

@@ -15,6 +15,7 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -40,13 +41,29 @@ class FederatedClientServicer:
     step-time histograms."""
 
     def __init__(self, client_id: int, stepper: FederatedStepper,
-                 on_stop, logger: logging.Logger, metrics=None):
+                 on_stop, logger: logging.Logger, metrics=None,
+                 on_activity=None, on_done=None, on_local_steps=None):
         self.client_id = client_id
         self.stepper = stepper
         self.on_stop = on_stop
         self.logger = logger
         self.metrics = metrics
-        self._lock = threading.Lock()
+        # Liveness signals for the owning Client's watchdog: every poll or
+        # aggregate the server sends proves it is alive. ``on_activity``
+        # fires at dispatch, ``on_done`` when the call returns — the pair
+        # lets the watchdog treat a long-running local step (an E-step
+        # round can legitimately run for minutes) as activity rather than
+        # as a dead server. ``on_local_steps`` reports each StepRequest's
+        # requested E so the watchdog window can scale with the server's
+        # actual per-round deadline.
+        self.on_activity = on_activity or (lambda: None)
+        self.on_done = on_done or (lambda: None)
+        self.on_local_steps = on_local_steps or (lambda n: None)
+        # Reentrant: the stop broadcast's on_stop finalizes under this
+        # lock, and the Client's watchdog path takes it too before
+        # snapshotting results — finalization must never read model state
+        # mid-mutation from a concurrent TrainStep.
+        self._lock = threading.RLock()
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
         """The round's local step(s); reply with the post-step shared
@@ -55,8 +72,16 @@ class FederatedClientServicer:
         aggregate-free local steps first (FedAvg proper) — only the
         final step's snapshot is exchanged, and the following
         ApplyAggregate accounts it."""
+        self.on_activity()
+        try:
+            return self._train_step(request)
+        finally:
+            self.on_done()
+
+    def _train_step(self, request: pb.StepRequest) -> pb.StepReply:
         with self._lock:
             requested = max(1, int(request.local_steps or 1))
+            self.on_local_steps(requested)
             # Truncate the round to the remaining epoch budget so the
             # exchanged step is always the FINAL scheduled one — the SPMD
             # trainer's forced-final-exchange semantics; never train past
@@ -88,6 +113,13 @@ class FederatedClientServicer:
         """Overwrite shared params with the global average and advance
         (``sendAggregatedTensor``, ``client.py:135-185``); a stop broadcast
         triggers finalization instead."""
+        self.on_activity()
+        try:
+            return self._apply_aggregate(request)
+        finally:
+            self.on_done()
+
+    def _apply_aggregate(self, request: pb.Aggregate) -> pb.AggregateReply:
         with self._lock:
             if request.stop:
                 self.on_stop()
@@ -131,6 +163,9 @@ class Client:
         setup_timeout: float = 3600.0,
         logger: logging.Logger | None = None,
         metrics=None,
+        liveness_timeout: float = 300.0,
+        watchdog_poll_s: float = 2.0,
+        retry_policy=None,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -146,6 +181,24 @@ class Client:
         # Optional MetricsLogger: join-phase spans, RPC/codec registry
         # metrics, and the stepper's step-time histograms all flow into it.
         self.metrics = metrics
+        # Liveness watchdog: if no poll/aggregate/stop arrives within this
+        # window after training starts, the client self-finalizes instead of
+        # blocking in stopped.wait() forever against a dead server.
+        # 0 disables. The window must comfortably exceed a round period —
+        # the server's base 120 s poll deadline means 300 s tolerates one
+        # fully timed-out round plus slack. The server's actual deadline
+        # scales with local_steps (120 + 2E), so the effective window is
+        # multiplied by the same factor once the first StepRequest reveals
+        # E (_note_local_steps) — a straggler peer inside ITS deadline must
+        # not read as a dead server here.
+        self.liveness_timeout = float(liveness_timeout)
+        self.watchdog_poll_s = float(watchdog_poll_s)
+        self._deadline_scale = 1.0
+        # Retries transient failures of the client->server control RPCs
+        # (join, readiness) — covers a server that is restarting for resume.
+        from gfedntm_tpu.federation.resilience import RetryPolicy
+
+        self.retry_policy = retry_policy or RetryPolicy(metrics=metrics)
 
         self.stepper: FederatedStepper | None = None
         self.global_vocab: Vocabulary | None = None
@@ -153,14 +206,100 @@ class Client:
         self.results: dict[str, Any] | None = None
         self.stopped = threading.Event()
         self._grpc_server = None
+        self._servicer: FederatedClientServicer | None = None
+        self._last_activity = time.monotonic()
+        # In-flight server-call count: a TrainStep that legitimately runs
+        # for minutes (an E-step round) must read as activity, not as a
+        # dead server — the watchdog never fires while a call is open.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._finalize_lock = threading.Lock()
+        self._finalized = False
 
     # ---- lifecycle ---------------------------------------------------------
+    def _touch(self) -> None:
+        self._last_activity = time.monotonic()
+
+    def _rpc_begin(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+        self._touch()
+
+    def _rpc_end(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+        self._touch()
+
+    def _note_local_steps(self, local_steps: int) -> None:
+        """Scale the liveness window by the server's actual per-round poll
+        deadline (120 + 2E vs the base 120) once a StepRequest reveals E."""
+        self._deadline_scale = max(
+            1.0, (120.0 + 2.0 * local_steps) / 120.0
+        )
+
+    def _idle_expired(self) -> float | None:
+        """Seconds of idle time iff past the (scaled) liveness window."""
+        idle = time.monotonic() - self._last_activity
+        window = self.liveness_timeout * self._deadline_scale
+        return idle if idle > window else None
+
     def run(self) -> None:
         """Blocking end-to-end client lifecycle; returns once the server's
-        stop broadcast has been processed and artifacts are written."""
+        stop broadcast has been processed and artifacts are written — or
+        once the liveness watchdog concludes the server is gone and
+        self-finalizes (the reference client, and our first rewrite, would
+        block in ``stopped.wait()`` forever)."""
         self.join_federation()
         self.serve_training()
-        self.stopped.wait()
+        if self.liveness_timeout <= 0:
+            # Watchdog disabled: a single blocking wait, not a poll loop.
+            self.stopped.wait()
+            return
+        self._touch()
+        while not self.stopped.wait(self.watchdog_poll_s):
+            with self._inflight_lock:
+                busy = self._inflight > 0
+            if busy:
+                # An open server call IS liveness, however long its local
+                # steps run — idle time only accrues between calls.
+                continue
+            if self._idle_expired() is None:
+                continue
+            if self._watchdog_finalize():
+                break
+
+    def _watchdog_finalize(self) -> bool:
+        """Self-finalize under the servicer's lock, re-checking liveness
+        once the lock is held: a TrainStep racing the watchdog may have
+        been mid-mutation (the lock closes that) or may have just proven
+        the server alive (the re-check closes that). Returns False when
+        the fire was spurious."""
+        if self._servicer is not None:
+            with self._servicer._lock:
+                idle = self._idle_expired()
+                if self.stopped.is_set() or idle is None:
+                    return False  # activity raced us to the lock
+                self._log_watchdog(idle)
+                self._on_stop()
+        else:
+            idle = self._idle_expired()
+            if idle is None:
+                return False
+            self._log_watchdog(idle)
+            self._on_stop()
+        return True
+
+    def _log_watchdog(self, idle: float) -> None:
+        self.logger.warning(
+            "client %d: no server activity for %.0f s (> %.0f s liveness "
+            "window); self-finalizing", self.client_id, idle,
+            self.liveness_timeout * self._deadline_scale,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("watchdog_self_finalized").inc()
+            self.metrics.log(
+                "watchdog_fired", client=self.client_id, idle_s=idle
+            )
 
     def join_federation(self) -> None:
         """Phases 1-2 of the client lifecycle (``client.py:378-507``)."""
@@ -168,6 +307,7 @@ class Client:
         self._federation_stub = rpc.ServiceStub(
             channel, "gfedntm.Federation",
             metrics=self.metrics, peer="server",
+            retry_policy=self.retry_policy,
         )
 
         # 1. local vocabulary -> server (client.py:358-406)
@@ -258,8 +398,10 @@ class Client:
         ``client.py:282-319,509-532``)."""
         servicer = FederatedClientServicer(
             self.client_id, self.stepper, self._on_stop, self.logger,
-            metrics=self.metrics,
+            metrics=self.metrics, on_activity=self._rpc_begin,
+            on_done=self._rpc_end, on_local_steps=self._note_local_steps,
         )
+        self._servicer = servicer
         self._grpc_server = rpc.make_server(max_workers=4)
         rpc.add_service(
             self._grpc_server, "gfedntm.FederationClient", servicer
@@ -284,9 +426,15 @@ class Client:
             self._on_stop()
 
     def _on_stop(self) -> None:
-        """Finalize on the server's stop broadcast: per-client artifacts
-        (thresholded thetas + betas + topics, ``client.py:173-183`` →
-        ``get_results_model``)."""
+        """Finalize on the server's stop broadcast (or the liveness
+        watchdog): per-client artifacts (thresholded thetas + betas +
+        topics, ``client.py:173-183`` → ``get_results_model``). Idempotent —
+        the watchdog, a stop broadcast, and a code=1 readiness ack may all
+        race to finalize the same client."""
+        with self._finalize_lock:
+            if self._finalized:
+                return
+            self._finalized = True
         try:
             with span(self.metrics, "finalize", client=self.client_id):
                 self.results = self.stepper.get_results_model(self.save_dir)

@@ -15,10 +15,25 @@ import threading
 from dataclasses import dataclass, field
 
 
+# Liveness states of a training-phase client. ACTIVE clients are polled
+# every round; SUSPECT clients (≥1 consecutive failed round) are re-polled
+# with a per-round exponential backoff until they either answer again
+# (→ ACTIVE, a "recovery") or exhaust their probation budget (→ DROPPED,
+# which also sets ``finished`` so the round loop and quorum maths treat
+# them exactly like an early finisher).
+ACTIVE = "active"
+SUSPECT = "suspect"
+DROPPED = "dropped"
+
+#: Cap on the suspect re-poll backoff, in rounds.
+MAX_RETRY_BACKOFF_ROUNDS = 8
+
+
 @dataclass
 class ClientRecord:
     """Per-client federation state (reference ``FederationClient``):
-    identity, FedAvg weight, phase flags, and training progress counters."""
+    identity, FedAvg weight, phase flags, liveness/probation state, and
+    training progress counters."""
 
     client_id: int
     nr_samples: float = 0.0
@@ -30,6 +45,9 @@ class ClientRecord:
     current_mb: int = 0
     current_epoch: int = 0
     last_loss: float = float("nan")
+    status: str = ACTIVE
+    consecutive_failures: int = 0
+    next_retry_round: int = 0
 
 
 @dataclass
@@ -75,6 +93,11 @@ class Federation:
             rec.address = address
             rec.ready_for_training = True
             rec.finished = False
+            # A (re)joining client starts with a clean probation slate — a
+            # fresh process is a fresh liveness history.
+            rec.status = ACTIVE
+            rec.consecutive_failures = 0
+            rec.next_retry_round = 0
             self._cond.notify_all()
             return rec
 
@@ -90,21 +113,66 @@ class Federation:
             )
 
     def mark_dropped(self, client_id: int, address: str) -> None:
-        """Drop a client after a failed RPC — but only if it has not
-        rejoined since: a rejoin changes the serving address, and a stale
+        """Permanently drop a client after a failed RPC — but only if it has
+        not rejoined since: a rejoin changes the serving address, and a stale
         in-flight failure against the OLD address must not clobber the
         fresh registration."""
         with self._lock:
             rec = self._clients.get(client_id)
             if rec is not None and rec.address == address:
                 rec.finished = True
+                rec.status = DROPPED
+
+    def mark_suspect(
+        self, client_id: int, address: str, round_idx: int,
+        probation_rounds: int = 3,
+    ) -> str | None:
+        """Record one failed round for a client: ACTIVE/SUSPECT clients gain
+        a consecutive-failure count and a backed-off ``next_retry_round``
+        (1, 2, 4, ... rounds out, capped); after ``probation_rounds``
+        consecutive failures the drop becomes permanent. Returns the
+        client's new status, or None when the failure is stale (the client
+        rejoined on a different address since the RPC was issued)."""
+        with self._lock:
+            rec = self._clients.get(client_id)
+            if rec is None or rec.address != address:
+                return None
+            rec.consecutive_failures += 1
+            if rec.consecutive_failures >= probation_rounds:
+                rec.status = DROPPED
+                rec.finished = True
+            else:
+                rec.status = SUSPECT
+                rec.next_retry_round = round_idx + min(
+                    2 ** (rec.consecutive_failures - 1),
+                    MAX_RETRY_BACKOFF_ROUNDS,
+                )
+            return rec.status
+
+    def mark_recovered(self, client_id: int) -> bool:
+        """A suspect client answered a poll again: clear its probation
+        state. Returns True iff this was an actual SUSPECT→ACTIVE
+        transition (so callers can count recoveries, not every poll)."""
+        with self._lock:
+            rec = self._clients.get(client_id)
+            if rec is None or rec.status != SUSPECT:
+                return False
+            rec.status = ACTIVE
+            rec.consecutive_failures = 0
+            rec.next_retry_round = 0
+            return True
 
     def update_progress(
         self, client_id: int, current_mb: int, current_epoch: int,
         loss: float, finished: bool,
     ) -> None:
         with self._lock:
-            rec = self._clients[client_id]
+            # .get(): a client may disconnect() concurrently with the push
+            # that reports its progress — a vanished record is a no-op, not
+            # a KeyError that kills the push worker.
+            rec = self._clients.get(client_id)
+            if rec is None:
+                return
             rec.current_mb = current_mb
             rec.current_epoch = current_epoch
             rec.last_loss = loss
@@ -120,11 +188,31 @@ class Federation:
         with self._lock:
             return sorted(self._clients.values(), key=lambda c: c.client_id)
 
-    def active_clients(self) -> list[ClientRecord]:
+    def active_clients(self, round_idx: int | None = None) -> list[ClientRecord]:
+        """Clients to poll: ready, not finished/dropped, and — when a
+        ``round_idx`` is given — not a suspect still inside its backoff
+        window. Without a round, suspects are included regardless (the
+        historical membership view)."""
         with self._lock:
             return [
                 c for c in self.get_clients()
                 if c.ready_for_training and not c.finished
+                and (
+                    round_idx is None
+                    or c.status != SUSPECT
+                    or c.next_retry_round <= round_idx
+                )
+            ]
+
+    def pending_suspects(self, round_idx: int) -> list[ClientRecord]:
+        """Suspects whose backed-off retry round is still in the future —
+        the reason a reply-less round should wait rather than end the
+        federation."""
+        with self._lock:
+            return [
+                c for c in self.get_clients()
+                if c.ready_for_training and not c.finished
+                and c.status == SUSPECT and c.next_retry_round > round_idx
             ]
 
     def total_weight(self) -> float:

@@ -1,0 +1,248 @@
+"""Federation resilience primitives: retry policy + fault injection.
+
+The reference federation is fail-stop — the first client failure crashes the
+round loop (SURVEY.md §5 "no retry"), and our initial rewrite only upgraded
+that to fail-soft (one transient ``UNAVAILABLE`` permanently drops the
+client). This module provides the two building blocks of the recovery story:
+
+- :class:`RetryPolicy` — exponential backoff with decorrelated jitter and a
+  per-gRPC-code classification of transient vs. permanent errors. Every
+  :class:`~gfedntm_tpu.federation.rpc.ServiceStub` call can route through
+  one, so a connection blip costs milliseconds instead of a federation
+  member. ``DEADLINE_EXCEEDED`` is deliberately NOT retried at the RPC
+  layer: a timed-out ``TrainStep`` may have executed on the client (the
+  call is not idempotent), so deadline expiries are handled one level up by
+  the server's round-scoped probation (``registry.mark_suspect``), which
+  re-polls the client on a later round instead of re-issuing the step.
+- :class:`FaultInjector` — deterministic scripted per-call failures (drop,
+  delay, error-code), seeded, injectable into both the client-side stub and
+  the servicer dispatch path, so every recovery path is exercisable
+  in-process without flaky socket games.
+
+Both are pure-Python and dependency-free beyond ``grpc`` (already a
+federation dependency); neither touches the wire format.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+import grpc
+
+#: gRPC status codes that indicate the request (very likely) never executed
+#: and is safe to re-issue immediately: connection refused / channel reset
+#: (UNAVAILABLE), server admission pushback (RESOURCE_EXHAUSTED), and
+#: serializable-conflict style aborts (ABORTED).
+TRANSIENT_CODES: frozenset[grpc.StatusCode] = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+})
+
+
+def error_code(exc: BaseException) -> grpc.StatusCode | None:
+    """The gRPC status code of an exception, or None for non-RPC errors."""
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            code = code()
+        except Exception:
+            return None
+    return code if isinstance(code, grpc.StatusCode) else None
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient = safe to retry the same RPC. Non-gRPC connection errors
+    (refused sockets surfacing as OS errors) count; everything else —
+    including ``DEADLINE_EXCEEDED``, where the call may have run — is
+    permanent at the RPC layer (see module docstring)."""
+    code = error_code(exc)
+    if code is not None:
+        return code in TRANSIENT_CODES
+    return isinstance(exc, ConnectionError)
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with decorrelated jitter (the AWS-architecture
+    variant: each delay is uniform on ``[base, 3 * previous]``, capped).
+
+    ``seed`` fixes the jitter sequence per call (each ``call`` re-derives
+    its RNG from the seed), making retry timing reproducible in tests;
+    ``seed=None`` uses the global RNG. ``sleep`` is injectable so tests can
+    record delays instead of waiting them out. ``metrics`` (an object with
+    a ``registry``, i.e. a MetricsLogger) feeds the ``retry_attempts`` /
+    ``retry_successes`` / ``retry_giveups`` counters.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    seed: int | None = None
+    metrics: Any = None
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+
+    def delays(self) -> Iterator[float]:
+        """Infinite decorrelated-jitter delay sequence (fresh per call)."""
+        rng = random.Random(self.seed) if self.seed is not None else random
+        prev = self.base_delay_s
+        while True:
+            prev = min(
+                self.max_delay_s, rng.uniform(self.base_delay_s, prev * 3)
+            )
+            yield prev
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        """Invoke ``fn``, retrying transient failures up to ``max_attempts``
+        total attempts. Permanent errors and exhausted budgets re-raise the
+        last exception."""
+        reg = self.metrics.registry if self.metrics is not None else None
+        delays = self.delays()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as exc:
+                if not is_transient(exc) or attempt >= self.max_attempts:
+                    if reg is not None and is_transient(exc):
+                        reg.counter("retry_giveups").inc()
+                    raise
+                if reg is not None:
+                    reg.counter("retry_attempts").inc()
+                self.sleep(next(delays))
+            else:
+                if attempt > 1 and reg is not None:
+                    reg.counter("retry_successes").inc()
+                return result
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+# ---- deterministic fault injection ------------------------------------------
+
+class InjectedRpcError(grpc.RpcError):
+    """A synthetic RPC failure carrying a real ``grpc.StatusCode`` so the
+    production classification (:func:`is_transient`) and telemetry paths
+    treat it exactly like a wire error."""
+
+    def __init__(self, code: grpc.StatusCode, detail: str):
+        super().__init__(detail)
+        self._code = code
+        self._detail = detail
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._detail
+
+
+@dataclass
+class FaultSpec:
+    """One scripted fault: fires on the next ``times`` matching calls.
+
+    ``kind``: ``"error"`` raises ``code``; ``"drop"`` is shorthand for an
+    ``UNAVAILABLE`` error (a dropped connection); ``"delay"`` sleeps
+    ``delay_s`` then lets the call proceed. ``peer=""`` matches any peer.
+    ``probability < 1`` fires probabilistically from the injector's seeded
+    RNG (still deterministic for a fixed seed and call order).
+    """
+
+    method: str
+    kind: str = "error"
+    code: grpc.StatusCode = grpc.StatusCode.UNAVAILABLE
+    delay_s: float = 0.0
+    times: int = 1
+    peer: str = ""
+    probability: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in ("error", "drop", "delay"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind == "drop":
+            self.kind, self.code = "error", grpc.StatusCode.UNAVAILABLE
+
+
+class FaultInjector:
+    """Deterministic scripted per-call fault injection.
+
+    Inject into a :class:`~gfedntm_tpu.federation.rpc.ServiceStub`
+    (``fault_injector=``) to fail outgoing calls before they reach the wire,
+    or into :func:`~gfedntm_tpu.federation.rpc.add_service` to fail incoming
+    dispatches before the servicer method runs. Specs for the same method
+    are consumed FIFO; each fired fault is appended to :attr:`fired` for
+    test assertions. Thread-safe — the server polls clients from a pool.
+    """
+
+    def __init__(self, seed: int = 0, metrics: Any = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._specs: list[FaultSpec] = []
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._sleep = sleep
+        self.metrics = metrics
+        self.fired: list[tuple[str, str, str]] = []  # (method, peer, kind)
+
+    def script(self, method: str, kind: str = "error", *,
+               code: grpc.StatusCode = grpc.StatusCode.UNAVAILABLE,
+               delay_s: float = 0.0, times: int = 1, peer: str = "",
+               probability: float = 1.0) -> FaultSpec:
+        """Queue a fault for the next ``times`` matching calls."""
+        spec = FaultSpec(
+            method=method, kind=kind, code=code, delay_s=delay_s,
+            times=times, peer=peer, probability=probability,
+        )
+        with self._lock:
+            self._specs.append(spec)
+        return spec
+
+    def pending(self, method: str | None = None) -> int:
+        """Remaining scripted firings (for a method, or in total)."""
+        with self._lock:
+            return sum(
+                s.times for s in self._specs
+                if method is None or s.method == method
+            )
+
+    def before_call(self, service: str, method: str, request: Any = None,
+                    peer: str = "") -> None:
+        """Consult the script for one call; raises/sleeps per the matched
+        spec, or returns immediately when nothing matches."""
+        with self._lock:
+            spec = next(
+                (
+                    s for s in self._specs
+                    if s.times > 0 and s.method == method
+                    and s.peer in ("", peer)
+                ),
+                None,
+            )
+            if spec is None:
+                return
+            if spec.probability < 1.0 and (
+                self._rng.random() >= spec.probability
+            ):
+                return
+            spec.times -= 1
+            if spec.times <= 0:
+                self._specs.remove(spec)
+            self.fired.append((method, peer, spec.kind))
+            if self.metrics is not None:
+                self.metrics.registry.counter("faults_injected").inc()
+        # Act OUTSIDE the lock: a scripted delay must not serialize every
+        # other injected call behind it.
+        if spec.kind == "delay":
+            self._sleep(spec.delay_s)
+            return
+        raise InjectedRpcError(
+            spec.code,
+            f"injected {spec.kind} for {service}/{method} (peer={peer!r})",
+        )

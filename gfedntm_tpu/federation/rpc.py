@@ -47,13 +47,25 @@ SERVER_OPTIONS = _MSG_CAPS + [
 ]
 
 
-def add_service(server: grpc.Server, service_name: str, impl: Any) -> None:
-    """Register ``impl`` (an object with one method per RPC) on ``server``."""
+def add_service(server: grpc.Server, service_name: str, impl: Any,
+                fault_injector: Any = None) -> None:
+    """Register ``impl`` (an object with one method per RPC) on ``server``.
+
+    ``fault_injector`` (a
+    :class:`~gfedntm_tpu.federation.resilience.FaultInjector`) intercepts
+    each dispatch BEFORE the servicer method runs — an injected error
+    surfaces to the remote caller as a real gRPC status, exercising its
+    retry/probation paths over a healthy connection."""
     spec = SERVICES[service_name]
     handlers = {}
     for method, (req_cls, resp_cls) in spec.items():
+        behaviour = getattr(impl, method)
+        if fault_injector is not None:
+            behaviour = _injected_behaviour(
+                fault_injector, service_name, method, behaviour
+            )
         handlers[method] = grpc.unary_unary_rpc_method_handler(
-            getattr(impl, method),
+            behaviour,
             request_deserializer=req_cls.FromString,
             response_serializer=resp_cls.SerializeToString,
         )
@@ -62,8 +74,22 @@ def add_service(server: grpc.Server, service_name: str, impl: Any) -> None:
     )
 
 
+def _injected_behaviour(injector: Any, service: str, method: str, fn: Any):
+    from gfedntm_tpu.federation.resilience import InjectedRpcError
+
+    def behaviour(request, context):
+        try:
+            injector.before_call(service, method, request)
+        except InjectedRpcError as exc:
+            context.abort(exc.code(), exc.details())
+        return fn(request, context)
+
+    return behaviour
+
+
 def _with_deadline(fn, default_timeout: float | None, metrics=None,
-                   service: str = "", method: str = "", peer: str = ""):
+                   service: str = "", method: str = "", peer: str = "",
+                   retry_policy=None, fault_injector=None):
     """Apply a default gRPC deadline: a deadline-less unary call on an
     unconnectable channel blocks forever (no RST ⇒ no error), which would
     hang the training thread on the first unreachable client.
@@ -83,9 +109,11 @@ def _with_deadline(fn, default_timeout: float | None, metrics=None,
         bytes_sent = reg.counter("rpc_bytes_sent")
         bytes_recv = reg.counter("rpc_bytes_recv")
 
-    def call(request, timeout: float | None = None, **kwargs):
+    def attempt(request, timeout: float | None = None, **kwargs):
         if timeout is None:
             timeout = default_timeout
+        if fault_injector is not None:
+            fault_injector.before_call(service, method, request, peer=peer)
         if metrics is None:
             return fn(request, timeout=timeout, **kwargs)
         t0 = time.perf_counter()
@@ -118,6 +146,15 @@ def _with_deadline(fn, default_timeout: float | None, metrics=None,
         bytes_recv.inc(response.ByteSize())
         return response
 
+    if retry_policy is None:
+        return attempt
+
+    # The retry wrapper sits OUTSIDE the per-attempt instrumentation: every
+    # attempt is individually metered (rpc_calls/rpc_errors/latency), while
+    # the policy's own retry_* counters account the recovery behaviour.
+    def call(request, timeout: float | None = None, **kwargs):
+        return retry_policy.call(attempt, request, timeout=timeout, **kwargs)
+
     return call
 
 
@@ -130,7 +167,14 @@ class ServiceStub:
     phase-transition timeout, ``server.py:237``); pass ``timeout=`` per call
     to override. ``metrics`` (a
     :class:`~gfedntm_tpu.utils.observability.MetricsLogger`) turns on
-    per-call latency/byte instrumentation; ``peer`` labels error events."""
+    per-call latency/byte instrumentation; ``peer`` labels error events.
+
+    ``retry_policy`` (a
+    :class:`~gfedntm_tpu.federation.resilience.RetryPolicy`) transparently
+    retries transient failures with backoff; ``fault_injector`` (a
+    :class:`~gfedntm_tpu.federation.resilience.FaultInjector`) fails
+    scripted calls before they reach the wire — each retry attempt
+    re-consults the script, so an N-times fault costs N attempts."""
 
     def __init__(
         self,
@@ -139,6 +183,8 @@ class ServiceStub:
         default_timeout: float | None = 120.0,
         metrics=None,
         peer: str = "",
+        retry_policy=None,
+        fault_injector=None,
     ):
         for method, (req_cls, resp_cls) in SERVICES[service_name].items():
             setattr(
@@ -155,6 +201,8 @@ class ServiceStub:
                     service=service_name,
                     method=method,
                     peer=peer,
+                    retry_policy=retry_policy,
+                    fault_injector=fault_injector,
                 ),
             )
 

@@ -15,14 +15,26 @@ sleep × N clients per step plus 2N fresh channels, SURVEY.md §3.3):
 - no inter-client sleeps;
 - quorum waits are condition-variable driven with configurable timeouts
   instead of the 120 s poll-expiry (§2.5 item 9);
-- a client whose RPC fails is dropped from the round and marked finished
-  (fail-soft) instead of crashing the loop (§5 "no retry" defect).
+- a client whose RPC fails enters **probation** (``SUSPECT``): it is
+  re-polled with per-round backoff for ``probation_rounds`` rounds before
+  the drop becomes permanent — recovery, not fail-soft, and several layers
+  beyond the reference's §5 "no retry" crash;
+- transient RPC errors are additionally retried in-call with decorrelated
+  jitter (:class:`~gfedntm_tpu.federation.resilience.RetryPolicy`);
+- a configurable round **quorum fraction** skips (rather than averages)
+  rounds where too few clients answered, so the weighted average never
+  silently degenerates to one straggler's parameters;
+- round state (``last_average`` + round counter + membership) is
+  **checkpointed** every ``checkpoint_every`` rounds, and a crashed server
+  restarted with :meth:`FederatedServer.restore_from_checkpoint` continues
+  from the checkpointed round while clients rejoin.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -34,7 +46,8 @@ from gfedntm_tpu.config import SHARE_ALL
 from gfedntm_tpu.data.vocab import Vocabulary
 from gfedntm_tpu.federation import codec, rpc
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
-from gfedntm_tpu.federation.registry import Federation
+from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
+from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.models.ctm import CTM
 from gfedntm_tpu.utils.observability import span
@@ -83,9 +96,23 @@ class FederatedServer:
         metrics=None,
         poll_workers: int = 16,
         local_steps: int = 1,
+        retry_policy: RetryPolicy | None = None,
+        probation_rounds: int = 3,
+        quorum_fraction: float = 0.5,
+        checkpoint_every: int = 25,
+        round_backoff_s: float = 0.5,
+        fault_injector=None,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
+        if probation_rounds < 1:
+            raise ValueError(
+                f"probation_rounds must be >= 1, got {probation_rounds}"
+            )
+        if not 0.0 <= quorum_fraction <= 1.0:
+            raise ValueError(
+                f"quorum_fraction must be in [0, 1], got {quorum_fraction}"
+            )
         self.family = family
         self.model_kwargs = dict(model_kwargs or {})
         self.grads_to_share = tuple(grads_to_share)
@@ -98,6 +125,18 @@ class FederatedServer:
         # per-minibatch averaging; E>1 = FedAvg proper — the same knob as
         # FederatedTrainer.local_steps, carried to clients per StepRequest).
         self.local_steps = int(local_steps)
+        # Resilience knobs (README "Fault tolerance"): in-call RPC retry,
+        # round-scoped probation before a permanent drop, minimum fraction
+        # of the round's unfinished membership that must answer for the
+        # average to
+        # count, round checkpoint period (0 disables; needs save_dir), and
+        # the wall-clock pause after a reply-less / below-quorum round.
+        self.retry_policy = retry_policy or RetryPolicy(metrics=metrics)
+        self.probation_rounds = int(probation_rounds)
+        self.quorum_fraction = float(quorum_fraction)
+        self.checkpoint_every = int(checkpoint_every)
+        self.round_backoff_s = float(round_backoff_s)
+        self.fault_injector = fault_injector
 
         # Clients whose compile-dominated first poll has been seen (and
         # excluded from the poll-latency/straggler stats).
@@ -119,8 +158,15 @@ class FederatedServer:
         # snapshot, before training_done) is turned away with code=1 instead
         # of blocking forever on a stop that will never be sent.
         self._stopping = threading.Event()
+        # _aborted models a hard server crash (tests/emergencies): the loop
+        # exits WITHOUT the stop broadcast or finalization, leaving clients
+        # to their liveness watchdogs — exactly like a SIGKILL.
+        self._aborted = threading.Event()
         self.training_done = threading.Event()
         self._grpc_server = None
+        self._expected_keys: frozenset[str] | None = None
+        self._expected_shapes: dict[str, tuple] | None = None
+        self._ckpt = None
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self, address: str = "[::]:50051") -> str:
@@ -137,9 +183,32 @@ class FederatedServer:
         self.logger.info("federation server listening on port %d", port)
         return f"localhost:{port}" if address.startswith("[::]") else address
 
-    def stop(self, grace: float = 1.0) -> None:
+    def stop(self, grace: float = 1.0, join_timeout: float = 10.0) -> None:
+        """Graceful shutdown: signal the training loop (waking any backoff
+        waits), give it ``join_timeout`` seconds to run its stop broadcast
+        and finalization, then stop the gRPC server. Without the join, the
+        training thread would keep polling against a stopped server."""
+        self._stopping.set()
+        t = self._train_thread
+        if t is not None and t.is_alive():
+            t.join(join_timeout)
+            if t.is_alive():
+                self.logger.warning(
+                    "training thread still running after %.1fs; stopping "
+                    "the gRPC server anyway", join_timeout,
+                )
         if self._grpc_server is not None:
             self._grpc_server.stop(grace)
+
+    def abort(self) -> None:
+        """Hard-crash simulation: kill the gRPC server NOW and abandon the
+        training loop with no stop broadcast and no finalization — clients
+        are left to their liveness watchdogs, and a later server process
+        can :meth:`restore_from_checkpoint`."""
+        self._aborted.set()
+        self._stopping.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(0)
 
     def wait_done(self, timeout: float | None = None) -> bool:
         return self.training_done.wait(timeout)
@@ -177,15 +246,21 @@ class FederatedServer:
         self.template = build_template_model(
             self.family, len(self.global_vocab), self.model_kwargs
         )
+        self.logger.info(
+            "consensus: %d clients, global vocabulary %d tokens",
+            len(vocabs), len(self.global_vocab),
+        )
+        return self._setup_reply_from_template()
+
+    def _setup_reply_from_template(self) -> pb.GlobalSetup:
+        """The GlobalSetup message for the CURRENT vocab + template state —
+        shared by the consensus path and the checkpoint-resume path (where
+        the template carries the restored average instead of fresh init)."""
         hyper = {
             "family": self.family,
             "kwargs": {**self.model_kwargs, "input_size": len(self.global_vocab)},
             "grads_to_share": list(self.grads_to_share),
         }
-        self.logger.info(
-            "consensus: %d clients, global vocabulary %d tokens",
-            len(vocabs), len(self.global_vocab),
-        )
         return pb.GlobalSetup(
             vocab=list(self.global_vocab.tokens),
             model_family=self.family,
@@ -199,6 +274,107 @@ class FederatedServer:
                 self.template.opt_state, metrics=self.metrics
             ),
         )
+
+    # ---- shared-key template + round checkpointing -------------------------
+    def _shared_template(self) -> dict[str, np.ndarray]:
+        """The template model's shared flat subset — the authoritative key
+        set (and shapes) every client reply must match."""
+        from flax.traverse_util import flatten_dict
+
+        from gfedntm_tpu.models.params import build_share_mask
+
+        variables = {
+            "params": self.template.params,
+            "batch_stats": self.template.batch_stats,
+        }
+        mask = flatten_dict(
+            build_share_mask(variables, self.grads_to_share), sep="/"
+        )
+        flat = flatten_dict(variables, sep="/")
+        return {k: np.asarray(v) for k, v in flat.items() if mask.get(k)}
+
+    def _checkpointer(self):
+        """Lazily constructed FederationCheckpointer under
+        ``save_dir/checkpoints`` (round checkpointing needs a save_dir)."""
+        if self._ckpt is None:
+            if self.save_dir is None:
+                raise ValueError("round checkpointing requires save_dir")
+            import os
+
+            from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+            self._ckpt = FederationCheckpointer(
+                os.path.join(self.save_dir, "checkpoints")
+            )
+        return self._ckpt
+
+    def _save_round_checkpoint(self) -> None:
+        """Persist round state (never lets a checkpoint failure kill
+        training — the checkpoint is the recovery path, not the workload)."""
+        try:
+            membership = [
+                {
+                    "client_id": c.client_id,
+                    "nr_samples": c.nr_samples,
+                    "current_mb": c.current_mb,
+                    "current_epoch": c.current_epoch,
+                    "finished": bool(c.finished),
+                    "status": c.status,
+                }
+                for c in self.federation.get_clients()
+            ]
+            self._checkpointer().save_round(
+                self.global_iterations, self.last_average, membership,
+                vocab=list(self.global_vocab.tokens),
+                extra={"family": self.family},
+            )
+        except Exception:
+            self.logger.exception(
+                "round checkpoint at %d failed", self.global_iterations
+            )
+            return
+        if self.metrics is not None:
+            self.metrics.registry.counter("checkpoints_saved").inc()
+            self.metrics.log("checkpoint", round=self.global_iterations)
+
+    def restore_from_checkpoint(self) -> int:
+        """Rebuild vocabulary, template, ``last_average``, and the round
+        counter from the latest round checkpoint under ``save_dir``; the
+        restored average is applied onto the template so rejoining clients
+        replicate the TRAINED state, not a fresh init. Call before
+        :meth:`start`. Returns the restored round; raises
+        ``FileNotFoundError`` when there is nothing to resume."""
+        ckpt = self._checkpointer()
+        meta = ckpt.load_meta()
+        if meta is None or ckpt.latest_round() is None:
+            raise FileNotFoundError(
+                f"no federation checkpoint under {ckpt.directory}"
+            )
+        self.global_vocab = Vocabulary(tuple(meta["vocab"]))
+        self.template = build_template_model(
+            self.family, len(self.global_vocab), self.model_kwargs
+        )
+        template = self._shared_template()
+        self._expected_keys = frozenset(template)
+        self._expected_shapes = {k: v.shape for k, v in template.items()}
+        round_idx, average = ckpt.restore_round(template)
+        self.last_average = average
+        self.global_iterations = int(round_idx)
+
+        from gfedntm_tpu.federated.stepper import FederatedStepper
+
+        FederatedStepper(self.template, self.grads_to_share).set_gradients(
+            average
+        )
+        with self._setup_lock:
+            self._setup_reply = self._setup_reply_from_template()
+        self.logger.info(
+            "resumed federation from round %d (%d checkpointed members)",
+            round_idx, len(meta.get("membership", ())),
+        )
+        if self.metrics is not None:
+            self.metrics.log("resume", step=round_idx)
+        return round_idx
 
     def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
         """Client readiness signal; the training thread starts exactly once
@@ -249,10 +425,51 @@ class FederatedServer:
             stub = rpc.ServiceStub(
                 channel, "gfedntm.FederationClient",
                 metrics=self.metrics, peer=f"client{rec.client_id}",
+                retry_policy=self.retry_policy,
+                fault_injector=self.fault_injector,
             )
             entry = (rec.address, channel, stub)
             stubs[rec.client_id] = entry
         return entry[2]
+
+    def _note_client_failure(self, rec, addr: str, round_idx: int,
+                             exc: Exception, what: str) -> None:
+        """Round-level failure accounting: probation with per-round backoff
+        (``SUSPECT``) for ``probation_rounds`` consecutive failed rounds,
+        then the permanent drop. ALL failure classes go through probation —
+        a deterministic error simply fails its probation and drops within a
+        bounded number of rounds, while a transient one recovers."""
+        status = self.federation.mark_suspect(
+            rec.client_id, addr, round_idx,
+            probation_rounds=self.probation_rounds,
+        )
+        if status is None:  # stale: the client rejoined on a new address
+            return
+        reg = self.metrics.registry if self.metrics is not None else None
+        if status == DROPPED:
+            self.logger.warning(
+                "dropping client %d after %d failed rounds (last %s: %s)",
+                rec.client_id, rec.consecutive_failures, what, exc,
+            )
+            # A rejoin is a fresh process that must re-jit, so its first
+            # poll is compile-dominated again.
+            self._poll_warmed.discard(rec.client_id)
+            if reg is not None:
+                reg.counter("client_drops").inc()
+        else:
+            self.logger.warning(
+                "client %d suspect (failure %d/%d, retry at round %d) "
+                "after failed %s: %s",
+                rec.client_id, rec.consecutive_failures,
+                self.probation_rounds, rec.next_retry_round, what, exc,
+            )
+            if reg is not None:
+                reg.counter("client_suspect_rounds").inc()
+                self.metrics.log(
+                    "client_suspect", client=rec.client_id,
+                    failures=rec.consecutive_failures, status=status,
+                    round=round_idx,
+                )
 
     def _note_round_poll(self, round_sp, polled, replies) -> None:
         """Straggler/staleness telemetry for one round's poll results:
@@ -297,6 +514,68 @@ class FederatedServer:
                 ),
             )
 
+    def _collect_snapshots(
+        self, replies: list, iteration: int
+    ) -> list[tuple[float, dict[str, np.ndarray]]]:
+        """Decode a round's replies into ``(weight, flat-snapshot)`` pairs,
+        excluding any reply whose shared-key set OR array shapes do not
+        match the template's — a version-skewed (or corrupted) client must
+        cost the round one contributor, not ``KeyError`` (or a broadcast
+        ``ValueError``: same keys over a different consensus vocab is the
+        likelier skew) the whole average."""
+        if self._expected_keys is None:
+            template = self._shared_template()
+            self._expected_keys = frozenset(template)
+            self._expected_shapes = {k: v.shape for k, v in template.items()}
+        m = self.metrics
+        snapshots: list[tuple[float, dict[str, np.ndarray]]] = []
+        for rec, reply in replies:
+            snap = codec.bundle_to_flatdict(reply.shared, metrics=m)
+            if frozenset(snap) != self._expected_keys:
+                missing = sorted(self._expected_keys - set(snap))[:3]
+                unexpected = sorted(set(snap) - self._expected_keys)[:3]
+                self.logger.warning(
+                    "round %d: client %d reply keys mismatch the shared "
+                    "template (missing=%s, unexpected=%s); excluding it "
+                    "from the average", iteration, rec.client_id,
+                    missing, unexpected,
+                )
+                if m is not None:
+                    m.registry.counter("key_skew_excluded").inc()
+                continue
+            skewed = {
+                k: (v.shape, self._expected_shapes[k])
+                for k, v in snap.items()
+                if tuple(v.shape) != tuple(self._expected_shapes[k])
+            }
+            if skewed:
+                k, (got, want) = next(iter(sorted(skewed.items())))
+                self.logger.warning(
+                    "round %d: client %d reply shapes mismatch the shared "
+                    "template (%d keys, e.g. %s: %s != %s); excluding it "
+                    "from the average", iteration, rec.client_id,
+                    len(skewed), k, got, want,
+                )
+                if m is not None:
+                    m.registry.counter("key_skew_excluded").inc()
+                continue
+            snapshots.append((rec.nr_samples, snap))
+        return snapshots
+
+    def _skip_below_quorum(self, iteration: int, got: int, membership: int,
+                           quorum: int, what: str) -> None:
+        """Log/count one skipped round, then wait out a backoff tick."""
+        self.logger.warning(
+            "round %d below quorum (%d/%d %s, need %d): skipping average",
+            iteration, got, membership, what, quorum,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("quorum_skipped_rounds").inc()
+            self.metrics.log(
+                "quorum_skip", round=iteration, got=got, needed=quorum,
+            )
+        self._stopping.wait(self.round_backoff_s)
+
     def _run_training(self) -> None:
         try:
             self._training_loop()
@@ -318,12 +597,40 @@ class FederatedServer:
             "starting federated training: total weight %.0f",
             self.federation.total_weight(),
         )
+        try:
+            self._round_loop(stubs, pool)
+        finally:
+            if not self._aborted.is_set():
+                self._stop_broadcast(stubs)
+                self._finalize()
+            pool.shutdown(wait=False)
+            for _addr, channel, _stub in stubs.values():
+                channel.close()
 
+    def _round_loop(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
         m = self.metrics
-        for iteration in range(self.max_iters):
-            active = self.federation.active_clients()
-            if not active:
+        # Resume path: global_iterations was restored from the checkpoint,
+        # so a resumed server continues from that round, not round 0.
+        for iteration in range(self.global_iterations, self.max_iters):
+            if self._stopping.is_set():
                 break
+            active = self.federation.active_clients(iteration)
+            if not active:
+                pending = self.federation.pending_suspects(iteration)
+                if not pending:
+                    break
+                # Every pollable client is inside its probation backoff
+                # window, so no round can advance the round clock the
+                # backoff is denominated in. Convert the gap to the
+                # earliest scheduled retry into wall-clock (one backoff
+                # tick per round), wait it out, then poll the suspects
+                # early — instead of burning one max_iters round per tick.
+                gap = min(s.next_retry_round for s in pending) - iteration
+                if self._stopping.wait(self.round_backoff_s * max(1, gap)):
+                    break
+                active = self.federation.active_clients()
+                if not active:
+                    break
 
             with span(m, "round", round=iteration) as round_sp:
                 # 1. concurrent poll: one local step per client. The round
@@ -331,6 +638,8 @@ class FederatedServer:
                 # inherit the loop thread's contextvars.
                 def poll(rec):
                     addr = rec.address  # snapshot: rejoin may change it mid-RPC
+                    was_suspect = rec.status == SUSPECT
+                    prior_failures = rec.consecutive_failures
                     t0 = time.perf_counter()
                     try:
                         stub = self._stub_for(stubs, rec)
@@ -348,16 +657,24 @@ class FederatedServer:
                             ),
                             timeout=120.0 + 2.0 * self.local_steps,
                         )
+                        if was_suspect and self.federation.mark_recovered(
+                            rec.client_id
+                        ):
+                            self.logger.info(
+                                "client %d recovered after %d failed rounds",
+                                rec.client_id, prior_failures,
+                            )
+                            if m is not None:
+                                m.registry.counter("client_recoveries").inc()
+                                m.log(
+                                    "client_recovered", client=rec.client_id,
+                                    round=iteration,
+                                )
                         return rec, reply, time.perf_counter() - t0
                     except Exception as exc:
-                        self.logger.warning(
-                            "dropping client %d after failed TrainStep: %s",
-                            rec.client_id, exc,
+                        self._note_client_failure(
+                            rec, addr, iteration, exc, "TrainStep"
                         )
-                        self.federation.mark_dropped(rec.client_id, addr)
-                        # A rejoin is a fresh process that must re-jit, so
-                        # its first poll is compile-dominated again.
-                        self._poll_warmed.discard(rec.client_id)
                         return rec, None, time.perf_counter() - t0
 
                 with span(m, "poll", parent=round_sp, clients=len(active)):
@@ -369,18 +686,52 @@ class FederatedServer:
                 if m is not None:
                     self._note_round_poll(round_sp, polled, replies)
                 if not replies:
-                    break
+                    # A fully failed round ends the federation only when
+                    # nobody is left to come back (everyone dropped or
+                    # finished); otherwise wait out a backoff tick and let
+                    # probation re-poll.
+                    if not self.federation.active_clients():
+                        break
+                    self._stopping.wait(self.round_backoff_s)
+                    continue
+                # The quorum denominator is the round's full unfinished
+                # membership — INCLUDING suspects still inside their backoff
+                # window (and any drop from this round's poll is already
+                # finished, so it no longer counts). Denominating over only
+                # the polled set would make the quorum vacuous exactly when
+                # it matters: with every peer in backoff, a lone straggler
+                # would be 1/1 and its solo reply would become the average.
+                membership = len(self.federation.active_clients())
+                quorum = max(
+                    1, math.ceil(self.quorum_fraction * membership)
+                )
+                if len(replies) < quorum:
+                    # Below-quorum rounds are SKIPPED, not averaged: a
+                    # weighted average over one straggler would silently
+                    # overwrite every other client's progress with its
+                    # parameters on the next push.
+                    self._skip_below_quorum(
+                        iteration, len(replies), membership, quorum,
+                        "replies",
+                    )
+                    continue
 
                 # 2. sample-weighted average over the shared subset, weighted
                 # by each client's total corpus size (server.py:476-487). The
                 # denominator is THIS round's contributors — clients that
                 # finished early or were dropped must not dilute the average.
                 with span(m, "average", parent=round_sp):
-                    snapshots = [
-                        (rec.nr_samples,
-                         codec.bundle_to_flatdict(reply.shared, metrics=m))
-                        for rec, reply in replies
-                    ]
+                    snapshots = self._collect_snapshots(replies, iteration)
+                    if len(snapshots) < quorum:
+                        # Key-skew exclusions can take a round that passed
+                        # the reply quorum back below it — skip, same as a
+                        # below-quorum poll, so the average never comes
+                        # from fewer contributors than the quorum promises.
+                        self._skip_below_quorum(
+                            iteration, len(snapshots), membership, quorum,
+                            "usable after key validation",
+                        )
+                        continue
                     round_weight = float(sum(w for w, _ in snapshots))
                     keys = snapshots[0][1].keys()
                     average = {
@@ -404,16 +755,13 @@ class FederatedServer:
                             finished=ack.finished,
                         )
                     except Exception as exc:
-                        self.logger.warning(
-                            "dropping client %d after failed ApplyAggregate: %s",
-                            rec.client_id, exc,
-                        )
                         self.federation.update_progress(
                             rec.client_id, reply.current_mb,
                             reply.current_epoch, reply.loss, finished=False,
                         )
-                        self.federation.mark_dropped(rec.client_id, addr)
-                        self._poll_warmed.discard(rec.client_id)
+                        self._note_client_failure(
+                            rec, addr, iteration, exc, "ApplyAggregate"
+                        )
 
                 with span(m, "push", parent=round_sp, clients=len(replies)):
                     list(pool.map(push, replies))
@@ -422,6 +770,12 @@ class FederatedServer:
                         bytes_pushed=agg.ByteSize() * len(replies)
                     )
             self.global_iterations = iteration + 1
+            if (
+                self.checkpoint_every > 0 and self.save_dir is not None
+                and self.last_average is not None
+                and self.global_iterations % self.checkpoint_every == 0
+            ):
+                self._save_round_checkpoint()
             if m is not None and iteration % 50 == 0:
                 # Periodic snapshot alongside the progress event so even a
                 # SIGKILLed run keeps registry state no older than 50 rounds
@@ -433,11 +787,21 @@ class FederatedServer:
                         np.mean([r.loss for _, r in replies])
                     ),
                 )
+        # Final checkpoint so a resume of a finished (or stopped) run does
+        # not replay rounds since the last periodic save.
+        if (
+            self.checkpoint_every > 0 and self.save_dir is not None
+            and self.last_average is not None and not self._aborted.is_set()
+        ):
+            self._save_round_checkpoint()
 
-        # 4. stop broadcast + server-side artifact (server.py:523-551);
-        # every ready client gets the broadcast, stub created if need be.
-        # _stopping goes up first: any ReadyForTraining from here on is
-        # answered code=1 rather than being left waiting for polls.
+    def _stop_broadcast(self, stubs: dict) -> None:
+        # Stop broadcast + server-side artifact (server.py:523-551); every
+        # ready client gets the broadcast, stub created if need be, each
+        # attempt retried with backoff — a client that misses it would
+        # otherwise sit on its liveness watchdog timeout. _stopping goes up
+        # first: any ReadyForTraining from here on is answered code=1
+        # rather than being left waiting for polls.
         self._stopping.set()
         stop = pb.Aggregate(stop=True)
         for rec in self.federation.get_clients():
@@ -447,16 +811,14 @@ class FederatedServer:
             if stub is None:
                 continue
             try:
+                # The stub routes through retry_policy, so the broadcast is
+                # retried with backoff before being given up on.
                 stub.ApplyAggregate(stop)
             except Exception as exc:
                 self.logger.warning(
                     "stop broadcast to client %d failed: %s",
                     rec.client_id, exc,
                 )
-        self._finalize()
-        pool.shutdown(wait=False)
-        for _addr, channel, _stub in stubs.values():
-            channel.close()
 
     def _finalize(self) -> None:
         """Write the aggregated global model (betas only — the server has no

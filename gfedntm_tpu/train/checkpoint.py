@@ -6,10 +6,17 @@ transfer at ``server.py:303-311`` only *starts* clients identically). Here
 the whole federation state — per-client params, batch stats, optimizer state,
 and the global step counter — is one pytree, checkpointed atomically with
 orbax and restored onto the same mesh sharding.
+
+:class:`FederationCheckpointer` extends the same machinery to the NETWORK
+server's round state (``last_average`` + round counter + membership
+snapshot + consensus vocabulary), so a crashed
+:class:`~gfedntm_tpu.federation.server.FederatedServer` resumes from its
+last checkpointed round instead of round 0.
 """
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
@@ -57,3 +64,98 @@ class CheckpointManager:
 
 def _to_numpy(tree: Any) -> Any:
     return jax.tree.map(np.asarray, tree)
+
+
+class FederationCheckpointer:
+    """Round-state checkpoints for the network federation server.
+
+    The numeric state — the shared-subset ``last_average`` — rides the
+    orbax :class:`CheckpointManager` (as a list of arrays: flat-dict keys
+    contain ``/`` which orbax would misread as tree structure, so the key
+    order is pinned in the JSON sidecar instead). Everything orbax cannot
+    hold — the consensus vocabulary, the sorted average keys, and the
+    membership snapshot — lives in an atomically-replaced
+    ``federation.json`` next to the round directories. The orbax
+    ``latest_step`` is the authoritative resume round; the sidecar is
+    rewritten after each array save, so after a crash between the two it is
+    at most one checkpoint stale in membership (never in keys — those are
+    fixed by the model config).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = CheckpointManager(
+            os.path.join(self.directory, "rounds"), max_to_keep=max_to_keep
+        )
+        self.meta_path = os.path.join(self.directory, "federation.json")
+
+    def save_round(
+        self,
+        round_idx: int,
+        average: dict[str, np.ndarray],
+        membership: list[dict[str, Any]],
+        vocab: list[str] | None = None,
+        extra: dict[str, Any] | None = None,
+    ) -> None:
+        keys = sorted(average)
+        # Idempotent per round: the server's final checkpoint can land on
+        # the same round as the last periodic one (orbax raises
+        # StepAlreadyExistsError on a re-save, even with force=True), and
+        # a given round's state is the same state.
+        if self._mgr.latest_step() == int(round_idx):
+            return
+        self._mgr.save(
+            int(round_idx), [np.asarray(average[k]) for k in keys],
+            force=True,
+        )
+        meta = {
+            "round": int(round_idx),
+            "average_keys": keys,
+            "membership": membership,
+            **(extra or {}),
+        }
+        if vocab is not None:
+            meta["vocab"] = list(vocab)
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh)
+        os.replace(tmp, self.meta_path)
+
+    def latest_round(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def load_meta(self) -> dict[str, Any] | None:
+        if not os.path.exists(self.meta_path):
+            return None
+        with open(self.meta_path) as fh:
+            return json.load(fh)
+
+    def restore_round(
+        self, template: dict[str, np.ndarray], step: int | None = None
+    ) -> tuple[int, dict[str, np.ndarray]]:
+        """Restore ``(round_idx, average)``; ``template`` supplies the
+        expected key set and array shapes (e.g. the shared flat subset of a
+        freshly built template model)."""
+        meta = self.load_meta()
+        if meta is None:
+            raise FileNotFoundError(f"no federation meta at {self.meta_path}")
+        keys = meta["average_keys"]
+        missing = [k for k in keys if k not in template]
+        if missing:
+            raise ValueError(
+                f"checkpoint avg keys not in template (model config "
+                f"changed since the checkpoint?): {missing[:3]}"
+            )
+        step = self.latest_round() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no round checkpoint under {self.directory}"
+            )
+        arrays = self._mgr.restore(
+            [np.asarray(template[k]) for k in keys], step=step
+        )
+        return int(step), dict(zip(keys, (np.asarray(a) for a in arrays)))
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -57,6 +57,13 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "metrics_snapshot": frozenset({"metrics"}),
     # RPC failures (successes aggregate into registry histograms only)
     "rpc": frozenset({"service", "method", "seconds", "ok"}),
+    # resilience lifecycle (federation probation / quorum / checkpoint /
+    # client watchdog; see README "Fault tolerance")
+    "client_suspect": frozenset({"client", "failures", "status"}),
+    "client_recovered": frozenset({"client"}),
+    "quorum_skip": frozenset({"round", "got", "needed"}),
+    "checkpoint": frozenset({"round"}),
+    "watchdog_fired": frozenset({"client", "idle_s"}),
     # training progress
     "resume": frozenset({"step"}),
     "epoch": frozenset({"epoch"}),

@@ -30,8 +30,16 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    # Registered in pytest.ini too; duplicated here so the suite also runs
+    # from a rootdir that misses the ini. pytest.ini's `strict_markers`
+    # makes any OTHER marker a collection error.
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end tests (network federation)"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded deterministic fault-injection suite "
+        "(in-process, tier-1)",
     )
 
 

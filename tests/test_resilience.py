@@ -1,0 +1,899 @@
+"""Fault-tolerance suite (tier-1): retry/backoff, client probation, round
+quorum, round checkpoint/resume, the client liveness watchdog, and the
+deterministic fault-injection harness.
+
+The `chaos` tests run real gRPC federations in-process with scripted,
+seeded faults (drop / delay / error-code) injected into the server's
+client stubs or the servicer dispatch path — every recovery path is
+exercised deterministically, no flaky socket games.
+"""
+
+import itertools
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import (
+    ACTIVE,
+    DROPPED,
+    SUSPECT,
+    ClientRecord,
+    Federation,
+)
+from gfedntm_tpu.federation.resilience import (
+    FaultInjector,
+    InjectedRpcError,
+    RetryPolicy,
+    error_code,
+    is_transient,
+)
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model
+from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+from gfedntm_tpu.utils.observability import MetricsLogger, read_metrics
+
+UNAVAILABLE = grpc.StatusCode.UNAVAILABLE
+
+
+# ---- RetryPolicy ------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_transient_classification(self):
+        assert is_transient(InjectedRpcError(UNAVAILABLE, "x"))
+        assert is_transient(
+            InjectedRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED, "x")
+        )
+        assert is_transient(InjectedRpcError(grpc.StatusCode.ABORTED, "x"))
+        assert is_transient(ConnectionRefusedError("refused"))
+        # DEADLINE_EXCEEDED is NOT retried at the RPC layer: the call may
+        # have executed (TrainStep is not idempotent) — probation handles it.
+        assert not is_transient(
+            InjectedRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, "x")
+        )
+        assert not is_transient(ValueError("boom"))
+        assert error_code(ValueError("boom")) is None
+        assert error_code(InjectedRpcError(UNAVAILABLE, "x")) is UNAVAILABLE
+
+    def test_delays_are_seeded_bounded_and_decorrelated(self):
+        p = RetryPolicy(base_delay_s=0.05, max_delay_s=2.0, seed=7)
+        a = list(itertools.islice(p.delays(), 8))
+        b = list(itertools.islice(p.delays(), 8))
+        assert a == b  # same seed -> same jitter sequence
+        assert all(0.05 <= d <= 2.0 for d in a)
+        q = RetryPolicy(base_delay_s=0.05, max_delay_s=2.0, seed=8)
+        assert list(itertools.islice(q.delays(), 8)) != a
+
+    def test_retries_transient_then_succeeds(self):
+        m = MetricsLogger(validate=True)
+        sleeps = []
+        p = RetryPolicy(max_attempts=3, seed=0, metrics=m,
+                        sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise InjectedRpcError(UNAVAILABLE, "blip")
+            return 42
+
+        assert p.call(flaky) == 42
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+        assert m.registry.counter("retry_attempts").value == 2
+        assert m.registry.counter("retry_successes").value == 1
+        assert m.registry.counter("retry_giveups").value == 0
+
+    def test_permanent_error_not_retried(self):
+        p = RetryPolicy(max_attempts=5, sleep=lambda _s: None)
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            p.call(bad)
+        assert calls["n"] == 1
+
+    def test_exhausted_budget_reraises_and_counts_giveup(self):
+        m = MetricsLogger(validate=True)
+        p = RetryPolicy(max_attempts=2, seed=0, metrics=m,
+                        sleep=lambda _s: None)
+        with pytest.raises(InjectedRpcError):
+            p.call(lambda: (_ for _ in ()).throw(
+                InjectedRpcError(UNAVAILABLE, "down")
+            ))
+        assert m.registry.counter("retry_attempts").value == 1
+        assert m.registry.counter("retry_giveups").value == 1
+
+    def test_rejects_zero_attempts(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+
+
+# ---- FaultInjector ----------------------------------------------------------
+
+class TestFaultInjector:
+    def test_scripted_error_fires_n_times_then_clears(self):
+        inj = FaultInjector(seed=0)
+        inj.script("TrainStep", times=2)
+        assert inj.pending("TrainStep") == 2
+        for _ in range(2):
+            with pytest.raises(InjectedRpcError) as err:
+                inj.before_call("svc", "TrainStep")
+            assert err.value.code() is UNAVAILABLE
+        inj.before_call("svc", "TrainStep")  # script exhausted: no-op
+        assert inj.pending() == 0
+        assert [f[0] for f in inj.fired] == ["TrainStep", "TrainStep"]
+
+    def test_drop_is_unavailable_and_peer_scoping(self):
+        inj = FaultInjector(seed=0)
+        spec = inj.script("TrainStep", kind="drop", peer="client2")
+        assert spec.kind == "error" and spec.code is UNAVAILABLE
+        inj.before_call("svc", "TrainStep", peer="client1")  # other peer
+        inj.before_call("svc", "ApplyAggregate", peer="client2")  # other rpc
+        with pytest.raises(InjectedRpcError):
+            inj.before_call("svc", "TrainStep", peer="client2")
+        assert inj.fired == [("TrainStep", "client2", "error")]
+
+    def test_delay_sleeps_and_proceeds(self):
+        slept = []
+        inj = FaultInjector(seed=0, sleep=slept.append)
+        inj.script("TrainStep", kind="delay", delay_s=0.25)
+        inj.before_call("svc", "TrainStep")  # no raise
+        assert slept == [0.25]
+
+    def test_probabilistic_faults_are_seed_deterministic(self):
+        def pattern(seed):
+            inj = FaultInjector(seed=seed)
+            inj.script("M", times=100, probability=0.5)
+            hits = []
+            for i in range(30):
+                try:
+                    inj.before_call("svc", "M")
+                    hits.append(False)
+                except InjectedRpcError:
+                    hits.append(True)
+            return hits
+
+        assert pattern(3) == pattern(3)
+        assert any(pattern(3)) and not all(pattern(3))
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjector().script("M", kind="explode")
+
+    def test_metrics_counter(self):
+        m = MetricsLogger(validate=True)
+        inj = FaultInjector(seed=0, metrics=m)
+        inj.script("M", times=3)
+        for _ in range(3):
+            with pytest.raises(InjectedRpcError):
+                inj.before_call("svc", "M")
+        assert m.registry.counter("faults_injected").value == 3
+
+
+# ---- registry probation + drop/rejoin lifecycle -----------------------------
+
+class TestProbation:
+    def _fed_with_client(self, addr="localhost:1111"):
+        fed = Federation(min_clients=1)
+        fed.connect_vocab(1, ("a", "b"), 4.0)
+        fed.connect_ready(1, addr)
+        return fed
+
+    def test_suspect_backoff_schedule_then_drop(self):
+        fed = self._fed_with_client()
+        assert fed.mark_suspect(1, "localhost:1111", 5) == SUSPECT
+        rec = fed.get_clients()[0]
+        assert rec.consecutive_failures == 1
+        assert rec.next_retry_round == 6  # 2**0 rounds out
+        # inside the backoff window the client is not polled, but the
+        # federation must not end while it is pending
+        assert fed.active_clients(5) == []
+        assert [c.client_id for c in fed.pending_suspects(5)] == [1]
+        assert [c.client_id for c in fed.active_clients(6)] == [1]
+
+        assert fed.mark_suspect(1, "localhost:1111", 6) == SUSPECT
+        assert rec.next_retry_round == 8  # 2**1 rounds out
+        assert fed.mark_suspect(1, "localhost:1111", 8) == DROPPED
+        assert rec.finished and rec.status == DROPPED
+        assert fed.active_clients() == []
+
+    def test_recovery_clears_probation(self):
+        fed = self._fed_with_client()
+        fed.mark_suspect(1, "localhost:1111", 0)
+        assert fed.mark_recovered(1) is True
+        rec = fed.get_clients()[0]
+        assert rec.status == ACTIVE
+        assert rec.consecutive_failures == 0 and rec.next_retry_round == 0
+        # only a genuine SUSPECT->ACTIVE transition counts as a recovery
+        assert fed.mark_recovered(1) is False
+        assert fed.mark_recovered(99) is False
+
+    def test_stale_address_failures_ignored_after_rejoin(self):
+        """A rejoin changes the serving address; in-flight failures against
+        the OLD address must not clobber the fresh registration."""
+        fed = self._fed_with_client("localhost:1111")
+        fed.connect_ready(1, "localhost:2222")  # rejoined on a new port
+        assert fed.mark_suspect(1, "localhost:1111", 3) is None
+        fed.mark_dropped(1, "localhost:1111")
+        rec = fed.get_clients()[0]
+        assert rec.status == ACTIVE and not rec.finished
+        # against the CURRENT address both still act
+        fed.mark_dropped(1, "localhost:2222")
+        assert rec.status == DROPPED and rec.finished
+
+    def test_rejoin_resets_probation_slate(self):
+        fed = self._fed_with_client()
+        fed.mark_suspect(1, "localhost:1111", 0)
+        fed.mark_suspect(1, "localhost:1111", 1)
+        fed.connect_ready(1, "localhost:2222")
+        rec = fed.get_clients()[0]
+        assert rec.status == ACTIVE
+        assert rec.consecutive_failures == 0 and rec.next_retry_round == 0
+        assert not rec.finished
+
+    def test_update_progress_after_disconnect_is_noop(self):
+        fed = self._fed_with_client()
+        fed.disconnect(1)
+        # a push worker may report progress concurrently with disconnect():
+        # a vanished record must be a no-op, not a KeyError
+        fed.update_progress(1, 5, 1, 0.5, finished=False)
+        assert len(fed) == 0
+
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _server(**kw):
+    base = dict(min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS)
+    base.update(kw)
+    return FederatedServer(**base)
+
+
+class TestServerUnits:
+    def test_rejoin_with_new_address_gets_fresh_stub(self):
+        server = _server()
+        stubs = {}
+        rec = ClientRecord(1, address="localhost:7001",
+                           ready_for_training=True)
+        first = server._stub_for(stubs, rec)
+        assert first is not None
+        assert server._stub_for(stubs, rec) is first  # cached while stable
+        rec.address = "localhost:7002"  # rejoin on a new port
+        second = server._stub_for(stubs, rec)
+        assert second is not first
+        assert stubs[1][0] == "localhost:7002"
+        # an address-less record falls back to whatever stub exists
+        rec.address = ""
+        assert server._stub_for(stubs, rec) is second
+        assert server._stub_for({}, ClientRecord(2)) is None
+
+    def test_drop_resets_poll_warm_state(self):
+        """A dropped client that rejoins is a fresh process that must
+        re-jit — its first poll is compile-dominated again and must be
+        excluded from the straggler stats."""
+        server = _server(probation_rounds=1)
+        server.federation.connect_vocab(1, ("a",), 1.0)
+        server.federation.connect_ready(1, "localhost:7001")
+        rec = server.federation.get_clients()[0]
+        server._poll_warmed.add(1)
+        server._note_client_failure(
+            rec, "localhost:7001", 0, RuntimeError("down"), "TrainStep"
+        )
+        assert rec.status == DROPPED
+        assert 1 not in server._poll_warmed
+
+    def test_suspect_keeps_poll_warm_state(self):
+        server = _server(probation_rounds=3)
+        server.federation.connect_vocab(1, ("a",), 1.0)
+        server.federation.connect_ready(1, "localhost:7001")
+        rec = server.federation.get_clients()[0]
+        server._poll_warmed.add(1)
+        server._note_client_failure(
+            rec, "localhost:7001", 0, RuntimeError("blip"), "TrainStep"
+        )
+        assert rec.status == SUSPECT
+        assert 1 in server._poll_warmed
+
+    def test_collect_snapshots_excludes_key_skewed_reply(self):
+        m = MetricsLogger(validate=True)
+        server = _server(metrics=m)
+        server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+        tmpl = server._shared_template()
+        good = pb.StepReply(client_id=1,
+                            shared=codec.flatdict_to_bundle(tmpl))
+        skewed_dict = dict(tmpl)
+        dropped_key = sorted(skewed_dict)[0]
+        skewed_dict.pop(dropped_key)
+        skewed_dict["params/rogue"] = np.zeros(2, np.float32)
+        skewed = pb.StepReply(client_id=2,
+                              shared=codec.flatdict_to_bundle(skewed_dict))
+        out = server._collect_snapshots(
+            [(ClientRecord(1, nr_samples=4.0), good),
+             (ClientRecord(2, nr_samples=2.0), skewed)],
+            iteration=0,
+        )
+        assert len(out) == 1 and out[0][0] == 4.0
+        assert set(out[0][1]) == set(tmpl)
+        assert m.registry.counter("key_skew_excluded").value == 1
+
+    def test_collect_snapshots_excludes_shape_skewed_reply(self):
+        """Same key set over a DIFFERENT consensus vocab (the likelier
+        version skew) must cost the round one contributor, not crash the
+        weighted average with a broadcast error."""
+        m = MetricsLogger(validate=True)
+        server = _server(metrics=m)
+        server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+        tmpl = server._shared_template()
+        good = pb.StepReply(client_id=1,
+                            shared=codec.flatdict_to_bundle(tmpl))
+        stale = {
+            k: np.zeros(v.shape + (2,), v.dtype) if k == sorted(tmpl)[0]
+            else v
+            for k, v in tmpl.items()
+        }
+        skewed = pb.StepReply(client_id=2,
+                              shared=codec.flatdict_to_bundle(stale))
+        out = server._collect_snapshots(
+            [(ClientRecord(1, nr_samples=4.0), good),
+             (ClientRecord(2, nr_samples=2.0), skewed)],
+            iteration=0,
+        )
+        assert len(out) == 1 and out[0][0] == 4.0
+        assert m.registry.counter("key_skew_excluded").value == 1
+
+    def test_stop_joins_training_thread(self):
+        server = _server()
+        t = threading.Thread(target=server._stopping.wait, daemon=True)
+        t.start()
+        server._train_thread = t
+        server.stop(grace=0, join_timeout=5.0)
+        assert server._stopping.is_set()
+        assert not t.is_alive()
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            _server(probation_rounds=0)
+        with pytest.raises(ValueError):
+            _server(quorum_fraction=1.5)
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        server = _server(save_dir=str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            server.restore_from_checkpoint()
+        with pytest.raises(ValueError):
+            _server(save_dir=None)._checkpointer()
+
+
+# ---- round checkpointing ----------------------------------------------------
+
+class TestFederationCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path):
+        ckpt = FederationCheckpointer(str(tmp_path))
+        rng = np.random.default_rng(0)
+        avg = {"params/beta": rng.normal(size=(3, 5)).astype(np.float32),
+               "params/prior_mean": rng.normal(size=3).astype(np.float32)}
+        membership = [{"client_id": 1, "nr_samples": 4.0, "current_mb": 7,
+                       "current_epoch": 1, "finished": False,
+                       "status": "active"}]
+        ckpt.save_round(6, avg, membership, vocab=["a", "b"],
+                        extra={"family": "avitm"})
+        assert ckpt.latest_round() == 6
+        meta = ckpt.load_meta()
+        assert meta["round"] == 6 and meta["vocab"] == ["a", "b"]
+        assert meta["membership"] == membership
+        assert meta["family"] == "avitm"
+
+        template = {k: np.zeros_like(v) for k, v in avg.items()}
+        step, restored = ckpt.restore_round(template)
+        assert step == 6
+        for k in avg:
+            np.testing.assert_allclose(restored[k], avg[k])
+        ckpt.close()
+
+    def test_latest_checkpoint_wins(self, tmp_path):
+        ckpt = FederationCheckpointer(str(tmp_path))
+        avg = {"a": np.full(2, 1.0, np.float32)}
+        ckpt.save_round(2, avg, [], vocab=["x"])
+        ckpt.save_round(4, {"a": np.full(2, 9.0, np.float32)}, [],
+                        vocab=["x"])
+        step, restored = ckpt.restore_round({"a": np.zeros(2, np.float32)})
+        assert step == 4
+        np.testing.assert_allclose(restored["a"], 9.0)
+        ckpt.close()
+
+    def test_resave_of_latest_round_is_noop(self, tmp_path):
+        """The server's final checkpoint can land on the same round as the
+        last periodic one — must be a silent no-op, not an orbax
+        StepAlreadyExistsError."""
+        ckpt = FederationCheckpointer(str(tmp_path))
+        avg = {"a": np.full(2, 1.0, np.float32)}
+        ckpt.save_round(2, avg, [], vocab=["x"])
+        ckpt.save_round(2, avg, [], vocab=["x"])  # duplicate round
+        assert ckpt.latest_round() == 2
+        ckpt.close()
+
+    def test_template_key_mismatch_detected(self, tmp_path):
+        ckpt = FederationCheckpointer(str(tmp_path))
+        ckpt.save_round(1, {"a": np.zeros(2, np.float32)}, [], vocab=["x"])
+        with pytest.raises(ValueError, match="model config"):
+            ckpt.restore_round({"b": np.zeros(2, np.float32)})
+        ckpt.close()
+
+    def test_empty_directory(self, tmp_path):
+        ckpt = FederationCheckpointer(str(tmp_path))
+        assert ckpt.latest_round() is None
+        assert ckpt.load_meta() is None
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_round({"a": np.zeros(2)})
+        ckpt.close()
+
+    def test_server_level_checkpoint_restore(self, tmp_path):
+        """A fresh server process restores vocab + template + average +
+        round counter from a crashed server's checkpoint directory."""
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        tokens = tuple(f"tok{i:02d}" for i in range(30))
+        crashed = _server(save_dir=str(tmp_path), checkpoint_every=1)
+        crashed.global_vocab = Vocabulary(tokens)
+        crashed.template = build_template_model(
+            "avitm", len(tokens), MODEL_KWARGS
+        )
+        crashed.last_average = {
+            k: v + 1.0 for k, v in crashed._shared_template().items()
+        }
+        crashed.global_iterations = 7
+        crashed._save_round_checkpoint()
+
+        resumed = _server(save_dir=str(tmp_path))
+        assert resumed.restore_from_checkpoint() == 7
+        assert resumed.global_iterations == 7
+        assert tuple(resumed.global_vocab.tokens) == tokens
+        assert set(resumed.last_average) == set(crashed.last_average)
+        for k, v in crashed.last_average.items():
+            np.testing.assert_allclose(resumed.last_average[k], v)
+        # the restored average was applied onto the template so rejoining
+        # clients replicate the TRAINED state, not a fresh init
+        assert resumed._setup_reply is not None
+
+
+# ---- client liveness watchdog -----------------------------------------------
+
+class _FakeStepper:
+    def get_results_model(self, save_dir):
+        return {"betas": np.zeros((1, 1), np.float32)}
+
+
+def test_watchdog_self_finalizes_without_server(monkeypatch):
+    """A client whose server vanished (no polls, no stop broadcast) must
+    self-finalize after the liveness window instead of blocking in
+    stopped.wait() forever."""
+    m = MetricsLogger(validate=True)
+    client = Client(
+        client_id=1, corpus=RawCorpus(documents=["a b"]),
+        server_address="localhost:1", metrics=m,
+        liveness_timeout=0.3, watchdog_poll_s=0.05,
+    )
+    monkeypatch.setattr(client, "join_federation", lambda: None)
+    monkeypatch.setattr(client, "serve_training", lambda: None)
+    client.stepper = _FakeStepper()
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "watchdog never fired"
+    assert client.stopped.is_set()
+    assert client.results is not None
+    assert m.registry.counter("watchdog_self_finalized").value == 1
+    assert m.events("watchdog_fired")[0]["client"] == 1
+
+
+def test_watchdog_holds_while_server_call_in_flight(monkeypatch):
+    """An open TrainStep/ApplyAggregate counts as liveness for its whole
+    duration: a local step legitimately running past the liveness window
+    (e.g. a long E-step round) must not trigger a spurious self-finalize."""
+    client = Client(
+        client_id=1, corpus=RawCorpus(documents=["a b"]),
+        server_address="localhost:1",
+        liveness_timeout=0.2, watchdog_poll_s=0.02,
+    )
+    monkeypatch.setattr(client, "join_federation", lambda: None)
+    monkeypatch.setattr(client, "serve_training", lambda: None)
+    client.stepper = _FakeStepper()
+    t = threading.Thread(target=client.run, daemon=True)
+    client._rpc_begin()  # a server call dispatches, then runs "forever"
+    t.start()
+    time.sleep(0.6)  # 3x the liveness window
+    assert t.is_alive(), "watchdog fired during an in-flight call"
+    assert client.results is None
+    client._rpc_end()  # the call returns; idle clock restarts from here
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "watchdog never fired after the call ended"
+    assert client.results is not None
+
+
+def test_watchdog_window_scales_with_local_steps():
+    """The server's poll deadline is 120 + 2E; a StepRequest revealing E
+    must widen the liveness window by the same factor so a slow-but-alive
+    peer's round can't look like a dead server."""
+    client = Client(
+        client_id=1, corpus=RawCorpus(documents=["a b"]),
+        server_address="localhost:1", liveness_timeout=100.0,
+    )
+    client._last_activity = time.monotonic() - 200.0
+    client._note_local_steps(150)  # deadline 420 s → scale 3.5, window 350
+    assert client._deadline_scale == pytest.approx(3.5)
+    assert client._idle_expired() is None
+    client._note_local_steps(1)  # scale ~1.02, window ~102 < 200 idle
+    assert client._idle_expired() == pytest.approx(200.0, abs=5.0)
+
+
+def test_watchdog_disabled_with_zero_timeout(monkeypatch):
+    client = Client(
+        client_id=1, corpus=RawCorpus(documents=["a b"]),
+        server_address="localhost:1",
+        liveness_timeout=0.0, watchdog_poll_s=0.02,
+    )
+    monkeypatch.setattr(client, "join_federation", lambda: None)
+    monkeypatch.setattr(client, "serve_training", lambda: None)
+    client.stepper = _FakeStepper()
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()  # no watchdog: still waiting on the server
+    client._on_stop()  # release it
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+# ---- CLI knobs --------------------------------------------------------------
+
+def test_parser_fault_tolerance_flags():
+    p = build_parser()
+    args = p.parse_args([])
+    assert args.resume is False
+    assert args.checkpoint_every == 25
+    assert args.probation_rounds == 3
+    assert args.quorum_fraction == 0.5
+    assert args.liveness_timeout == 300.0
+    args = p.parse_args(
+        ["--resume", "--checkpoint_every", "5", "--quorum_fraction", "0.8",
+         "--probation_rounds", "2", "--liveness_timeout", "60"]
+    )
+    assert args.resume and args.checkpoint_every == 5
+    assert args.quorum_fraction == 0.8
+    assert args.probation_rounds == 2 and args.liveness_timeout == 60.0
+
+
+# ---- chaos: scripted faults over real gRPC ----------------------------------
+
+def _corpora(n_clients, docs, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(45)]
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+@pytest.mark.chaos
+def test_servicer_side_injection_surfaces_real_grpc_status():
+    """An injector on the SERVICER dispatch path aborts the call with a real
+    gRPC status, which the caller's RetryPolicy then recovers from."""
+
+    class Impl:
+        def OfferVocab(self, request, context):
+            return pb.Ack(code=0, detail="ok")
+
+        def GetGlobalSetup(self, request, context):
+            return pb.GlobalSetup()
+
+        def ReadyForTraining(self, request, context):
+            return pb.Ack(code=0, detail="ok")
+
+    inj = FaultInjector(seed=0)
+    server = rpc.make_server(max_workers=4)
+    rpc.add_service(server, "gfedntm.Federation", Impl(), fault_injector=inj)
+    port = server.add_insecure_port("[::]:0")
+    server.start()
+    try:
+        channel = rpc.make_channel(f"localhost:{port}")
+        plain = rpc.ServiceStub(channel, "gfedntm.Federation",
+                                default_timeout=10.0)
+        inj.script("OfferVocab", times=1)
+        with pytest.raises(grpc.RpcError) as err:
+            plain.OfferVocab(pb.VocabOffer(client_id=1))
+        assert err.value.code() is UNAVAILABLE
+        assert plain.OfferVocab(pb.VocabOffer(client_id=1)).code == 0
+
+        # with a retry policy the same scripted blip is invisible
+        retrying = rpc.ServiceStub(
+            rpc.make_channel(f"localhost:{port}"), "gfedntm.Federation",
+            default_timeout=10.0,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                     max_delay_s=0.02, seed=0),
+        )
+        inj.script("OfferVocab", times=1)
+        assert retrying.OfferVocab(pb.VocabOffer(client_id=1)).code == 0
+        assert len(inj.fired) == 2
+    finally:
+        server.stop(0)
+
+
+@pytest.mark.chaos
+def test_transient_trainstep_faults_recover_with_all_clients(tmp_path):
+    """Acceptance scenario: a 3-client federation where client 1's TrainStep
+    fails transiently for 2 consecutive rounds (in-call retries exhausted
+    both rounds) completes with all 3 clients fully trained, the suspect
+    recovering via probation, and the retry/recovery counters visible in
+    the metrics snapshot."""
+    path = str(tmp_path / "metrics.jsonl")
+    metrics = MetricsLogger(path, validate=True)
+    inj = FaultInjector(seed=0, metrics=metrics)
+    # 5 scripted UNAVAILABLEs against client1 with a 2-attempt retry budget:
+    # round r consumes 2 (failed round #1), round r+1 consumes 2 (failed
+    # round #2, backoff pushes the re-poll 2 rounds out), the re-poll round
+    # consumes 1 then succeeds on the in-call retry (a retry_success).
+    inj.script("TrainStep", times=5, peer="client1")
+    server = FederatedServer(
+        min_clients=3, family="avitm", model_kwargs=MODEL_KWARGS,
+        max_iters=60, save_dir=str(tmp_path / "server"), metrics=metrics,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                 max_delay_s=0.05, seed=1, metrics=metrics),
+        probation_rounds=3, fault_injector=inj, checkpoint_every=0,
+    )
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"client{c + 1}"),
+               metrics=metrics)
+        for c, corpus in enumerate(_corpora(3, docs=40))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=600), "federation did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+        metrics.close()
+
+    # every scripted fault fired against client1's stub, nobody else's
+    assert [f[:2] for f in inj.fired] == [("TrainStep", "client1")] * 5
+
+    # all 3 clients trained to completion and produced artifacts — the
+    # faulted client contributed again after recovery
+    for c in clients:
+        assert c.stopped.is_set() and c.results is not None
+        assert c.stepper.current_epoch == MODEL_KWARGS["num_epochs"]
+        assert c.stepper.finished
+    recs = {r.client_id: r for r in server.federation.get_clients()}
+    assert recs[1].status == ACTIVE  # recovered, never dropped
+    # the faulted client's recorded progress matches its healthy peers'
+    # (reply.current_mb lags the stepper by the final step's accounting,
+    # which lands in the push — identical for all three)
+    assert recs[1].current_mb == recs[2].current_mb == recs[3].current_mb > 0
+
+    reg = metrics.registry
+    assert reg.counter("client_suspect_rounds").value == 2
+    assert reg.counter("client_recoveries").value == 1
+    assert reg.counter("client_drops").value == 0
+    assert reg.counter("retry_attempts").value == 3
+    assert reg.counter("retry_giveups").value == 2
+    assert reg.counter("retry_successes").value == 1
+    assert reg.counter("faults_injected").value == 5
+
+    # ... and the same counters are visible in the persisted snapshot
+    records = read_metrics(path)
+    merged = {}
+    for r in records:
+        if r["event"] == "metrics_snapshot":
+            merged.update(r["metrics"])
+    assert merged["client_recoveries"]["value"] == 1
+    assert merged["client_suspect_rounds"]["value"] == 2
+    assert merged["retry_attempts"]["value"] == 3
+    suspects = [r for r in records if r["event"] == "client_suspect"]
+    recoveries = [r for r in records if r["event"] == "client_recovered"]
+    assert len(suspects) == 2 and len(recoveries) == 1
+    assert all(s["client"] == 1 for s in suspects + recoveries)
+
+
+@pytest.mark.chaos
+def test_below_quorum_rounds_are_skipped_not_averaged(tmp_path):
+    """quorum_fraction=1.0 with one client failing: the two failed rounds
+    AND the backoff round where only the healthy client is pollable are
+    SKIPPED (no average from the lone straggler's parameters — the quorum
+    denominator is the full unfinished membership, suspects included),
+    then the suspect recovers and the run completes."""
+    metrics = MetricsLogger(validate=True)
+    inj = FaultInjector(seed=0)
+    inj.script("TrainStep", times=2, peer="client1")
+    kwargs = dict(MODEL_KWARGS, num_epochs=1)
+    server = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=kwargs,
+        max_iters=40, save_dir=str(tmp_path / "server"), metrics=metrics,
+        retry_policy=RetryPolicy(max_attempts=1, metrics=metrics),
+        quorum_fraction=1.0, probation_rounds=3, fault_injector=inj,
+        round_backoff_s=0.05, checkpoint_every=0,
+    )
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"client{c + 1}"))
+        for c, corpus in enumerate(_corpora(2, docs=40, seed=1))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=600), "federation did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+
+    for c in clients:
+        assert c.stopped.is_set() and c.stepper.finished
+    reg = metrics.registry
+    # 2 failed rounds + the backoff round where the suspect was not
+    # pollable but still counted in the quorum denominator
+    assert reg.counter("quorum_skipped_rounds").value == 3
+    assert reg.counter("client_suspect_rounds").value == 2
+    assert reg.counter("client_recoveries").value == 1
+    assert reg.counter("client_drops").value == 0
+    skips = metrics.events("quorum_skip")
+    assert len(skips) == 3
+    assert all(s["got"] == 1 and s["needed"] == 2 for s in skips)
+
+
+@pytest.mark.chaos
+def test_all_suspect_backoff_repolls_early_without_burning_rounds(tmp_path):
+    """When EVERY pollable client is inside its probation backoff window,
+    the round clock cannot advance, so the server converts the gap to the
+    earliest scheduled retry into wall-clock waiting and re-polls early —
+    it must not burn one max_iters round per backoff tick."""
+    metrics = MetricsLogger(validate=True)
+    inj = FaultInjector(seed=0)
+    inj.script("TrainStep", times=2, peer="client1")
+    server = FederatedServer(
+        min_clients=1, family="avitm",
+        model_kwargs=dict(MODEL_KWARGS, num_epochs=1),
+        max_iters=40, save_dir=str(tmp_path / "server"), metrics=metrics,
+        retry_policy=RetryPolicy(max_attempts=1, metrics=metrics),
+        probation_rounds=3, fault_injector=inj,
+        round_backoff_s=0.05, checkpoint_every=0,
+    )
+    addr = server.start("[::]:0")
+    client = Client(client_id=1, corpus=_corpora(1, docs=40)[0],
+                    server_address=addr, max_features=45,
+                    save_dir=str(tmp_path / "client1"))
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    try:
+        assert server.wait_done(timeout=600), "federation did not finish"
+        t.join(timeout=60)
+    finally:
+        server.stop()
+        client.shutdown()
+
+    assert client.stepper.finished
+    reg = metrics.registry
+    assert reg.counter("client_suspect_rounds").value == 2
+    assert reg.counter("client_recoveries").value == 1
+    # Failures at rounds 0 and 1 push next_retry_round to 3; rounds 0/1
+    # execute (and fail), then the all-suspect window is waited out in
+    # wall-clock and the re-poll lands at round 2 — round index 2, not 3,
+    # proves the backoff wait did not consume a round of the budget.
+    assert metrics.events("client_recovered")[0]["round"] == 2
+
+
+@pytest.mark.chaos
+def test_server_crash_checkpoint_resume(tmp_path):
+    """Acceptance scenario: a hard-killed server's round state survives via
+    the periodic checkpoint; abandoned clients self-finalize on their
+    liveness watchdogs; a fresh server process resumes from the
+    checkpointed round (NOT round 0) and rejoining clients train to
+    completion."""
+    metrics1 = MetricsLogger(str(tmp_path / "run1.jsonl"), validate=True)
+    server1 = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=MODEL_KWARGS,
+        max_iters=60, save_dir=str(tmp_path / "server"), metrics=metrics1,
+        checkpoint_every=2,
+    )
+    addr1 = server1.start("[::]:0")
+    gen1 = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr1,
+               max_features=45, save_dir=str(tmp_path / f"g1c{c + 1}"),
+               metrics=metrics1, liveness_timeout=120.0,
+               watchdog_poll_s=0.1)
+        for c, corpus in enumerate(_corpora(2, docs=40, seed=2))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in gen1]
+    for t in threads:
+        t.start()
+
+    # let it train past the first periodic checkpoint (rounds 0..2 done)
+    deadline = time.time() + 300
+    while time.time() < deadline and server1.global_iterations < 3:
+        time.sleep(0.1)
+    assert server1.global_iterations >= 3, "training never reached round 3"
+    server1.abort()  # SIGKILL-equivalent: no stop broadcast, no finalize
+
+    # the abandoned clients' watchdogs fire once their window elapses
+    for c in gen1:
+        c.liveness_timeout = 0.5
+    for t in threads:
+        t.join(timeout=60)
+    metrics1.close()
+    for c in gen1:
+        assert c.stopped.is_set(), "watchdog never released the client"
+        assert c.results is not None  # self-finalized artifacts
+        c.shutdown()
+    assert metrics1.registry.counter("watchdog_self_finalized").value == 2
+
+    # a fresh server process resumes from the checkpointed round
+    metrics2 = MetricsLogger(str(tmp_path / "run2.jsonl"), validate=True)
+    server2 = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=MODEL_KWARGS,
+        max_iters=60, save_dir=str(tmp_path / "server"), metrics=metrics2,
+        checkpoint_every=2,
+    )
+    resumed_round = server2.restore_from_checkpoint()
+    assert resumed_round >= 2 and resumed_round % 2 == 0
+    assert server2.global_iterations == resumed_round
+    assert set(server2.last_average) == set(server1.last_average)
+
+    addr2 = server2.start("[::]:0")
+    gen2 = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr2,
+               max_features=45, save_dir=str(tmp_path / f"g2c{c + 1}"),
+               metrics=metrics2)
+        for c, corpus in enumerate(_corpora(2, docs=40, seed=2))
+    ]
+    threads2 = [threading.Thread(target=c.run, daemon=True) for c in gen2]
+    for t in threads2:
+        t.start()
+    try:
+        assert server2.wait_done(timeout=600), "resumed run did not finish"
+        for t in threads2:
+            t.join(timeout=60)
+    finally:
+        server2.stop()
+        for c in gen2:
+            c.shutdown()
+        metrics2.close()
+
+    for c in gen2:
+        assert c.stopped.is_set() and c.results is not None
+        assert c.stepper.finished
+    assert server2.global_iterations > resumed_round
+    assert np.isfinite(server2.global_betas).all()
+
+    # the resumed run's telemetry proves it never revisited round 0: the
+    # resume event carries the checkpointed round and every round span of
+    # run 2 is at or beyond it
+    records = read_metrics(str(tmp_path / "run2.jsonl"))
+    resumes = [r for r in records if r["event"] == "resume"]
+    assert resumes and resumes[0]["step"] == resumed_round
+    round_spans = [r for r in records
+                   if r["event"] == "span" and r["name"] == "round"]
+    assert round_spans
+    assert min(s["round"] for s in round_spans) == resumed_round
