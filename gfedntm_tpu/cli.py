@@ -96,6 +96,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--liveness_timeout", type=float, default=300.0,
                    help="client mode: self-finalize if no server activity "
                         "arrives within this many seconds (0 disables)")
+    # Aggregation strategy + wire compression (README "Aggregation
+    # strategies & wire compression").
+    p.add_argument("--aggregator", default="fedavg",
+                   choices=("fedavg", "fedavgm", "fedadam", "fedyogi"),
+                   help="server mode: aggregate-step strategy (fedavg = the "
+                        "reference's sample-weighted average; fedavgm adds "
+                        "server momentum; fedadam/fedyogi apply adaptive "
+                        "server optimizers with state that survives "
+                        "--resume)")
+    p.add_argument("--server_lr", type=float, default=None,
+                   help="server mode: server-optimizer learning rate for "
+                        "fedavgm/fedadam/fedyogi (default: each "
+                        "aggregator's own)")
+    p.add_argument("--wire_codec", type=str, default=None,
+                   help="wire-compression spec, '+'-joined stages of "
+                        "'delta', 'topk:<frac>', 'fp16'/'bf16' (e.g. "
+                        "'delta+topk:0.1+fp16'). Server mode: the "
+                        "federation-wide codec advertised at join time. "
+                        "Client mode: default adopts the server's; an "
+                        "explicit value must match it or the join fails")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -193,6 +213,12 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     from gfedntm_tpu.utils.observability import MetricsLogger
 
     metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
+    aggregator_kwargs = {}
+    if getattr(args, "server_lr", None) is not None:
+        if getattr(args, "aggregator", "fedavg") == "fedavg":
+            raise SystemExit("--server_lr needs a server-optimizer "
+                             "aggregator (fedavgm/fedadam/fedyogi)")
+        aggregator_kwargs["server_lr"] = args.server_lr
     server = FederatedServer(
         min_clients=args.min_clients_federation,
         family=args.model_type,
@@ -205,6 +231,9 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         checkpoint_every=getattr(args, "checkpoint_every", 25),
         probation_rounds=getattr(args, "probation_rounds", 3),
         quorum_fraction=getattr(args, "quorum_fraction", 0.5),
+        aggregator=getattr(args, "aggregator", "fedavg"),
+        aggregator_kwargs=aggregator_kwargs,
+        wire_codec=getattr(args, "wire_codec", None) or "none",
     )
     if getattr(args, "resume", False):
         try:
@@ -255,6 +284,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         save_dir=save_dir,
         metrics=metrics,
         liveness_timeout=getattr(args, "liveness_timeout", 300.0),
+        wire_codec=getattr(args, "wire_codec", None) or "auto",
     )
     client.run()
     client.shutdown()

@@ -24,6 +24,12 @@ from gfedntm_tpu.data.datasets import BowDataset, CTMDataset
 from gfedntm_tpu.data.loaders import RawCorpus
 from gfedntm_tpu.data.vocab import Vocabulary, build_vocabulary, vectorize
 from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation.compression import (
+    DownlinkDecoder,
+    ReferenceMismatch,
+    UplinkEncoder,
+    WireCodec,
+)
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.federated.stepper import FederatedStepper
@@ -42,12 +48,20 @@ class FederatedClientServicer:
 
     def __init__(self, client_id: int, stepper: FederatedStepper,
                  on_stop, logger: logging.Logger, metrics=None,
-                 on_activity=None, on_done=None, on_local_steps=None):
+                 on_activity=None, on_done=None, on_local_steps=None,
+                 uplink: UplinkEncoder | None = None,
+                 downlink: DownlinkDecoder | None = None):
         self.client_id = client_id
         self.stepper = stepper
         self.on_stop = on_stop
         self.logger = logger
         self.metrics = metrics
+        # Negotiated wire-compression sessions (None = identity codec, the
+        # plain codec.py path): `uplink` encodes StepReply snapshots
+        # (delta vs the last applied aggregate + error-feedback residual),
+        # `downlink` decodes Aggregate pushes.
+        self.uplink = uplink
+        self.downlink = downlink
         # Liveness signals for the owning Client's watchdog: every poll or
         # aggregate the server sends proves it is alive. ``on_activity``
         # fires at dispatch, ``on_done`` when the call returns — the pair
@@ -97,11 +111,15 @@ class FederatedClientServicer:
             losses.append(self.stepper.loss)
             if self.metrics is not None:
                 self.metrics.registry.counter("client_polls").inc()
+            if self.uplink is not None:
+                shared = self.uplink.encode(snapshot)
+            else:
+                shared = codec.flatdict_to_bundle(
+                    snapshot, metrics=self.metrics
+                )
             return pb.StepReply(
                 client_id=self.client_id,
-                shared=codec.flatdict_to_bundle(
-                    snapshot, metrics=self.metrics
-                ),
+                shared=shared,
                 loss=float(sum(losses) / len(losses)),
                 nr_samples=self.stepper._last_batch_size,
                 current_mb=self.stepper.current_mb,
@@ -127,9 +145,26 @@ class FederatedClientServicer:
                     client_id=self.client_id, finished=True,
                     current_epoch=self.stepper.current_epoch,
                 )
-            average = codec.bundle_to_flatdict(
-                request.shared, metrics=self.metrics
-            )
+            if self.downlink is not None:
+                try:
+                    average = self.downlink.decode(
+                        request.shared, round_idx=int(request.round)
+                    )
+                except ReferenceMismatch:
+                    self.logger.exception(
+                        "client %d cannot decode the round %d push",
+                        self.client_id, int(request.round),
+                    )
+                    raise
+                if self.uplink is not None:
+                    # The applied aggregate is the next snapshot's delta
+                    # reference — exactly the view the server cached when
+                    # it built this push.
+                    self.uplink.note_aggregate(average, int(request.round))
+            else:
+                average = codec.bundle_to_flatdict(
+                    request.shared, metrics=self.metrics
+                )
             status = self.stepper.delta_update_fit(average)
             if status.epoch_ended:
                 self.logger.info(
@@ -166,6 +201,7 @@ class Client:
         liveness_timeout: float = 300.0,
         watchdog_poll_s: float = 2.0,
         retry_policy=None,
+        wire_codec: str | None = "auto",
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -199,6 +235,13 @@ class Client:
         from gfedntm_tpu.federation.resilience import RetryPolicy
 
         self.retry_policy = retry_policy or RetryPolicy(metrics=metrics)
+        # Wire codec: "auto" adopts whatever the server's GlobalSetup
+        # advertises; an explicit spec must MATCH the server's or the join
+        # fails loudly (negotiation, not silent mis-decoding).
+        self.wire_codec = wire_codec
+        self._codec: WireCodec | None = None
+        self._uplink: UplinkEncoder | None = None
+        self._downlink: DownlinkDecoder | None = None
 
         self.stepper: FederatedStepper | None = None
         self.global_vocab: Vocabulary | None = None
@@ -336,6 +379,7 @@ class Client:
                 timeout=self.setup_timeout,
             )
             self.global_vocab = Vocabulary(tuple(setup.vocab))
+            self._negotiate_codec(setup.codec_id or "none")
             hyper = json.loads(setup.hyperparams_json)
             model = build_template_model(
                 hyper["family"], len(self.global_vocab), hyper["kwargs"]
@@ -392,6 +436,33 @@ class Client:
         with span(self.metrics, "pre_fit", client=self.client_id):
             self.stepper.pre_fit(self.dataset)
 
+    def _negotiate_codec(self, server_codec_id: str) -> None:
+        """Adopt ("auto") or verify (explicit spec) the federation's wire
+        codec, then build the per-direction sessions."""
+        if self.wire_codec in (None, "auto"):
+            self._codec = WireCodec(server_codec_id)
+        else:
+            self._codec = WireCodec(self.wire_codec)
+            if self._codec.codec_id != server_codec_id:
+                raise ValueError(
+                    f"client {self.client_id} configured wire codec "
+                    f"{self._codec.codec_id!r} but the federation runs "
+                    f"{server_codec_id!r}; refusing to join with a "
+                    "mismatched codec"
+                )
+        if not self._codec.identity:
+            self._uplink = UplinkEncoder(self._codec, metrics=self.metrics)
+            self._downlink = DownlinkDecoder(self._codec, metrics=self.metrics)
+        self.logger.info(
+            "client %d negotiated wire codec %r",
+            self.client_id, self._codec.codec_id,
+        )
+        if self.metrics is not None:
+            self.metrics.log(
+                "codec_negotiated", client=self.client_id,
+                codec=self._codec.codec_id,
+            )
+
     def serve_training(self) -> None:
         """Start the in-client servicer and signal readiness
         (``__start_client_server`` + ``__send_ready_for_training``,
@@ -400,6 +471,7 @@ class Client:
             self.client_id, self.stepper, self._on_stop, self.logger,
             metrics=self.metrics, on_activity=self._rpc_begin,
             on_done=self._rpc_end, on_local_steps=self._note_local_steps,
+            uplink=self._uplink, downlink=self._downlink,
         )
         self._servicer = servicer
         self._grpc_server = rpc.make_server(max_workers=4)
@@ -413,8 +485,18 @@ class Client:
             pb.JoinRequest(
                 client_id=self.client_id,
                 address=f"{self.advertise_host}:{port}",
+                codec_id=(
+                    self._codec.codec_id if self._codec is not None
+                    else "none"
+                ),
             )
         )
+        if ack.code == 2:
+            # The server refused the codec this client negotiated — a
+            # mixed fleet must stop here, loudly, not mis-decode rounds.
+            raise RuntimeError(
+                f"client {self.client_id} join rejected: {ack.detail}"
+            )
         if ack.code == 1:
             # Rejoined after the federation already finished: there will be
             # no polls and no stop broadcast — finalize immediately instead

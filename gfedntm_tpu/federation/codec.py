@@ -19,6 +19,7 @@ import time
 from typing import Any, Mapping
 
 import jax
+import ml_dtypes
 import numpy as np
 
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
@@ -31,14 +32,29 @@ ALLOWED_DTYPES = frozenset(
     {"float32", "float64", "bfloat16", "int32", "int64", "uint32", "bool"}
 )
 
+# Dtypes a record's raw payload may be stored in. float16 is wire-only: it
+# exists as a quantized transport format (compression.QuantizeStage), never
+# as a logical model dtype.
+WIRE_DTYPES = ALLOWED_DTYPES | {"float16"}
+
+
+def np_dtype(name: str) -> np.dtype:
+    """numpy dtype for a wire dtype name. bfloat16 is not a stock numpy
+    dtype — it comes from ml_dtypes (a jax dependency), which makes the raw
+    2-byte little-endian payload stable across endpoints."""
+    if name == "bfloat16":
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
 
 def array_to_record(name: str, value: Any) -> pb.TensorRecord:
     arr = np.asarray(value)
     dtype = arr.dtype.name
     if dtype not in ALLOWED_DTYPES:
         raise TypeError(f"dtype {dtype!r} of {name!r} is not serializable")
-    if dtype == "bfloat16":  # no stable raw-buffer format across stacks
-        arr, dtype = arr.astype(np.float32), "float32"
+    # bf16 ships as its raw 2-byte payload (ml_dtypes gives both endpoints
+    # the same buffer layout); the old float32 upcast doubled the wire size
+    # of every bf16 tensor for no fidelity gain.
     return pb.TensorRecord(
         name=name, shape=list(arr.shape), dtype=dtype,
         data=np.ascontiguousarray(arr).tobytes(),
@@ -48,8 +64,19 @@ def array_to_record(name: str, value: Any) -> pb.TensorRecord:
 def record_to_array(record: pb.TensorRecord) -> np.ndarray:
     if record.dtype not in ALLOWED_DTYPES:
         raise TypeError(f"dtype {record.dtype!r} not allowed on the wire")
-    arr = np.frombuffer(record.data, dtype=np.dtype(record.dtype))
-    return arr.reshape(tuple(record.shape)).copy()
+    if record.codec not in ("", "raw"):
+        raise ValueError(
+            f"record {record.name!r} is compressed ({record.codec!r}); "
+            "decode it through federation.compression, not the raw codec"
+        )
+    wire = record.wire_dtype or record.dtype
+    if wire not in WIRE_DTYPES:
+        raise TypeError(f"wire dtype {wire!r} not allowed on the wire")
+    arr = np.frombuffer(record.data, dtype=np_dtype(wire))
+    arr = arr.reshape(tuple(record.shape))
+    if wire != record.dtype:  # quantized transport: upcast to logical dtype
+        return arr.astype(np_dtype(record.dtype))
+    return arr.copy()
 
 
 def _note_codec(metrics, op: str, bundle: pb.TensorBundle,

@@ -45,6 +45,13 @@ import numpy as np
 from gfedntm_tpu.config import SHARE_ALL
 from gfedntm_tpu.data.vocab import Vocabulary
 from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation.aggregation import make_aggregator
+from gfedntm_tpu.federation.compression import (
+    CodecError,
+    DownlinkEncoder,
+    UplinkDecoder,
+    make_codec,
+)
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
@@ -102,6 +109,10 @@ class FederatedServer:
         checkpoint_every: int = 25,
         round_backoff_s: float = 0.5,
         fault_injector=None,
+        aggregator="fedavg",
+        aggregator_kwargs: dict[str, Any] | None = None,
+        wire_codec: str = "none",
+        codec_ref_cache: int = 8,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -137,6 +148,26 @@ class FederatedServer:
         self.checkpoint_every = int(checkpoint_every)
         self.round_backoff_s = float(round_backoff_s)
         self.fault_injector = fault_injector
+        # Aggregation strategy (README "Aggregation strategies & wire
+        # compression"): the round's aggregate step is a strategy call —
+        # FedAvg reproduces the historical inline average bit-for-bit;
+        # FedAvgM/FedAdam/FedYogi carry server-optimizer state across
+        # rounds (checkpointed with the round state, so --resume keeps it).
+        self.aggregator = make_aggregator(
+            aggregator, **(aggregator_kwargs or {})
+        )
+        # Wire codec, negotiated with every client at join time: the
+        # GlobalSetup advertises this id, ReadyForTraining verifies the
+        # client runs the same one (mismatch = Ack code 2, loud on both
+        # ends — never a silent mis-decode).
+        self.wire_codec = make_codec(wire_codec)
+        self._uplink_dec = UplinkDecoder(
+            self.wire_codec, metrics=metrics, max_refs=codec_ref_cache,
+        )
+        self._downlink_enc = DownlinkEncoder(self.wire_codec, metrics=metrics)
+        # Clients that acked the most recent push — a push may only be
+        # delta-encoded when every recipient holds the previous broadcast.
+        self._push_acked: set[int] = set()
 
         # Clients whose compile-dominated first poll has been seen (and
         # excluded from the poll-latency/straggler stats).
@@ -264,6 +295,7 @@ class FederatedServer:
         return pb.GlobalSetup(
             vocab=list(self.global_vocab.tokens),
             model_family=self.family,
+            codec_id=self.wire_codec.codec_id,
             hyperparams_json=json.dumps(hyper),
             init_variables=codec.tree_to_bundle(
                 {"params": self.template.params,
@@ -326,7 +358,12 @@ class FederatedServer:
             self._checkpointer().save_round(
                 self.global_iterations, self.last_average, membership,
                 vocab=list(self.global_vocab.tokens),
-                extra={"family": self.family},
+                extra={
+                    "family": self.family,
+                    "aggregator": self.aggregator.name,
+                    "wire_codec": self.wire_codec.codec_id,
+                },
+                aggregator_state=self.aggregator.state_dict(),
             )
         except Exception:
             self.logger.exception(
@@ -360,6 +397,7 @@ class FederatedServer:
         round_idx, average = ckpt.restore_round(template)
         self.last_average = average
         self.global_iterations = int(round_idx)
+        self._restore_aggregator_state(ckpt, meta, round_idx)
 
         from gfedntm_tpu.federated.stepper import FederatedStepper
 
@@ -376,6 +414,37 @@ class FederatedServer:
             self.metrics.log("resume", step=round_idx)
         return round_idx
 
+    def _restore_aggregator_state(self, ckpt, meta: dict, round_idx) -> None:
+        """Reload the server aggregator's optimizer state saved with the
+        round checkpoint — a resumed FedAdam/FedYogi run must continue its
+        moments, not restart them cold. An aggregator-name mismatch (the
+        operator changed --aggregator between runs) restarts stateless with
+        a loud warning rather than loading foreign moments."""
+        saved_name = meta.get("aggregator")
+        if saved_name is not None and saved_name != self.aggregator.name:
+            self.logger.warning(
+                "checkpoint was written by aggregator %r but this server "
+                "runs %r; server-optimizer state starts fresh",
+                saved_name, self.aggregator.name,
+            )
+            return
+        state = ckpt.load_aggregator_state()
+        if state is None:
+            return
+        state_round, arrays = state
+        if int(state_round) != int(round_idx):
+            self.logger.warning(
+                "aggregator state is from round %d but the round "
+                "checkpoint is %d (crash between the two saves); "
+                "server-optimizer state starts fresh", state_round, round_idx,
+            )
+            return
+        self.aggregator.load_state_dict(arrays)
+        self.logger.info(
+            "restored %s aggregator state (%d arrays) from round %d",
+            self.aggregator.name, len(arrays), state_round,
+        )
+
     def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
         """Client readiness signal; the training thread starts exactly once
         when quorum is reached (``trainFederatedModel``, ``server.py:365-406``).
@@ -384,7 +453,38 @@ class FederatedServer:
         never come."""
         if self._stopping.is_set() or self.training_done.is_set():
             return pb.Ack(code=1, detail="federation already finished")
+        # Codec negotiation: the training phase moves opaque (possibly
+        # delta/sparse/quantized) payloads, so a fleet mixing codecs must
+        # fail at join time, not mis-decode at round time. An empty id is
+        # a pre-negotiation client — compatible only with the identity
+        # codec.
+        client_codec = request.codec_id or "none"
+        if client_codec != self.wire_codec.codec_id:
+            self.logger.error(
+                "client %d runs wire codec %r but this federation "
+                "negotiated %r; rejecting join",
+                request.client_id, client_codec, self.wire_codec.codec_id,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("codec_mismatches").inc()
+                self.metrics.log(
+                    "codec_mismatch", client=request.client_id,
+                    server_codec=self.wire_codec.codec_id,
+                    client_codec=client_codec,
+                )
+            return pb.Ack(
+                code=2,
+                detail=(
+                    f"wire codec mismatch: federation runs "
+                    f"{self.wire_codec.codec_id!r}, client offered "
+                    f"{client_codec!r}"
+                ),
+            )
         self.federation.connect_ready(request.client_id, request.address)
+        # A (re)joining client is a fresh process with no broadcast
+        # reference — it must not count as having acked the last push, or
+        # the next push could be delta-encoded against state it never held.
+        self._push_acked.discard(request.client_id)
         # Re-check after registering: if the training loop began shutting
         # down concurrently, this client may have missed the stop-broadcast
         # snapshot — tell it to finalize on its own. (If it made the
@@ -530,7 +630,30 @@ class FederatedServer:
         m = self.metrics
         snapshots: list[tuple[float, dict[str, np.ndarray]]] = []
         for rec, reply in replies:
-            snap = codec.bundle_to_flatdict(reply.shared, metrics=m)
+            try:
+                if self.wire_codec.identity:
+                    snap = codec.bundle_to_flatdict(reply.shared, metrics=m)
+                else:
+                    snap = self._uplink_dec.decode(reply.shared)
+            except CodecError as err:
+                # A reply the negotiated codec cannot decode (usually a
+                # delta against a broadcast older than the reference
+                # cache) costs the round one contributor; the client still
+                # receives this round's push, which re-syncs its
+                # reference.
+                self.logger.warning(
+                    "round %d: client %d reply not decodable (%s); "
+                    "excluding it from the average",
+                    iteration, rec.client_id, err,
+                )
+                if m is not None:
+                    m.registry.counter("codec_ref_miss").inc()
+                    m.log(
+                        "codec_ref_miss", client=rec.client_id,
+                        ref_round=int(reply.shared.ref_round) - 1,
+                        round=iteration,
+                    )
+                continue
             if frozenset(snap) != self._expected_keys:
                 missing = sorted(self._expected_keys - set(snap))[:3]
                 unexpected = sorted(set(snap) - self._expected_keys)[:3]
@@ -561,6 +684,27 @@ class FederatedServer:
                 continue
             snapshots.append((rec.nr_samples, snap))
         return snapshots
+
+    def _encode_push(
+        self, average: dict[str, np.ndarray], iteration: int, replies: list
+    ) -> pb.Aggregate:
+        """Encode one round's push through the negotiated wire codec. A
+        delta-encoded push is only legal when every recipient holds the
+        previous broadcast (acked it); otherwise the push is
+        self-contained. The client-held view of this push becomes an
+        uplink delta reference for the following rounds."""
+        if self.wire_codec.identity:
+            return pb.Aggregate(
+                shared=codec.flatdict_to_bundle(average, metrics=self.metrics),
+                round=iteration,
+            )
+        repliers = {rec.client_id for rec, _reply in replies}
+        allow_delta = bool(self._push_acked) and repliers <= self._push_acked
+        bundle, client_view = self._downlink_enc.encode(
+            average, round_idx=iteration, allow_delta=allow_delta
+        )
+        self._uplink_dec.note_push(iteration, client_view)
+        return pb.Aggregate(shared=bundle, round=iteration)
 
     def _skip_below_quorum(self, iteration: int, got: int, membership: int,
                            quorum: int, what: str) -> None:
@@ -716,10 +860,14 @@ class FederatedServer:
                     )
                     continue
 
-                # 2. sample-weighted average over the shared subset, weighted
-                # by each client's total corpus size (server.py:476-487). The
-                # denominator is THIS round's contributors — clients that
-                # finished early or were dropped must not dilute the average.
+                # 2. aggregate step over the shared subset: decode + key-
+                # validate the replies, then hand the (weight, snapshot)
+                # pairs to the configured strategy — FedAvg is the
+                # reference's sample-weighted average (server.py:476-487)
+                # bit-for-bit; the adaptive aggregators apply a server
+                # optimizer step toward it. The weight denominator is THIS
+                # round's contributors — clients that finished early or
+                # were dropped must not dilute the average.
                 with span(m, "average", parent=round_sp):
                     snapshots = self._collect_snapshots(replies, iteration)
                     if len(snapshots) < quorum:
@@ -732,18 +880,20 @@ class FederatedServer:
                             "usable after key validation",
                         )
                         continue
-                    round_weight = float(sum(w for w, _ in snapshots))
-                    keys = snapshots[0][1].keys()
-                    average = {
-                        k: sum(w * s[k] for w, s in snapshots) / round_weight
-                        for k in keys
-                    }
-                    self.last_average = average
-                    agg = pb.Aggregate(
-                        shared=codec.flatdict_to_bundle(average, metrics=m)
+                    current = (
+                        self.last_average if self.last_average is not None
+                        else self._shared_template()
                     )
+                    average = self.aggregator.aggregate(
+                        snapshots, current_global=current
+                    )
+                    self.last_average = average
+                    agg = self._encode_push(average, iteration, replies)
 
-                # 3. concurrent push + progress bookkeeping
+                # 3. concurrent push + progress bookkeeping. A push worker
+                # returns the client id iff the client applied the
+                # aggregate — the set of ackers gates whether the NEXT
+                # push may be delta-encoded.
                 def push(item):
                     rec, reply = item
                     addr = rec.address
@@ -754,6 +904,7 @@ class FederatedServer:
                             reply.current_epoch, reply.loss,
                             finished=ack.finished,
                         )
+                        return rec.client_id
                     except Exception as exc:
                         self.federation.update_progress(
                             rec.client_id, reply.current_mb,
@@ -762,9 +913,13 @@ class FederatedServer:
                         self._note_client_failure(
                             rec, addr, iteration, exc, "ApplyAggregate"
                         )
+                        return None
 
                 with span(m, "push", parent=round_sp, clients=len(replies)):
-                    list(pool.map(push, replies))
+                    self._push_acked = {
+                        cid for cid in pool.map(push, replies)
+                        if cid is not None
+                    }
                 if m is not None:
                     round_sp.annotate(
                         bytes_pushed=agg.ByteSize() * len(replies)

@@ -89,6 +89,9 @@ class FederationCheckpointer:
             os.path.join(self.directory, "rounds"), max_to_keep=max_to_keep
         )
         self.meta_path = os.path.join(self.directory, "federation.json")
+        self.aggregator_path = os.path.join(
+            self.directory, "aggregator_state.npz"
+        )
 
     def save_round(
         self,
@@ -97,6 +100,7 @@ class FederationCheckpointer:
         membership: list[dict[str, Any]],
         vocab: list[str] | None = None,
         extra: dict[str, Any] | None = None,
+        aggregator_state: dict[str, np.ndarray] | None = None,
     ) -> None:
         keys = sorted(average)
         # Idempotent per round: the server's final checkpoint can land on
@@ -109,6 +113,22 @@ class FederationCheckpointer:
             int(round_idx), [np.asarray(average[k]) for k in keys],
             force=True,
         )
+        # Server-aggregator optimizer state (FedAvgM/FedAdam momenta — a
+        # flat npz-able array dict, see aggregation.ServerAggregator):
+        # saved NEXT TO the orbax rounds, tagged with its round so a crash
+        # between the two writes is detected at restore instead of pairing
+        # round-R parameters with round-R' moments.
+        if aggregator_state:
+            tmp = self.aggregator_path + ".tmp.npz"
+            with open(tmp, "wb") as fh:
+                np.savez(
+                    fh, __round__=np.int64(round_idx), **aggregator_state
+                )
+            os.replace(tmp, self.aggregator_path)
+        elif os.path.exists(self.aggregator_path):
+            # Stateless aggregator now: a stale state file from an earlier
+            # configuration must not survive to poison a later resume.
+            os.remove(self.aggregator_path)
         meta = {
             "round": int(round_idx),
             "average_keys": keys,
@@ -121,6 +141,17 @@ class FederationCheckpointer:
         with open(tmp, "w") as fh:
             json.dump(meta, fh)
         os.replace(tmp, self.meta_path)
+
+    def load_aggregator_state(
+        self,
+    ) -> "tuple[int, dict[str, np.ndarray]] | None":
+        """The ``(round, arrays)`` saved by the last :meth:`save_round`, or
+        ``None`` when the aggregator was stateless (no file)."""
+        if not os.path.exists(self.aggregator_path):
+            return None
+        with np.load(self.aggregator_path) as data:
+            arrays = {k: data[k] for k in data.files if k != "__round__"}
+            return int(data["__round__"]), arrays
 
     def latest_round(self) -> int | None:
         return self._mgr.latest_step()

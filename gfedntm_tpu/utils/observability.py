@@ -64,6 +64,12 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "quorum_skip": frozenset({"round", "got", "needed"}),
     "checkpoint": frozenset({"round"}),
     "watchdog_fired": frozenset({"client", "idle_s"}),
+    # wire codec negotiation + delta-reference discipline (federation
+    # compression subsystem; see README "Aggregation strategies & wire
+    # compression")
+    "codec_negotiated": frozenset({"client", "codec"}),
+    "codec_mismatch": frozenset({"client", "server_codec", "client_codec"}),
+    "codec_ref_miss": frozenset({"client", "ref_round"}),
     # training progress
     "resume": frozenset({"step"}),
     "epoch": frozenset({"epoch"}),

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Repo check gate: byte-compile everything, lint the telemetry schema, and
+# run the tier-1 test command from ROADMAP.md. Run from anywhere:
+#   scripts/check.sh [extra pytest args...]
+#
+# Environment:
+#   SKIP_TESTS=1   compile + lint only (fast pre-commit loop)
+set -o pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+echo "== compileall =="
+python -m compileall -q gfedntm_tpu || exit 1
+
+echo "== telemetry schema lint =="
+python scripts/lint_telemetry.py || exit 1
+
+echo "== proto codegen drift =="
+# gen_protos is idempotent; if running it CHANGES the pb2, the checked-in
+# module does not match the declared schema.
+PB2=gfedntm_tpu/federation/protos/federated_pb2.py
+before=$(sha256sum "$PB2")
+python scripts/gen_protos.py >/dev/null || exit 1
+after=$(sha256sum "$PB2")
+if [ "$before" != "$after" ]; then
+    echo "federated_pb2.py was stale: commit the scripts/gen_protos.py output" >&2
+    exit 1
+fi
+
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo "== tests skipped (SKIP_TESTS=1) =="
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

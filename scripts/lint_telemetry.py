@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Telemetry-schema lint: every event the codebase emits must be registered.
+
+Scans ``gfedntm_tpu`` (plus ``bench.py``) for ``<logger>.log("<event>", ...)``
+call sites and asserts each event name appears in
+``observability.EVENT_SCHEMAS`` — the documented contract the ``summarize``
+CLI and the JSONL stream validators run on. An unregistered event would
+pass silently in un-validated production loggers and then explode the first
+time a test constructs ``MetricsLogger(validate=True)``; this lint moves
+that failure to check time.
+
+Exit code 0 = clean; 1 = drift (unregistered events listed on stderr).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+#: `<expr>.log("name", ...)` where <expr> ends in a metrics-ish name — the
+#: codebase's MetricsLogger handles are `metrics`, `m`, `logger.metrics`,
+#: `self.metrics`. Python `logging` handles are `logger`/`self.logger` and
+#: use level methods (.info/.warning), never `.log("str")`, so a plain
+#: `.log("` with a string literal first arg is a telemetry emission.
+_LOG_CALL = re.compile(r"""\.log\(\s*\n?\s*["']([a-z][a-z0-9_]*)["']""")
+
+SCAN_ROOTS = ("gfedntm_tpu", "bench.py")
+
+
+def emitted_events() -> dict[str, list[str]]:
+    """Map of event name -> list of ``path:line`` emission sites."""
+    sites: dict[str, list[str]] = {}
+    paths: list[str] = []
+    for root in SCAN_ROOTS:
+        full = os.path.join(REPO, root)
+        if os.path.isfile(full):
+            paths.append(full)
+            continue
+        for dirpath, _dirs, files in os.walk(full):
+            paths.extend(
+                os.path.join(dirpath, f) for f in files if f.endswith(".py")
+            )
+    for path in sorted(paths):
+        text = open(path).read()
+        for m in _LOG_CALL.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            rel = os.path.relpath(path, REPO)
+            sites.setdefault(m.group(1), []).append(f"{rel}:{line}")
+    return sites
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from gfedntm_tpu.utils.observability import EVENT_SCHEMAS
+
+    sites = emitted_events()
+    if not sites:
+        sys.stderr.write("lint_telemetry: found no .log() call sites — "
+                         "the scanner regex is probably broken\n")
+        return 1
+    drift = {
+        name: where for name, where in sites.items()
+        if name not in EVENT_SCHEMAS
+    }
+    if drift:
+        sys.stderr.write(
+            "telemetry schema drift: events emitted but not registered in "
+            "observability.EVENT_SCHEMAS:\n"
+        )
+        for name, where in sorted(drift.items()):
+            sys.stderr.write(f"  {name!r}: {', '.join(where)}\n")
+        return 1
+    print(
+        f"telemetry lint: {len(sites)} distinct events across "
+        f"{sum(len(w) for w in sites.values())} call sites, all registered"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,593 @@
+"""Aggregation strategies + wire compression (the federation comms PR).
+
+Three layers:
+
+1. raw codec invariants — roundtrips across EVERY ``ALLOWED_DTYPES`` entry
+   (incl. bool, uint32, zero-size and 0-d arrays) and the bf16 wire-size
+   regression (raw 2-byte payload, not a float32 upcast);
+2. compression/aggregation units — spec parsing + negotiation ids, exact
+   recovery where the codec is lossless, the error-feedback invariant
+   (dropped mass is delivered, not lost), delta reference discipline
+   (loud :class:`ReferenceMismatch`, never a mis-decode), FedAvg
+   bit-for-bit vs the historical inline average, adaptive-aggregator state
+   roundtrips through ``FederationCheckpointer``;
+3. end-to-end federations over localhost gRPC — 3 clients converging under
+   ``fedadam`` and under ``delta+topk+fp16`` compression with a >2x
+   measured wire reduction, and codec-mismatch joins failing loudly.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation import codec
+from gfedntm_tpu.federation.aggregation import (
+    AGGREGATORS,
+    FedAvg,
+    make_aggregator,
+    weighted_mean,
+)
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.compression import (
+    DownlinkDecoder,
+    DownlinkEncoder,
+    ReferenceMismatch,
+    UplinkDecoder,
+    UplinkEncoder,
+    WireCodec,
+)
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.server import FederatedServer
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+
+# ---- 1. raw codec: every allowed dtype roundtrips ---------------------------
+
+def _sample_array(dtype: str, rng) -> list:
+    """Representative arrays per dtype: regular, 0-d, and zero-size."""
+    if dtype == "bool":
+        base = rng.integers(0, 2, size=(3, 4)).astype(bool)
+    elif dtype in ("int32", "int64", "uint32"):
+        base = rng.integers(0, 1000, size=(3, 4)).astype(dtype)
+    elif dtype == "bfloat16":
+        import ml_dtypes
+
+        base = rng.normal(size=(3, 4)).astype(ml_dtypes.bfloat16)
+    else:
+        base = rng.normal(size=(3, 4)).astype(dtype)
+    return [
+        base,
+        base.reshape(-1)[0].reshape(()),  # 0-d scalar
+        base[:0],                         # zero-size, shape (0, 4)
+    ]
+
+
+@pytest.mark.parametrize("dtype", sorted(codec.ALLOWED_DTYPES))
+def test_record_roundtrip_every_allowed_dtype(dtype):
+    rng = np.random.default_rng(0)
+    for arr in _sample_array(dtype, rng):
+        rec = codec.array_to_record("x", arr)
+        out = codec.record_to_array(rec)
+        assert out.dtype == arr.dtype, dtype
+        assert out.shape == arr.shape, dtype
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_bfloat16_ships_two_bytes_per_element():
+    """Satellite regression: bf16 used to be upcast to float32 before
+    serialization, doubling its wire size — the record must now carry the
+    raw 2-byte payload and declare dtype bfloat16."""
+    import ml_dtypes
+
+    arr = np.arange(64, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    rec = codec.array_to_record("b", arr)
+    assert rec.dtype == "bfloat16"
+    assert len(rec.data) == 2 * arr.size
+    out = codec.record_to_array(rec)
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_record_to_array_rejects_compressed_records():
+    """Compressed records must go through federation.compression — the raw
+    codec refuses them instead of misreading the payload."""
+    rec = pb.TensorRecord(
+        name="x", shape=[4], dtype="float32", codec="topk",
+        data=np.zeros(1, np.float32).tobytes(), aux=b"\0\0\0\0",
+    )
+    with pytest.raises(ValueError, match="compress"):
+        codec.record_to_array(rec)
+
+
+def test_record_wire_dtype_upcasts():
+    """A fp16-quantized record decodes back at its logical dtype."""
+    vals = np.array([0.5, -1.25, 3.0], np.float32)
+    rec = pb.TensorRecord(
+        name="x", shape=[3], dtype="float32", wire_dtype="float16",
+        data=vals.astype(np.float16).tobytes(),
+    )
+    out = codec.record_to_array(rec)
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, vals)  # fp16-exact values
+
+
+# ---- 2a. codec spec parsing / negotiation ids -------------------------------
+
+class TestWireCodecSpec:
+    def test_identity_spellings(self):
+        for spec in (None, "", "none", "identity"):
+            c = WireCodec(spec)
+            assert c.identity and c.codec_id == "none" and not c.lossy
+
+    def test_canonical_order_and_topk_implies_delta(self):
+        c = WireCodec("fp16+topk:0.1")
+        assert c.codec_id == "delta+topk:0.1+fp16"
+        assert c.delta and c.lossy
+
+    def test_bad_specs(self):
+        for bad in ("gzip", "topk:0", "topk:1.5", "fp16+bf16"):
+            with pytest.raises(ValueError):
+                WireCodec(bad)
+
+    def test_roundtrip_of_canonical_id(self):
+        for spec in ("delta", "fp16", "bf16", "delta+fp16",
+                     "delta+topk:0.25+bf16"):
+            assert WireCodec(WireCodec(spec).codec_id).codec_id == \
+                WireCodec(spec).codec_id
+
+
+# ---- 2b. compression sessions: recovery + EF + reference discipline ---------
+
+def _tensors(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params/beta": rng.normal(size=(4, 16)).astype(np.float32),
+        "params/prior_mean": rng.normal(size=(4,)).astype(np.float32),
+        "batch_stats/count": np.array(7, np.int64),  # non-float rides raw
+        "params/empty": np.zeros((0, 3), np.float32),
+    }
+
+
+def _pipe(spec):
+    c = WireCodec(spec)
+    return UplinkEncoder(c), UplinkDecoder(c)
+
+
+def test_identity_and_quant_exact_recovery():
+    x = _tensors()
+    # identity: bitwise; fp16 with fp16-exact values: bitwise too
+    exact = {k: (np.round(v * 4) / 4).astype(v.dtype) for k, v in x.items()}
+    for spec in ("none", "fp16"):
+        enc, dec = _pipe(spec)
+        out = dec.decode(enc.encode(exact))
+        assert set(out) == set(exact)
+        for k in exact:
+            assert out[k].dtype == exact[k].dtype
+            np.testing.assert_array_equal(out[k], exact[k], err_msg=spec)
+
+
+def test_delta_without_lossy_stages_recovers_closely():
+    enc, dec = _pipe("delta")
+    assert enc.residual is None  # lossless codec carries no residual
+    x = _tensors()
+    ref = {k: v * 0.5 for k, v in x.items()}
+    enc.note_aggregate(ref, 3)
+    dec.note_push(3, ref)
+    bundle = enc.encode(x)
+    assert bundle.ref_round == 4  # round + 1 on the wire (0 = no ref)
+    out = dec.decode(bundle)
+    for k in x:
+        np.testing.assert_allclose(out[k], x[k], rtol=1e-6, atol=1e-7)
+
+
+def test_topk_first_round_falls_back_to_dense():
+    """With no reference, top-k would zero most of the model — the first
+    bundle must ship dense instead."""
+    enc, dec = _pipe("delta+topk:0.1")
+    x = _tensors()
+    bundle = enc.encode(x)
+    assert bundle.ref_round == 0
+    out = dec.decode(bundle)
+    for k in x:
+        np.testing.assert_array_equal(out[k], x[k])
+
+
+def test_error_feedback_delivers_dropped_mass():
+    """The EF invariant, in protocol shape: the client's state is
+    overwritten by each applied aggregate, so whatever top-k dropped
+    survives ONLY in the residual — and must arrive within the following
+    rounds rather than being lost."""
+    enc, dec = _pipe("delta+topk:0.5")
+    x = {"w": np.arange(1.0, 17.0, dtype=np.float32)}
+    zero = {"w": np.zeros(16, np.float32)}
+    enc.note_aggregate(zero, 0)
+    dec.note_push(0, zero)
+
+    out1 = dec.decode(enc.encode(x))
+    dropped = out1["w"] == 0
+    assert 0 < dropped.sum() <= 8  # half the mass was withheld
+    # residual holds EXACTLY what was not delivered (the EF invariant)
+    np.testing.assert_array_equal(enc.residual["w"], x["w"] - out1["w"])
+
+    # protocol turn: the aggregate the client applies IS the decoded view
+    enc.note_aggregate(out1, 1)
+    dec.note_push(1, out1)
+    # client took no further local step: the next bundle is pure residual
+    out2 = dec.decode(enc.encode(out1))
+    np.testing.assert_array_equal(out2["w"], x["w"] - out1["w"] + out1["w"])
+    np.testing.assert_array_equal(enc.residual["w"], np.zeros(16, np.float32))
+
+
+def test_reference_mismatch_fails_loudly():
+    enc, dec = _pipe("delta+fp16")
+    x = _tensors()
+    ref = {k: v * 0.9 for k, v in x.items()}
+    enc.note_aggregate(ref, 5)
+    bundle = enc.encode(x)
+    with pytest.raises(ReferenceMismatch):
+        dec.decode(bundle)  # decoder never saw round 5's broadcast
+
+
+def test_uplink_reference_cache_evicts_oldest():
+    c = WireCodec("delta")
+    dec = UplinkDecoder(c, max_refs=2)
+    for r in range(4):
+        dec.note_push(r, {"w": np.full(3, float(r), np.float32)})
+    enc = UplinkEncoder(c)
+    enc.note_aggregate({"w": np.zeros(3, np.float32)}, 0)
+    with pytest.raises(ReferenceMismatch):
+        dec.decode(enc.encode({"w": np.ones(3, np.float32)}))
+    enc.note_aggregate({"w": np.full(3, 3.0, np.float32)}, 3)
+    out = dec.decode(enc.encode({"w": np.full(3, 3.5, np.float32)}))
+    np.testing.assert_allclose(out["w"], 3.5, rtol=1e-6)
+
+
+def test_downlink_delta_chain_and_client_view_equality():
+    """The server's cached client_view must equal bitwise what the client
+    reconstructs — that equality is what makes uplink deltas decodable."""
+    c = WireCodec("delta+topk:0.3+fp16")
+    down_enc = DownlinkEncoder(c)
+    down_dec = DownlinkDecoder(c)
+    rng = np.random.default_rng(1)
+    avg = {"w": rng.normal(size=(8, 8)).astype(np.float32)}
+    for r in range(4):
+        bundle, view = down_enc.encode(avg, round_idx=r, allow_delta=r > 0)
+        applied = down_dec.decode(bundle, round_idx=r)
+        for k in avg:
+            np.testing.assert_array_equal(applied[k], view[k])
+        avg = {"w": avg["w"] * 0.95 + 0.01}
+
+
+def test_compression_shrinks_wire_bytes():
+    m = MetricsLogger(validate=True)
+    c = WireCodec("delta+topk:0.1+fp16")
+    enc = UplinkEncoder(c, metrics=m)
+    dec = UplinkDecoder(c, metrics=m)
+    rng = np.random.default_rng(2)
+    x = {"w": rng.normal(size=(64, 64)).astype(np.float32)}
+    ref = {"w": x["w"] * 0.999}
+    enc.note_aggregate(ref, 0)
+    dec.note_push(0, ref)
+    dec.decode(enc.encode(x))
+    snap = m.registry.snapshot()
+    raw = snap["uncompressed_bytes_sent"]["value"]
+    wire = snap["compressed_bytes_sent"]["value"]
+    assert wire < raw / 4
+    assert snap["compression_ratio_sent"]["value"] > 4
+
+
+# ---- 2c. aggregators --------------------------------------------------------
+
+def _snapshots(seed=0, n=3):
+    rng = np.random.default_rng(seed)
+    keys = ("params/beta", "params/prior_mean")
+    shapes = {(k): (5, 11) if "beta" in k else (5,) for k in keys}
+    return [
+        (
+            float(rng.integers(10, 200)),
+            {k: rng.normal(size=shapes[k]).astype(np.float32) for k in keys},
+        )
+        for _ in range(n)
+    ]
+
+
+def test_fedavg_bitwise_matches_inline_path():
+    """Acceptance: with --aggregator fedavg the round average must be
+    numerically IDENTICAL to the historical inline expression."""
+    snapshots = _snapshots()
+    # the exact expression (and operand order) server.py used inline
+    round_weight = float(sum(w for w, _ in snapshots))
+    keys = snapshots[0][1].keys()
+    inline = {
+        k: sum(w * s[k] for w, s in snapshots) / round_weight for k in keys
+    }
+    current = {k: np.zeros_like(v) for k, v in snapshots[0][1].items()}
+    for out in (
+        FedAvg().aggregate(snapshots, current),
+        weighted_mean(snapshots),
+    ):
+        assert set(out) == set(inline)
+        for k in inline:
+            np.testing.assert_array_equal(out[k], inline[k])
+
+
+def test_make_aggregator_names_and_errors():
+    for name in ("fedavg", "fedavgm", "fedadam", "fedyogi"):
+        assert make_aggregator(name).name == name
+    assert set(AGGREGATORS) == {"fedavg", "fedavgm", "fedadam", "fedyogi"}
+    with pytest.raises(ValueError):
+        make_aggregator("fedprox")
+    inst = FedAvg()
+    assert make_aggregator(inst) is inst
+
+
+def test_fedavgm_accumulates_momentum():
+    ag = make_aggregator("fedavgm", server_lr=1.0, beta=0.5)
+    snaps = [(1.0, {"w": np.ones(4, np.float32)})]
+    cur = {"w": np.zeros(4, np.float32)}
+    out1 = ag.aggregate(snaps, cur)            # m = 1      -> x = 1
+    out2 = ag.aggregate(snaps, out1)           # m = .5*1+0 -> x = 1.5
+    np.testing.assert_allclose(out1["w"], 1.0)
+    np.testing.assert_allclose(out2["w"], 1.5)
+
+
+def test_adaptive_aggregators_state_roundtrip():
+    for name in ("fedavgm", "fedadam", "fedyogi"):
+        ag = make_aggregator(name)
+        snaps = _snapshots(seed=3)
+        cur = {k: np.zeros_like(v) for k, v in snaps[0][1].items()}
+        out = ag.aggregate(snaps, cur)
+        state = ag.state_dict()
+        assert state  # stateful
+        twin = make_aggregator(name)
+        twin.load_state_dict(state)
+        a = ag.aggregate(snaps, out)
+        b = twin.aggregate(snaps, out)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=name)
+
+
+def test_stateless_aggregator_rejects_foreign_state():
+    with pytest.raises(ValueError):
+        FedAvg().load_state_dict({"m::w": np.zeros(2)})
+
+
+def test_aggregator_state_survives_checkpointer(tmp_path):
+    from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+    ag = make_aggregator("fedadam")
+    snaps = _snapshots(seed=4)
+    cur = {k: np.zeros_like(v) for k, v in snaps[0][1].items()}
+    avg = ag.aggregate(snaps, cur)
+
+    ckpt = FederationCheckpointer(str(tmp_path))
+    ckpt.save_round(
+        12, avg, membership=[], vocab=["a", "b"],
+        extra={"aggregator": ag.name},
+        aggregator_state=ag.state_dict(),
+    )
+    state = ckpt.load_aggregator_state()
+    assert state is not None
+    round_idx, arrays = state
+    assert round_idx == 12
+    twin = make_aggregator("fedadam")
+    twin.load_state_dict(arrays)
+    a = ag.aggregate(snaps, avg)
+    b = twin.aggregate(snaps, avg)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    ckpt.close()
+
+
+def test_checkpointer_clears_stale_aggregator_state(tmp_path):
+    """A later stateless-aggregator save must remove the previous
+    configuration's state file so a resume cannot load foreign moments."""
+    from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+    avg = {"w": np.ones(3, np.float32)}
+    ckpt = FederationCheckpointer(str(tmp_path))
+    ckpt.save_round(5, avg, membership=[],
+                    aggregator_state={"m::w": np.ones(3, np.float32)})
+    assert ckpt.load_aggregator_state() is not None
+    ckpt.save_round(10, avg, membership=[], aggregator_state=None)
+    assert ckpt.load_aggregator_state() is None
+    ckpt.close()
+
+
+# ---- 3. end-to-end federations over localhost gRPC --------------------------
+
+def _make_corpora(n_clients: int, docs: int = 18, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    words = [f"word{i:03d}" for i in range(90)]
+    corpora = []
+    for c in range(n_clients):
+        lo = 20 * c
+        corpora.append(RawCorpus(documents=[
+            " ".join(rng.choice(words[lo:lo + 60], size=25))
+            for _ in range(docs)
+        ]))
+    return corpora
+
+
+_MODEL_KW = dict(
+    n_components=3, hidden_sizes=(8, 8), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _run_federation(tmp_path, metrics, aggregator="fedavg",
+                    wire_codec="none", n_clients=3):
+    server = FederatedServer(
+        min_clients=n_clients, family="avitm", model_kwargs=dict(_MODEL_KW),
+        max_iters=300, save_dir=str(tmp_path / "server"),
+        aggregator=aggregator, wire_codec=wire_codec, metrics=metrics,
+    )
+    addr = server.start("[::]:0")
+    clients = [
+        Client(
+            client_id=c + 1, corpus=corp, server_address=addr,
+            max_features=80, save_dir=str(tmp_path / f"client{c + 1}"),
+            metrics=metrics,
+        )
+        for c, corp in enumerate(_make_corpora(n_clients))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    finished = server.wait_done(timeout=240)
+    for t in threads:
+        t.join(timeout=30)
+    assert finished, f"{aggregator}/{wire_codec} federation did not finish"
+    for c in clients:
+        assert c.stopped.is_set() and c.results is not None
+        assert c.stepper.finished
+        assert np.isfinite(c.results["betas"]).all()
+    assert np.isfinite(server.global_betas).all()
+    server.stop()
+    for c in clients:
+        c.shutdown()
+    return server, clients
+
+
+def test_e2e_fedadam_three_clients_converges(tmp_path):
+    """Acceptance: a chaos-free 3-client federation converges under the
+    fedadam server optimizer (completes its epochs, finite artifacts)."""
+    m = MetricsLogger(validate=True)
+    server, clients = _run_federation(tmp_path, m, aggregator="fedadam")
+    assert server.aggregator.name == "fedadam"
+    assert server.aggregator.state_dict()  # moments actually accumulated
+    losses = [c.stepper.epoch_losses[-1] for c in clients]
+    assert all(np.isfinite(losses))
+
+
+@pytest.mark.parametrize("aggregator", ["fedavgm", "fedyogi"])
+def test_e2e_remaining_aggregators_converge(tmp_path, aggregator):
+    """Every shipped aggregator completes a 2-client federation with
+    finite artifacts and accumulated server-optimizer state."""
+    m = MetricsLogger(validate=True)
+    server, _clients = _run_federation(
+        tmp_path, m, aggregator=aggregator, n_clients=2
+    )
+    assert server.aggregator.name == aggregator
+    assert server.aggregator.state_dict()
+
+
+def test_e2e_topk_compression_with_error_feedback(tmp_path):
+    """Acceptance: 3 clients converge under delta+topk+fp16 with
+    client-side error feedback, and telemetry reports a >2x wire
+    reduction for the run."""
+    m = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, m, wire_codec="delta+topk:0.1+fp16"
+    )
+    # every client negotiated the canonical codec id
+    negotiated = m.events("codec_negotiated")
+    assert {e["codec"] for e in negotiated} == {"delta+topk:0.1+fp16"}
+    assert len(negotiated) == 3
+    # error feedback actually engaged client-side
+    assert any(
+        c._uplink is not None and c._uplink.residual for c in clients
+    )
+    snap = m.registry.snapshot()
+    raw = snap["uncompressed_bytes_sent"]["value"]
+    wire = snap["compressed_bytes_sent"]["value"]
+    assert wire > 0 and raw / wire > 2.0, (raw, wire)
+    assert snap["compression_ratio_sent"]["value"] > 2.0
+    # decode path verified end-to-end: recv ratio compresses too
+    assert snap["compression_ratio_recv"]["value"] > 2.0
+
+
+def test_e2e_fedavg_identity_unchanged_defaults(tmp_path):
+    """Default server (fedavg + identity codec): StepReply/Aggregate
+    bundles stay raw (self-contained) and negotiation yields 'none'."""
+    m = MetricsLogger(validate=True)
+    server, clients = _run_federation(tmp_path, m, n_clients=2)
+    assert server.wire_codec.identity
+    assert {e["codec"] for e in m.events("codec_negotiated")} == {"none"}
+    assert all(c._uplink is None and c._downlink is None for c in clients)
+
+
+def test_codec_mismatch_rejected_at_join():
+    """Mixed fleets must fail loudly at ReadyForTraining (Ack code 2)."""
+    m = MetricsLogger(validate=True)
+    server = FederatedServer(
+        min_clients=1, family="avitm", model_kwargs=dict(_MODEL_KW),
+        wire_codec="delta+fp16", metrics=m,
+    )
+    ack = server.ReadyForTraining(
+        pb.JoinRequest(client_id=3, address="localhost:1", codec_id="none"),
+        None,
+    )
+    assert ack.code == 2
+    assert "delta+fp16" in ack.detail
+    assert len(server.federation) == 0  # turned away before registration
+    assert m.events("codec_mismatch")
+
+
+def test_client_explicit_codec_mismatch_raises():
+    """A client configured with an explicit codec refuses a federation
+    advertising a different one (fail loudly, never mis-decode)."""
+    client = Client(
+        client_id=1, corpus=_make_corpora(1)[0],
+        server_address="localhost:1", wire_codec="fp16",
+    )
+    with pytest.raises(ValueError, match="mismatch"):
+        client._negotiate_codec("delta+topk:0.1+fp16")
+
+
+def test_client_auto_adopts_server_codec():
+    client = Client(
+        client_id=1, corpus=_make_corpora(1)[0],
+        server_address="localhost:1",
+    )
+    client._negotiate_codec("delta+topk:0.5+bf16")
+    assert client._codec.codec_id == "delta+topk:0.5+bf16"
+    assert client._uplink is not None and client._downlink is not None
+
+
+@pytest.mark.slow
+def test_e2e_fedadam_resume_keeps_optimizer_state(tmp_path):
+    """--resume continuity for the server optimizer: a fedadam federation
+    checkpointed mid-run restores with its moments, not cold state."""
+    m = MetricsLogger(validate=True)
+    server = FederatedServer(
+        min_clients=1, family="avitm", model_kwargs=dict(_MODEL_KW),
+        max_iters=300, save_dir=str(tmp_path / "server"),
+        aggregator="fedadam", checkpoint_every=2, metrics=m,
+    )
+    addr = server.start("[::]:0")
+    client = Client(
+        client_id=1, corpus=_make_corpora(1, docs=30)[0],
+        server_address=addr, max_features=80,
+        save_dir=str(tmp_path / "c1"),
+    )
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    assert server.wait_done(timeout=240)
+    t.join(timeout=30)
+    saved_state = server.aggregator.state_dict()
+    assert saved_state
+    server.stop()
+    client.shutdown()
+
+    server2 = FederatedServer(
+        min_clients=1, family="avitm", model_kwargs=dict(_MODEL_KW),
+        max_iters=300, save_dir=str(tmp_path / "server"),
+        aggregator="fedadam",
+    )
+    restored_round = server2.restore_from_checkpoint()
+    assert restored_round > 0
+    state2 = server2.aggregator.state_dict()
+    assert set(state2) == set(saved_state)
+    for k in saved_state:
+        np.testing.assert_array_equal(state2[k], saved_state[k])
+
+    # a config change falls back to fresh state with a warning, not a load
+    server3 = FederatedServer(
+        min_clients=1, family="avitm", model_kwargs=dict(_MODEL_KW),
+        max_iters=300, save_dir=str(tmp_path / "server"),
+        aggregator="fedyogi",
+    )
+    server3.restore_from_checkpoint()
+    assert not server3.aggregator.state_dict()

@@ -90,7 +90,7 @@ class TestMainOrchestration:
     cached artifact instead of a CPU number."""
 
     def _run_main(self, monkeypatch, capsys, phase_results, backend="axon",
-                  artifact_dir=None):
+                  artifact_dir=None, budget_s=3600.0):
         calls = []
 
         def fake_run_phase(phase, bk, timeout_s, retries=1):
@@ -101,6 +101,10 @@ class TestMainOrchestration:
         monkeypatch.setattr(bench_mod, "_run_phase", fake_run_phase)
         monkeypatch.setattr(bench_mod.sys, "argv", ["bench.py"])
         monkeypatch.setenv("BENCH_NO_GIT", "1")
+        # Phases are faked (instant), so a generous default budget keeps
+        # these tests about orchestration order, not budget clamping; the
+        # budget tests below pin the clamping itself.
+        monkeypatch.setenv("BENCH_BUDGET_S", str(budget_s))
         if artifact_dir is not None:
             monkeypatch.setattr(
                 bench_mod, "_TPU_ARTIFACT",
@@ -145,6 +149,40 @@ class TestMainOrchestration:
         )
         assert result["provenance"] == "live-cpu-degraded"
         assert result["backend"] == "cpu"
+
+    def test_budget_clamps_deadlines_and_skips_escalation(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """BENCH_r01-r05 regression: the old internal schedule (720 s +
+        1440 s escalation + 1800 s CPU fallback) could legally run ~65 min
+        under the harness's hard 720 s deadline -> rc=124 and no JSON.
+        Under a small BENCH_BUDGET_S every deadline is clamped, the 2x
+        escalation is skipped when it cannot fit, and the run still emits
+        a parseable summary."""
+        cpu_summary = {"metric": "m", "value": 1.0, "backend": "cpu"}
+        result, calls = self._run_main(
+            monkeypatch, capsys, [None, cpu_summary, None],
+            artifact_dir=tmp_path / "missing", budget_s=300.0,
+        )
+        assert result["provenance"] == "live-cpu-degraded"
+        # one TPU attempt (clamped below the 720 s default), then straight
+        # to the CPU fallback — no 2x escalation inside a 300 s budget
+        assert [c[1] for c in calls[:2]] == ["axon", "cpu"]
+        assert calls[0][2] <= 300.0 - 240.0 + 1.0 or calls[0][2] == 60.0
+        assert all(c[2] <= 300.0 for c in calls)
+
+    def test_budget_exhaustion_skips_fused_phase(self, monkeypatch, capsys,
+                                                 tmp_path):
+        """A main phase that ate the whole budget leaves a summary whose
+        fused_largev_error says the phase was skipped for budget — not a
+        silent absence, and no over-budget subprocess."""
+        summary = {"metric": "m", "value": 9.0, "backend": "cpu"}
+        result, calls = self._run_main(
+            monkeypatch, capsys, [dict(summary)],
+            artifact_dir=tmp_path, backend="cpu", budget_s=60.0,
+        )
+        assert [c[0] for c in calls] == ["run"]  # fused never launched
+        assert "BENCH_BUDGET_S" in result["fused_largev_error"]
 
     def test_cpu_degradation_cites_committed_tpu_evidence(
         self, monkeypatch, capsys, tmp_path
