@@ -120,6 +120,7 @@ def run(backend: str) -> dict:
     from gfedntm_tpu.federated.trainer import FederatedTrainer
     from gfedntm_tpu.models.avitm import AVITM
     from gfedntm_tpu.utils.observability import (
+        DeviceMemoryMonitor,
         MetricsLogger,
         phase_timer,
         trace,
@@ -170,11 +171,16 @@ def run(backend: str) -> dict:
 
     # Warmup fit: stages the corpora once (cached in the trainer) and
     # compiles the whole-run program.
+    # Device-memory gauges (device_bytes_in_use/<dev>; no-op on CPU):
+    # sampled after the compile fit (peak includes compile scratch) and
+    # after the steady fit, landing in the same registry snapshot.
+    devmem = DeviceMemoryMonitor(metrics.registry)
     t0 = time.perf_counter()
     with phase_timer(metrics, "compile_and_first_run"):
         warm = trainer.fit(datasets, metrics=metrics)
         jax.block_until_ready(warm.client_params)
     compile_s = time.perf_counter() - t0
+    devmem.sample()
     assert np.isfinite(warm.losses).all()
     stage_s = sum(
         r["seconds"] for r in metrics.events("phase")
@@ -193,6 +199,7 @@ def run(backend: str) -> dict:
         result = trainer.fit(datasets, metrics=metrics)
         jax.block_until_ready(result.client_params)
     steady_s = time.perf_counter() - t0
+    devmem.sample()
     # Phase accounting for the TIMED fit only (the traced fit below logs
     # its own program_segment events, which must not pollute this).
     phases = metrics.events("phase")[n_before:]
@@ -717,11 +724,14 @@ def _git(*args: str) -> "subprocess.CompletedProcess":
 
 
 def _persist_tpu_artifact(summary: dict) -> None:
-    """Write a successful TPU bench to results/bench_tpu/ and best-effort
-    commit it, so the round's best live number survives as a falsifiable
-    artifact even if a later driver-time run hits a dead tunnel (round 4's
-    86.5x existed only in prose because the driver's capture degraded to
-    CPU). ``BENCH_NO_GIT=1`` disables the commit (tests)."""
+    """Write a successful TPU bench to results/bench_tpu/ so the round's
+    best live number survives as a falsifiable artifact even if a later
+    driver-time run hits a dead tunnel (round 4's 86.5x existed only in
+    prose because the driver's capture degraded to CPU). Write-only by
+    default: committing repo history is a surprising side effect for a
+    measurement tool (ADVICE r5), so the git commit requires an explicit
+    ``BENCH_COMMIT=1`` opt-in (and ``BENCH_NO_GIT=1`` still force-disables
+    it)."""
     try:
         os.makedirs(os.path.dirname(_TPU_ARTIFACT), exist_ok=True)
         head = _git("rev-parse", "HEAD").stdout.strip()
@@ -730,7 +740,9 @@ def _persist_tpu_artifact(summary: dict) -> None:
         record["captured_at_commit"] = head
         with open(_TPU_ARTIFACT, "w") as f:
             json.dump(record, f, indent=1)
-        if os.environ.get("BENCH_NO_GIT"):
+        if not os.environ.get("BENCH_COMMIT") or os.environ.get(
+            "BENCH_NO_GIT"
+        ):
             return
         rel = os.path.relpath(_TPU_ARTIFACT, _REPO_ROOT)
         _git("add", rel)

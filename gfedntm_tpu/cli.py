@@ -6,10 +6,13 @@ reference cannot express: ``--id`` omitted runs the WHOLE federation as one
 SPMD program on the local device mesh (``simulate``), where the gRPC
 hub-and-spoke collapses into ``lax.psum`` over ICI.
 
-A fourth entry point reads telemetry instead of producing it:
+Two more entry points read telemetry instead of producing it:
 ``python -m gfedntm_tpu.cli summarize <metrics.jsonl>`` renders a run
 report (phase breakdown, p50/p95/p99 step time, bytes moved per round,
-slowest client) from the JSONL stream every role writes to its save dir.
+slowest client) from the JSONL stream every role writes to its save dir,
+and ``python -m gfedntm_tpu.cli trace <server.jsonl> <client*.jsonl> -o
+trace.json`` merges the per-node streams into one clock-aligned Chrome
+trace-event file (README "Distributed tracing & ops endpoint").
 
 Data paths mirror ``main.py:138-152``: synthetic ``.npz`` archives (node
 ``id-1`` of a multi-node archive) or real ``.parquet`` filtered by ``--fos``.
@@ -40,8 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
             "one SPMD program."
         ),
         epilog=(
-            "Subcommand: 'summarize <metrics.jsonl>' renders a telemetry "
-            "report from a run's JSONL stream (see README 'Telemetry')."
+            "Subcommands: 'summarize <metrics.jsonl>' renders a telemetry "
+            "report from a run's JSONL stream (see README 'Telemetry'); "
+            "'trace <metrics.jsonl>...' merges per-node streams into one "
+            "Chrome trace-event file (README 'Distributed tracing & ops "
+            "endpoint')."
         ),
     )
     p.add_argument("--id", type=int, default=None,
@@ -116,6 +122,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "federation-wide codec advertised at join time. "
                         "Client mode: default adopts the server's; an "
                         "explicit value must match it or the join fails")
+    # Cross-process observability plane (README "Distributed tracing & ops
+    # endpoint"): live ops endpoint + device profiler window.
+    p.add_argument("--ops_port", type=int, default=None,
+                   help="server mode: serve /metrics (Prometheus), "
+                        "/healthz, and /status on this HTTP port "
+                        "(0 = ephemeral; default: disabled, no thread)")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="capture a jax.profiler trace into this directory "
+                        "(server/client: around the --profile_rounds "
+                        "window; simulate: around the federated fit)")
+    p.add_argument("--profile_rounds", type=str, default="1:2",
+                   help="half-open round window for --profile_dir, "
+                        "'start:stop' or a single round (default '1:2' — "
+                        "skips the compile-dominated round 0)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -210,9 +230,15 @@ def _load_corpora(args: argparse.Namespace):
 def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     """``--id 0``: network federation server (``main.py:27-95``)."""
     from gfedntm_tpu.federation.server import FederatedServer
-    from gfedntm_tpu.utils.observability import MetricsLogger
+    from gfedntm_tpu.utils.observability import MetricsLogger, RoundProfiler
 
-    metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
+    metrics = MetricsLogger(
+        os.path.join(args.save_dir, "metrics.jsonl"), node="server"
+    )
+    profiler = (
+        RoundProfiler(args.profile_dir, args.profile_rounds, metrics=metrics)
+        if getattr(args, "profile_dir", None) else None
+    )
     aggregator_kwargs = {}
     if getattr(args, "server_lr", None) is not None:
         if getattr(args, "aggregator", "fedavg") == "fedavg":
@@ -234,6 +260,8 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         aggregator=getattr(args, "aggregator", "fedavg"),
         aggregator_kwargs=aggregator_kwargs,
         wire_codec=getattr(args, "wire_codec", None) or "none",
+        ops_port=getattr(args, "ops_port", None),
+        profiler=profiler,
     )
     if getattr(args, "resume", False):
         try:
@@ -270,10 +298,16 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
     port = (
         args.listen_port if args.listen_port is not None else 50051 + args.id
     )
-    from gfedntm_tpu.utils.observability import MetricsLogger
+    from gfedntm_tpu.utils.observability import MetricsLogger, RoundProfiler
 
     save_dir = os.path.join(args.save_dir, f"client{args.id}")
-    metrics = MetricsLogger(os.path.join(save_dir, "metrics.jsonl"))
+    metrics = MetricsLogger(
+        os.path.join(save_dir, "metrics.jsonl"), node=f"client{args.id}"
+    )
+    profiler = (
+        RoundProfiler(args.profile_dir, args.profile_rounds, metrics=metrics)
+        if getattr(args, "profile_dir", None) else None
+    )
     client = Client(
         client_id=args.id,
         corpus=corpus,
@@ -285,6 +319,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         metrics=metrics,
         liveness_timeout=getattr(args, "liveness_timeout", 300.0),
         wire_codec=getattr(args, "wire_codec", None) or "auto",
+        profiler=profiler,
     )
     client.run()
     client.shutdown()
@@ -304,7 +339,11 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
     from gfedntm_tpu.federated.trainer import FederatedTrainer
     from gfedntm_tpu.models.avitm import AVITM
     from gfedntm_tpu.models.ctm import CTM
-    from gfedntm_tpu.utils.observability import MetricsLogger, phase_timer
+    from gfedntm_tpu.utils.observability import (
+        MetricsLogger,
+        phase_timer,
+        trace,
+    )
 
     corpora, synthetic = _load_corpora(args)
     if synthetic is not None and args.model_type == "ctm":
@@ -314,7 +353,9 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
             "parquet column, as the reference does)"
         )
     n_clients = len(corpora)
-    metrics = MetricsLogger(os.path.join(args.save_dir, "metrics.jsonl"))
+    metrics = MetricsLogger(
+        os.path.join(args.save_dir, "metrics.jsonl"), node="simulate"
+    )
 
     with phase_timer(metrics, "consensus"):
         if synthetic is not None:
@@ -350,7 +391,10 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
         local_steps=getattr(args, "local_steps", 1),
     )
     with phase_timer(metrics, "federated_fit", n_clients=n_clients):
-        result = trainer.fit(datasets, metrics=metrics)
+        # SPMD mode has no round loop to window — --profile_dir wraps the
+        # whole federated fit in one jax.profiler capture.
+        with trace(getattr(args, "profile_dir", None)):
+            result = trainer.fit(datasets, metrics=metrics)
 
     global_model = trainer.make_global_model(result)
     global_model.train_data = datasets[0]
@@ -439,11 +483,85 @@ def run_summarize(argv: list[str]) -> int:
     return 0
 
 
+# ---- cross-node trace merge (`trace` subcommand) ----------------------------
+
+def _node_name_for(path: str, records: list[dict[str, Any]]) -> str:
+    """A stream's node identity: the ``node`` field its logger stamped, or
+    (pre-plane streams) the metrics file's parent directory name."""
+    for r in records:
+        node = r.get("node")
+        if isinstance(node, str) and node:
+            return node
+    parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    return parent or os.path.splitext(os.path.basename(path))[0]
+
+
+def run_trace(argv: list[str]) -> int:
+    """``trace <metrics.jsonl>...``: merge per-node telemetry streams into
+    one Chrome trace-event JSON (open in Perfetto / chrome://tracing),
+    aligning each node's wall clock onto the reference node's via the
+    paired RPC send/recv stamps (README "Distributed tracing & ops
+    endpoint")."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu trace",
+        description="Merge per-node metrics.jsonl streams into one "
+                    "Perfetto-loadable Chrome trace-event file.",
+    )
+    p.add_argument("paths", nargs="+",
+                   help="per-node metrics.jsonl files (server + clients)")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="output Chrome trace-event JSON (default "
+                        "trace.json)")
+    p.add_argument("--reference", default=None,
+                   help="node whose clock anchors the merge (default: the "
+                        "node owning the 'round' spans)")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.utils.observability import (
+        merge_chrome_trace,
+        read_metrics,
+    )
+
+    node_records: dict[str, list[dict[str, Any]]] = {}
+    for path in args.paths:
+        try:
+            records = read_metrics(path)
+        except FileNotFoundError:
+            raise SystemExit(f"no such metrics file: {path}")
+        node_records.setdefault(_node_name_for(path, records), []).extend(
+            records
+        )
+    try:
+        trace = merge_chrome_trace(node_records, reference=args.reference)
+    except ValueError as err:
+        raise SystemExit(f"trace merge failed: {err}")
+    out_dir = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(trace, fh, default=float)
+    meta = trace["otherData"]
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    offsets = ", ".join(
+        f"{node}{off:+.3f}s"
+        for node, off in meta["clock_offsets_s"].items()
+        if node != meta["reference"]
+    )
+    print(
+        f"wrote {args.out}: {n_spans} spans from {len(node_records)} nodes "
+        f"(reference {meta['reference']!r}"
+        + (f"; clock offsets: {offsets}" if offsets else "")
+        + ") — open in https://ui.perfetto.dev"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "summarize":
         return run_summarize(argv[1:])
+    if argv and argv[0] == "trace":
+        return run_trace(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -83,10 +83,18 @@ class FederatedStepper:
         self.grads_to_share = tuple(grads_to_share)
         # Optional MetricsLogger: per-step wall-time histogram
         # ("stepper_step_s", host-synced — includes the loss device fetch)
-        # plus first-step compile capture via the jit wrapper. None = every
-        # hook is a no-op (zero overhead).
+        # plus first-step compile capture via the jit wrapper and per-step
+        # device-memory gauges (device_bytes_in_use/<dev>; the monitor
+        # probes memory_stats() support once and is a no-op on CPU). None =
+        # every hook is a no-op (zero overhead).
         self.metrics = metrics
         self._first_step_done = False
+        if metrics is not None:
+            from gfedntm_tpu.utils.observability import DeviceMemoryMonitor
+
+            self._devmem = DeviceMemoryMonitor(metrics.registry)
+        else:
+            self._devmem = None
         # When set, a model snapshot (variables + config) is written at every
         # epoch end during federated training — the reference does this for
         # CTM (``federated_ctm.py:150-159``); here any stepped model may
@@ -176,6 +184,7 @@ class FederatedStepper:
                     time.perf_counter() - t0
                 )
             self._first_step_done = True
+            self._devmem.sample()
         self._last_batch_size = float(self._schedule.mask[self._step_in_epoch].sum())
         self._pending_step = True
         return self.get_gradients() if snapshot else {}

@@ -50,12 +50,17 @@ class FederatedClientServicer:
                  on_stop, logger: logging.Logger, metrics=None,
                  on_activity=None, on_done=None, on_local_steps=None,
                  uplink: UplinkEncoder | None = None,
-                 downlink: DownlinkDecoder | None = None):
+                 downlink: DownlinkDecoder | None = None,
+                 profiler=None):
         self.client_id = client_id
         self.stepper = stepper
         self.on_stop = on_stop
         self.logger = logger
         self.metrics = metrics
+        # Optional RoundProfiler: the client learns the round index from
+        # each StepRequest, so the jax.profiler window opens/closes here —
+        # the local steps are where this process's device time actually is.
+        self.profiler = profiler
         # Negotiated wire-compression sessions (None = identity codec, the
         # plain codec.py path): `uplink` encodes StepReply snapshots
         # (delta vs the last applied aggregate + error-feedback residual),
@@ -94,6 +99,8 @@ class FederatedClientServicer:
 
     def _train_step(self, request: pb.StepRequest) -> pb.StepReply:
         with self._lock:
+            if self.profiler is not None:
+                self.profiler.observe(int(request.global_iter))
             requested = max(1, int(request.local_steps or 1))
             self.on_local_steps(requested)
             # Truncate the round to the remaining epoch budget so the
@@ -103,12 +110,19 @@ class FederatedClientServicer:
             # the last step is exchanged).
             n_run = max(1, min(requested, self.stepper.steps_remaining))
             losses = []
+            # nr_samples must cover EVERY minibatch of the round, not the
+            # last (possibly partial tail) batch: the server's FedAvg
+            # weighting is sample-count-proper only when an E-step round
+            # reports the samples it actually consumed (ADVICE r5).
+            nr_samples = 0.0
             for _ in range(n_run - 1):
                 self.stepper.train_mb_delta(snapshot=False)
                 losses.append(self.stepper.loss)
+                nr_samples += self.stepper._last_batch_size
                 self.stepper.advance_local()
             snapshot = self.stepper.train_mb_delta()
             losses.append(self.stepper.loss)
+            nr_samples += self.stepper._last_batch_size
             if self.metrics is not None:
                 self.metrics.registry.counter("client_polls").inc()
             if self.uplink is not None:
@@ -121,7 +135,7 @@ class FederatedClientServicer:
                 client_id=self.client_id,
                 shared=shared,
                 loss=float(sum(losses) / len(losses)),
-                nr_samples=self.stepper._last_batch_size,
+                nr_samples=nr_samples,
                 current_mb=self.stepper.current_mb,
                 current_epoch=self.stepper.current_epoch,
                 finished=self.stepper.finished,
@@ -202,6 +216,7 @@ class Client:
         watchdog_poll_s: float = 2.0,
         retry_policy=None,
         wire_codec: str | None = "auto",
+        profiler=None,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -217,6 +232,10 @@ class Client:
         # Optional MetricsLogger: join-phase spans, RPC/codec registry
         # metrics, and the stepper's step-time histograms all flow into it.
         self.metrics = metrics
+        # Optional observability.RoundProfiler (--profile_dir): handed to
+        # the servicer, which opens/closes the jax.profiler window as the
+        # server's StepRequests reveal the round index.
+        self.profiler = profiler
         # Liveness watchdog: if no poll/aggregate/stop arrives within this
         # window after training starts, the client self-finalizes instead of
         # blocking in stopped.wait() forever against a dead server.
@@ -472,11 +491,16 @@ class Client:
             metrics=self.metrics, on_activity=self._rpc_begin,
             on_done=self._rpc_end, on_local_steps=self._note_local_steps,
             uplink=self._uplink, downlink=self._downlink,
+            profiler=self.profiler,
         )
         self._servicer = servicer
         self._grpc_server = rpc.make_server(max_workers=4)
+        # metrics= wraps every dispatch in a `serve` span that adopts the
+        # server's trace context from the call metadata — the client half
+        # of the round tree.
         rpc.add_service(
-            self._grpc_server, "gfedntm.FederationClient", servicer
+            self._grpc_server, "gfedntm.FederationClient", servicer,
+            metrics=self.metrics,
         )
         port = self._grpc_server.add_insecure_port(self.listen_address)
         self._grpc_server.start()
@@ -526,6 +550,8 @@ class Client:
             )
             raise
         finally:
+            if self.profiler is not None:
+                self.profiler.close()
             if self.metrics is not None:
                 self.metrics.snapshot_registry(client=self.client_id)
             self.stopped.set()

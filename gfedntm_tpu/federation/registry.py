@@ -11,6 +11,7 @@ event-driven instead of poll-with-timeout (``server.py:237-238``'s
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field
 
@@ -213,6 +214,31 @@ class Federation:
                 c for c in self.get_clients()
                 if c.ready_for_training and not c.finished
                 and c.status == SUSPECT and c.next_retry_round > round_idx
+            ]
+
+    def membership_snapshot(self) -> list[dict]:
+        """JSON-safe per-client membership view for the live ops endpoint's
+        ``/status``: identity, liveness/probation state, and training
+        progress (NaN losses become null — JSON has no NaN)."""
+        with self._lock:
+            return [
+                {
+                    "client_id": c.client_id,
+                    "status": c.status,
+                    "address": c.address,
+                    "ready": bool(c.ready_for_training),
+                    "finished": bool(c.finished),
+                    "nr_samples": c.nr_samples,
+                    "current_mb": c.current_mb,
+                    "current_epoch": c.current_epoch,
+                    "last_loss": (
+                        None if math.isnan(c.last_loss)
+                        else float(c.last_loss)
+                    ),
+                    "consecutive_failures": c.consecutive_failures,
+                    "next_retry_round": c.next_retry_round,
+                }
+                for c in self.get_clients()
             ]
 
     def total_weight(self) -> float:

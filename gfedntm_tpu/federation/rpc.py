@@ -16,6 +16,7 @@ from typing import Any
 import grpc
 
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.utils import observability as obs
 
 SERVICES: dict[str, dict[str, tuple[Any, Any]]] = {
     "gfedntm.Federation": {
@@ -48,14 +49,22 @@ SERVER_OPTIONS = _MSG_CAPS + [
 
 
 def add_service(server: grpc.Server, service_name: str, impl: Any,
-                fault_injector: Any = None) -> None:
+                fault_injector: Any = None, metrics: Any = None) -> None:
     """Register ``impl`` (an object with one method per RPC) on ``server``.
 
     ``fault_injector`` (a
     :class:`~gfedntm_tpu.federation.resilience.FaultInjector`) intercepts
     each dispatch BEFORE the servicer method runs — an injected error
     surfaces to the remote caller as a real gRPC status, exercising its
-    retry/probation paths over a healthy connection."""
+    retry/probation paths over a healthy connection.
+
+    ``metrics`` (a
+    :class:`~gfedntm_tpu.utils.observability.MetricsLogger`) wraps every
+    dispatch in a ``serve`` span carrying the trace context extracted from
+    the caller's gRPC metadata (trace id, the SENDER's span id as
+    ``remote_parent_id``, round, the paired send/recv clock stamps the
+    trace merger aligns on). ``metrics=None`` registers the raw behaviours
+    unchanged — the un-instrumented dispatch path is bit-identical."""
     spec = SERVICES[service_name]
     handlers = {}
     for method, (req_cls, resp_cls) in spec.items():
@@ -63,6 +72,10 @@ def add_service(server: grpc.Server, service_name: str, impl: Any,
         if fault_injector is not None:
             behaviour = _injected_behaviour(
                 fault_injector, service_name, method, behaviour
+            )
+        if metrics is not None:
+            behaviour = _traced_behaviour(
+                metrics, service_name, method, behaviour
             )
         handlers[method] = grpc.unary_unary_rpc_method_handler(
             behaviour,
@@ -83,6 +96,28 @@ def _injected_behaviour(injector: Any, service: str, method: str, fn: Any):
         except InjectedRpcError as exc:
             context.abort(exc.code(), exc.details())
         return fn(request, context)
+
+    return behaviour
+
+
+def _traced_behaviour(metrics: Any, service: str, method: str, fn: Any):
+    """Wrap one servicer method in a ``serve`` span parented (remotely)
+    under the caller's span via the trace metadata it attached. Sits
+    OUTSIDE the fault injector so injected dispatch failures show up as
+    failed serve spans in the merged trace."""
+    short = service.rsplit(".", 1)[-1]
+
+    def behaviour(request, context):
+        fields = obs.extract_trace_context(
+            context.invocation_metadata() if context is not None else ()
+        )
+        fields["rpc_recv_time"] = time.time()
+        client_id = getattr(request, "client_id", 0)
+        if client_id:
+            fields["client"] = int(client_id)
+        with obs.span(metrics, "serve", method=f"{short}.{method}",
+                      **fields):
+            return fn(request, context)
 
     return behaviour
 
@@ -116,6 +151,20 @@ def _with_deadline(fn, default_timeout: float | None, metrics=None,
             fault_injector.before_call(service, method, request, peer=peer)
         if metrics is None:
             return fn(request, timeout=timeout, **kwargs)
+        # Trace-context propagation: explicit caller metadata (the server's
+        # poll/push workers pass trace_pairs with the round span) wins;
+        # otherwise attach the ambient span context. The node label and a
+        # FRESH send-time stamp (per attempt — retries re-send) ride along
+        # so the servicer side can pair clocks. metrics=None skips all of
+        # this: the un-instrumented wire is bit-identical.
+        md = list(kwargs.pop("metadata", None) or ())
+        if obs.TRACE_ID_KEY not in {k for k, _ in md}:
+            md.extend(obs.ambient_trace_pairs(metrics))
+        node = getattr(metrics, "node", None)
+        if node:
+            md.append((obs.NODE_KEY, node))
+        md.append((obs.SEND_TIME_KEY, f"{time.time():.6f}"))
+        kwargs["metadata"] = md
         t0 = time.perf_counter()
         calls.inc()
         bytes_sent.inc(request.ByteSize())

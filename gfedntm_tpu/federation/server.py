@@ -57,7 +57,14 @@ from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.models.ctm import CTM
-from gfedntm_tpu.utils.observability import span
+from gfedntm_tpu.utils.observability import (
+    OpsServer,
+    RoundProfiler,
+    StragglerDetector,
+    new_trace_id,
+    span,
+    trace_pairs,
+)
 
 
 def build_template_model(
@@ -113,6 +120,10 @@ class FederatedServer:
         aggregator_kwargs: dict[str, Any] | None = None,
         wire_codec: str = "none",
         codec_ref_cache: int = 8,
+        ops_port: int | None = None,
+        ops_host: str = "127.0.0.1",
+        profiler: RoundProfiler | None = None,
+        straggler_z: float = 2.0,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -173,6 +184,24 @@ class FederatedServer:
         # excluded from the poll-latency/straggler stats).
         self._poll_warmed: set[int] = set()
 
+        # Cross-process observability plane (README "Distributed tracing &
+        # ops endpoint"): one trace id per training run (every poll/push
+        # carries it in gRPC metadata, so client-side serve spans land in
+        # the same tree), an optional live ops endpoint (/metrics, /healthz,
+        # /status; port=0 binds ephemeral, None disables — no thread), an
+        # optional jax.profiler round window, and rolling straggler
+        # analytics over the warmed poll latencies.
+        self.trace_id: str | None = None
+        self.ops_port = ops_port
+        self.ops_host = ops_host
+        self.ops_actual_port: int | None = None
+        self._ops_server: OpsServer | None = None
+        self.profiler = profiler
+        self.straggler = StragglerDetector(
+            registry=metrics.registry if metrics is not None else None,
+            z_threshold=straggler_z,
+        )
+
         self.federation = Federation(min_clients=min_clients)
         self.template: AVITM | None = None
         self.global_vocab: Vocabulary | None = None
@@ -208,10 +237,31 @@ class FederatedServer:
                 self.poll_workers, 2 * self.federation.min_clients + 4
             )
         )
-        rpc.add_service(self._grpc_server, "gfedntm.Federation", self)
+        rpc.add_service(
+            self._grpc_server, "gfedntm.Federation", self,
+            metrics=self.metrics,
+        )
         port = self._grpc_server.add_insecure_port(address)
         self._grpc_server.start()
         self.logger.info("federation server listening on port %d", port)
+        if self.ops_port is not None:
+            self._ops_server = OpsServer(
+                registry=(
+                    self.metrics.registry if self.metrics is not None
+                    else None
+                ),
+                status_fn=self._status,
+                host=self.ops_host, port=self.ops_port,
+            )
+            self.ops_actual_port = self._ops_server.start()
+            self.logger.info(
+                "ops endpoint on http://%s:%d (/metrics /healthz /status)",
+                self.ops_host, self.ops_actual_port,
+            )
+            if self.metrics is not None:
+                self.metrics.log(
+                    "ops_server_started", port=self.ops_actual_port,
+                )
         return f"localhost:{port}" if address.startswith("[::]") else address
 
     def stop(self, grace: float = 1.0, join_timeout: float = 10.0) -> None:
@@ -230,6 +280,7 @@ class FederatedServer:
                 )
         if self._grpc_server is not None:
             self._grpc_server.stop(grace)
+        self._stop_ops_server()
 
     def abort(self) -> None:
         """Hard-crash simulation: kill the gRPC server NOW and abandon the
@@ -240,6 +291,43 @@ class FederatedServer:
         self._stopping.set()
         if self._grpc_server is not None:
             self._grpc_server.stop(0)
+        self._stop_ops_server()
+
+    def _stop_ops_server(self) -> None:
+        if self._ops_server is not None:
+            self._ops_server.stop()
+            self._ops_server = None
+
+    def _status(self) -> dict[str, Any]:
+        """The live ops endpoint's ``/status`` payload: round progress,
+        membership with probation states, negotiated codec + compression
+        ratios, and the straggler view — all JSON-safe reads, no training-
+        loop locks held across RPC work."""
+        reg = self.metrics.registry if self.metrics is not None else None
+
+        def gauge(name):
+            metric = reg.get(name) if reg is not None else None
+            return metric.value if metric is not None else None
+
+        return {
+            "round": int(self.global_iterations),
+            "max_iters": int(self.max_iters),
+            "min_clients": int(self.federation.min_clients),
+            "training_started": self._train_thread is not None,
+            "training_done": self.training_done.is_set(),
+            "stopping": self._stopping.is_set(),
+            "trace_id": self.trace_id,
+            "codec": self.wire_codec.codec_id,
+            "aggregator": self.aggregator.name,
+            "local_steps": self.local_steps,
+            "quorum_fraction": self.quorum_fraction,
+            "clients": self.federation.membership_snapshot(),
+            "compression": {
+                "ratio_sent": gauge("compression_ratio_sent"),
+                "ratio_recv": gauge("compression_ratio_recv"),
+            },
+            "stragglers": self.straggler.status(),
+        }
 
     def wait_done(self, timeout: float | None = None) -> bool:
         return self.training_done.wait(timeout)
@@ -484,7 +572,9 @@ class FederatedServer:
         # A (re)joining client is a fresh process with no broadcast
         # reference — it must not count as having acked the last push, or
         # the next push could be delta-encoded against state it never held.
+        # Its straggler history is a different process's too.
         self._push_acked.discard(request.client_id)
+        self.straggler.forget(request.client_id)
         # Re-check after registering: if the training loop began shutting
         # down concurrently, this client may have missed the stop-broadcast
         # snapshot — tell it to finalize on its own. (If it made the
@@ -552,8 +642,11 @@ class FederatedServer:
                 rec.client_id, rec.consecutive_failures, what, exc,
             )
             # A rejoin is a fresh process that must re-jit, so its first
-            # poll is compile-dominated again.
+            # poll is compile-dominated again; its frozen EWMA must also
+            # leave the straggler population or it skews every later
+            # round's mean/std.
             self._poll_warmed.discard(rec.client_id)
+            self.straggler.forget(rec.client_id)
             if reg is not None:
                 reg.counter("client_drops").inc()
         else:
@@ -571,13 +664,15 @@ class FederatedServer:
                     round=round_idx,
                 )
 
-    def _note_round_poll(self, round_sp, polled, replies) -> None:
+    def _note_round_poll(self, round_sp, polled, replies, iteration) -> None:
         """Straggler/staleness telemetry for one round's poll results:
         per-client poll-latency histograms, slowest-client gauges (annotated
-        onto the round span too), per-client staleness-in-minibatches
+        onto the round span too), rolling per-client EWMAs with z-score
+        ``straggler_detected`` events, per-client staleness-in-minibatches
         gauges, and the round's pulled payload bytes."""
         reg = self.metrics.registry
         slowest_id, slowest_s = None, -1.0
+        round_lats: dict[int, float] = {}
         for rec, reply, lat in polled:
             if reply is None:
                 # A failed poll's latency is the deadline constant, not a
@@ -593,6 +688,7 @@ class FederatedServer:
                 continue
             reg.histogram("client_poll_s").observe(lat)
             reg.histogram(f"client_poll_s/client{rec.client_id}").observe(lat)
+            round_lats[rec.client_id] = lat
             if lat > slowest_s:
                 slowest_id, slowest_s = rec.client_id, lat
         if slowest_id is not None:
@@ -600,6 +696,17 @@ class FederatedServer:
             reg.gauge("round_slowest_client_s").set(slowest_s)
             round_sp.annotate(
                 slowest_client=slowest_id, slowest_s=slowest_s
+            )
+        for flagged in self.straggler.observe_round(round_lats):
+            reg.counter("stragglers_detected").inc()
+            self.metrics.log(
+                "straggler_detected", client=flagged["client"],
+                round=iteration, z=flagged["z"], ewma_s=flagged["ewma_s"],
+            )
+            self.logger.warning(
+                "round %d: client %d is a straggler (z=%.1f, "
+                "EWMA %.3f s)", iteration, flagged["client"], flagged["z"],
+                flagged["ewma_s"],
             )
         if replies:
             max_mb = max(reply.current_mb for _rec, reply in replies)
@@ -622,7 +729,12 @@ class FederatedServer:
         match the template's — a version-skewed (or corrupted) client must
         cost the round one contributor, not ``KeyError`` (or a broadcast
         ``ValueError``: same keys over a different consensus vocab is the
-        likelier skew) the whole average."""
+        likelier skew) the whole average.
+
+        The FedAvg weight is the reply's ``nr_samples`` — the samples the
+        client actually consumed this round (summed over all E local
+        minibatches, ADVICE r5) — falling back to the client's join-time
+        corpus size for replies that don't report one."""
         if self._expected_keys is None:
             template = self._shared_template()
             self._expected_keys = frozenset(template)
@@ -682,7 +794,9 @@ class FederatedServer:
                 if m is not None:
                     m.registry.counter("key_skew_excluded").inc()
                 continue
-            snapshots.append((rec.nr_samples, snap))
+            snapshots.append(
+                (float(reply.nr_samples) or rec.nr_samples, snap)
+            )
         return snapshots
 
     def _encode_push(
@@ -721,11 +835,25 @@ class FederatedServer:
         self._stopping.wait(self.round_backoff_s)
 
     def _run_training(self) -> None:
+        if self.metrics is not None:
+            # One trace identity per training run: every round span inherits
+            # it (via the logger) and every poll/push advertises it, so the
+            # N per-node JSONL streams merge into one tree.
+            self.trace_id = (
+                getattr(self.metrics, "trace_id", None) or new_trace_id()
+            )
+            self.metrics.trace_id = self.trace_id
+            self.metrics.log(
+                "trace_started", trace_id=self.trace_id,
+                round=self.global_iterations,
+            )
         try:
             self._training_loop()
         except Exception:  # pragma: no cover - defensive
             self.logger.exception("federated training loop failed")
         finally:
+            if self.profiler is not None:
+                self.profiler.close()
             # Snapshot in the failure path too: a crashed run's metrics.jsonl
             # must still carry its cumulative RPC/codec/step-time state —
             # those are exactly the runs telemetry exists to debug.
@@ -776,7 +904,19 @@ class FederatedServer:
                 if not active:
                     break
 
+            if self.profiler is not None:
+                self.profiler.observe(iteration)
+
             with span(m, "round", round=iteration) as round_sp:
+                # Trace metadata for this round's polls/pushes — built once
+                # here because the pool threads the RPCs run on do not
+                # inherit the round span's contextvars.
+                rpc_kwargs = {}
+                if m is not None:
+                    rpc_kwargs["metadata"] = trace_pairs(
+                        self.trace_id, round_sp.span_id, iteration
+                    )
+
                 # 1. concurrent poll: one local step per client. The round
                 # span is handed down explicitly — pool threads don't
                 # inherit the loop thread's contextvars.
@@ -800,6 +940,7 @@ class FederatedServer:
                                 local_steps=self.local_steps,
                             ),
                             timeout=120.0 + 2.0 * self.local_steps,
+                            **rpc_kwargs,
                         )
                         if was_suspect and self.federation.mark_recovered(
                             rec.client_id
@@ -828,7 +969,8 @@ class FederatedServer:
                     if reply is not None
                 ]
                 if m is not None:
-                    self._note_round_poll(round_sp, polled, replies)
+                    self._note_round_poll(round_sp, polled, replies,
+                                          iteration)
                 if not replies:
                     # A fully failed round ends the federation only when
                     # nobody is left to come back (everyone dropped or
@@ -898,7 +1040,9 @@ class FederatedServer:
                     rec, reply = item
                     addr = rec.address
                     try:
-                        ack = stubs[rec.client_id][2].ApplyAggregate(agg)
+                        ack = stubs[rec.client_id][2].ApplyAggregate(
+                            agg, **rpc_kwargs
+                        )
                         self.federation.update_progress(
                             rec.client_id, reply.current_mb,
                             reply.current_epoch, reply.loss,

@@ -98,6 +98,8 @@ def make_slice_client_mesh(
 
 def distributed_slice_client_mesh(
     axis_names: tuple[str, str] = ("slice", "clients"),
+    devices: list | None = None,
+    n_proc: int | None = None,
 ) -> Mesh:
     """Real-pod construction of the multi-slice client mesh: one mesh row
     per PROCESS (devices grouped by ``process_index``, so the outer axis
@@ -105,15 +107,38 @@ def distributed_slice_client_mesh(
     devices along the inner ``clients`` axis (ICI). Call after
     ``jax.distributed.initialize`` (see :func:`distributed_client_mesh`);
     on a single process this degenerates to a 1 x n_devices mesh —
-    equivalent to the 1-D clients mesh."""
-    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    n_proc = max(1, jax.process_count())
+    equivalent to the 1-D clients mesh. ``devices``/``n_proc`` default to
+    the live backend (overridable for tests).
+
+    Every process must contribute exactly ``len(devices) // n_proc``
+    devices: a total count that merely divides evenly is NOT enough — with
+    unequal per-process contributions the reshape would silently mix
+    devices from different processes within a row, putting DCN hops on the
+    "ICI" inner axis (ADVICE r5). Unequal topologies fail loudly here.
+    """
+    devices = sorted(
+        devices if devices is not None else jax.devices(),
+        key=lambda d: (d.process_index, d.id),
+    )
+    n_proc = max(1, jax.process_count() if n_proc is None else n_proc)
     if len(devices) % n_proc != 0:
         raise ValueError(
             f"{len(devices)} devices do not divide evenly over "
             f"{n_proc} processes"
         )
-    grid = np.array(devices).reshape(n_proc, len(devices) // n_proc)
+    per_proc = len(devices) // n_proc
+    counts: dict[int, int] = {}
+    for d in devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    uneven = {p: c for p, c in sorted(counts.items()) if c != per_proc}
+    if len(counts) != n_proc or uneven:
+        raise ValueError(
+            f"every process must contribute exactly {per_proc} devices for "
+            f"a {n_proc}-row (slice, clients) mesh, got per-process counts "
+            f"{dict(sorted(counts.items()))} — reshaping would mix "
+            "processes within a row (DCN hops on the ICI axis)"
+        )
+    grid = np.array(devices).reshape(n_proc, per_proc)
     return Mesh(grid, axis_names)
 
 

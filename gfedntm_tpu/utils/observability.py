@@ -24,11 +24,35 @@ the substrate every perf/robustness PR reports against:
 - :func:`trace` — ``jax.profiler`` trace context for TPU timeline capture
   (view in TensorBoard / xprof).
 
+Cross-process observability plane (README "Distributed tracing & ops
+endpoint"): a federation is N processes, so a round's story needs shared
+trace identity, live introspection, and device-level visibility —
+
+- trace-context propagation — :func:`new_trace_id`, :func:`trace_pairs` /
+  :func:`ambient_trace_pairs` (outbound gRPC metadata) and
+  :func:`extract_trace_context` (servicer side), Dapper-style: the server
+  stamps every poll/push with ``trace_id``/``parent_span_id``/``round``,
+  the remote servicer parents its local ``serve`` span under it, and one
+  federation round becomes one tree spanning server and all clients;
+- :func:`merge_chrome_trace` — the ``trace`` CLI subcommand's engine:
+  merges per-node JSONL streams into one Chrome trace-event (Perfetto-
+  loadable) JSON, aligning clocks via the paired RPC send/recv timestamps
+  the trace plane records;
+- :func:`render_prometheus` + :class:`OpsServer` — a stdlib ``http.server``
+  thread serving ``/metrics`` (Prometheus text exposition of the registry),
+  ``/healthz``, and ``/status`` (live round, membership, codec state);
+- :class:`RoundProfiler` — ``jax.profiler`` start/stop around a
+  configurable round window (``--profile_dir``);
+- :class:`DeviceMemoryMonitor` — per-device memory gauges from
+  ``jax.local_devices()`` ``memory_stats()`` (no-op on CPU);
+- :class:`StragglerDetector` — rolling per-client step-time EWMAs with
+  z-score ``straggler_detected`` events.
+
 Every hook is a no-op when no logger is passed (``logger=None``), so
 un-instrumented hot paths pay nothing. Durations come from
 ``time.perf_counter`` (monotonic — NTP steps cannot produce negative phase
-times); wall-clock ``time.time()`` appears only as the ``time`` event
-timestamp field.
+times); wall-clock ``time.time()`` appears as the ``time`` event timestamp
+field and in the paired RPC send/recv stamps the clock aligner consumes.
 """
 
 from __future__ import annotations
@@ -39,6 +63,7 @@ import contextvars
 import itertools
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Iterator
@@ -70,6 +95,14 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "codec_negotiated": frozenset({"client", "codec"}),
     "codec_mismatch": frozenset({"client", "server_codec", "client_codec"}),
     "codec_ref_miss": frozenset({"client", "ref_round"}),
+    # cross-process observability plane (README "Distributed tracing & ops
+    # endpoint"): trace identity, live ops endpoint, device profiler window,
+    # straggler analytics
+    "trace_started": frozenset({"trace_id"}),
+    "ops_server_started": frozenset({"port"}),
+    "profiler_started": frozenset({"dir", "round"}),
+    "profiler_stopped": frozenset({"round"}),
+    "straggler_detected": frozenset({"client", "round", "z"}),
     # training progress
     "resume": frozenset({"step"}),
     "epoch": frozenset({"epoch"}),
@@ -273,6 +306,13 @@ class MetricRegistry:
     ) -> Histogram:
         return self._get(name, Histogram, buckets)
 
+    def get(self, name: str):
+        """Read-only lookup: the metric, or None — unlike the typed
+        accessors this never creates (the ops endpoint's /status must not
+        mint empty gauges just by being curled)."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             metrics = list(self._metrics.items())
@@ -290,12 +330,23 @@ class MetricsLogger:
     from many poll/push worker threads, and interleaved JSONL lines would
     corrupt the stream. ``validate=True`` schema-lints every record at log
     time (tests; see :func:`validate_record`).
+
+    ``node`` names this process in the federation ("server", "client3");
+    it is stamped on every record so the ``trace`` CLI can merge per-node
+    streams without guessing from filenames. ``trace_id`` is the process's
+    ambient trace identity — spans inherit it (see :class:`Span`) and
+    outbound RPCs advertise it (:func:`ambient_trace_pairs`); the
+    federation server mints one per training run and clients adopt it
+    per-call from gRPC metadata.
     """
 
     def __init__(self, path: str | None = None, validate: bool = False,
-                 mode: str = "a", keep_records: bool | None = None):
+                 mode: str = "a", keep_records: bool | None = None,
+                 node: str | None = None, trace_id: str | None = None):
         self.path = path
         self.validate = validate
+        self.node = node
+        self.trace_id = trace_id
         # In-memory retention is for in-process consumers (.events(), tests,
         # bench phase accounting). Default: retain only when there is no
         # file — a long path-backed server run would otherwise accumulate
@@ -315,6 +366,8 @@ class MetricsLogger:
 
     def log(self, event: str, **fields: Any) -> dict[str, Any]:
         record = {"event": event, "time": time.time(), **fields}
+        if self.node is not None:
+            record.setdefault("node", self.node)
         if self.validate:
             validate_record(record)
         # Serialize outside the lock; append + write inside it so lines
@@ -375,6 +428,14 @@ class Span:
     Within a thread, nesting is implicit (contextvars). Work handed to a
     pool thread does NOT inherit the submitting thread's context — pass the
     enclosing span explicitly: ``span(logger, "poll", parent=round_span)``.
+
+    Trace identity: a ``trace_id`` field is inherited from the parent span
+    (explicit or ambient), falling back to the logger's ``trace_id`` — so
+    once the federation server mints a trace, every span in the process
+    carries it without call-site changes, and remote children stamped via
+    gRPC metadata land in the same tree. The emitting thread id is recorded
+    too (``thread``) so the trace merger can lay concurrent servicer spans
+    on separate tracks.
     """
 
     __slots__ = ("logger", "name", "fields", "span_id", "parent_id",
@@ -397,11 +458,17 @@ class Span:
         return self
 
     def __enter__(self) -> "Span":
-        if self._parent is not None:
-            self.parent_id = getattr(self._parent, "span_id", self._parent)
-        else:
-            cur = _CURRENT_SPAN.get()
-            self.parent_id = cur.span_id if cur is not None else None
+        cur = self._parent if self._parent is not None else _CURRENT_SPAN.get()
+        if cur is not None:
+            self.parent_id = getattr(cur, "span_id", cur)
+        if self.fields.get("trace_id") is None:
+            inherited = getattr(cur, "fields", {}).get("trace_id") if (
+                cur is not None
+            ) else None
+            if inherited is None:
+                inherited = getattr(self.logger, "trace_id", None)
+            if inherited is not None:
+                self.fields["trace_id"] = inherited
         self._token = _CURRENT_SPAN.set(self)
         self._t0 = time.perf_counter()
         return self
@@ -412,7 +479,8 @@ class Span:
         self.logger.log(
             "span", name=self.name, span_id=self.span_id,
             parent_id=self.parent_id, seconds=seconds,
-            ok=exc_type is None, **self.fields,
+            ok=exc_type is None, thread=threading.get_ident(),
+            **self.fields,
         )
 
 
@@ -442,6 +510,90 @@ def span(logger: MetricsLogger | None, name: str, parent: Any = None,
     if logger is None:
         return _NULL_SPAN
     return Span(logger, name, parent, fields)
+
+
+def current_span() -> Span | None:
+    """The thread's innermost open span, if any (contextvar-scoped)."""
+    return _CURRENT_SPAN.get()
+
+
+# ---- trace-context propagation (gRPC metadata) ------------------------------
+
+#: gRPC metadata keys of the trace plane (lowercase per the HTTP/2 rules).
+TRACE_ID_KEY = "x-gfedntm-trace-id"
+PARENT_SPAN_KEY = "x-gfedntm-parent-span"
+ROUND_KEY = "x-gfedntm-round"
+SEND_TIME_KEY = "x-gfedntm-send-time"
+NODE_KEY = "x-gfedntm-node"
+
+#: Span names the trace plane is built on: ``round`` (the server's per-round
+#: root, used to pick the merge reference node) and ``serve`` (the servicer-
+#: side child every instrumented RPC dispatch logs, carrying the extracted
+#: trace context + the paired send/recv clock stamps). lint_telemetry.py
+#: verifies both names still exist as span() call sites.
+TRACE_PLANE_SPANS: tuple[str, ...] = ("round", "serve")
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id (one federation training run)."""
+    import uuid
+
+    return uuid.uuid4().hex[:16]
+
+
+def trace_pairs(trace_id: str | None = None, parent_span: int | None = None,
+                round_idx: int | None = None) -> list[tuple[str, str]]:
+    """Explicit outbound trace metadata — the server's poll/push workers
+    use this (pool threads do not inherit the round span's contextvars)."""
+    pairs: list[tuple[str, str]] = []
+    if trace_id:
+        pairs.append((TRACE_ID_KEY, str(trace_id)))
+    if parent_span is not None:
+        pairs.append((PARENT_SPAN_KEY, str(parent_span)))
+    if round_idx is not None:
+        pairs.append((ROUND_KEY, str(round_idx)))
+    return pairs
+
+
+def ambient_trace_pairs(logger: MetricsLogger | None) -> list[tuple[str, str]]:
+    """Outbound trace metadata from the calling thread's ambient context:
+    the innermost open span (id + inherited trace id), falling back to the
+    logger's process-level ``trace_id``."""
+    cur = _CURRENT_SPAN.get()
+    trace_id = cur.fields.get("trace_id") if cur is not None else None
+    if trace_id is None:
+        trace_id = getattr(logger, "trace_id", None)
+    return trace_pairs(
+        trace_id, cur.span_id if cur is not None else None
+    )
+
+
+def extract_trace_context(invocation_metadata) -> dict[str, Any]:
+    """Parse inbound gRPC metadata into span fields: ``trace_id``,
+    ``remote_parent_id`` (the SENDER's span id — a different id space than
+    local ``parent_id``), ``round``, ``rpc_send_time`` (sender wall clock),
+    ``remote_node``. Missing or malformed entries are simply absent —
+    un-instrumented peers must interoperate."""
+    md: dict[str, str] = {}
+    for k, v in (invocation_metadata or ()):
+        md[str(k).lower()] = v
+    out: dict[str, Any] = {}
+    if md.get(TRACE_ID_KEY):
+        out["trace_id"] = str(md[TRACE_ID_KEY])
+    if md.get(NODE_KEY):
+        out["remote_node"] = str(md[NODE_KEY])
+    for key, field, conv in (
+        (PARENT_SPAN_KEY, "remote_parent_id", int),
+        (ROUND_KEY, "round", int),
+        (SEND_TIME_KEY, "rpc_send_time", float),
+    ):
+        v = md.get(key)
+        if v is not None:
+            try:
+                out[field] = conv(v)
+            except (TypeError, ValueError):
+                pass
+    return out
 
 
 # ---- jit wrappers -----------------------------------------------------------
@@ -503,6 +655,153 @@ def trace(log_dir: str | None) -> Iterator[None]:
         yield
 
 
+def parse_round_window(spec: str) -> tuple[int, int]:
+    """Parse a ``--profile_rounds`` window: ``"start:stop"`` (half-open) or
+    a single round ``"N"`` (= ``N:N+1``)."""
+    try:
+        if ":" in spec:
+            lo_s, hi_s = spec.split(":", 1)
+            lo, hi = int(lo_s), int(hi_s)
+        else:
+            lo = int(spec)
+            hi = lo + 1
+    except ValueError:
+        raise ValueError(
+            f"bad round window {spec!r}: expected 'start:stop' or 'round'"
+        )
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"bad round window {spec!r}: need 0 <= start < stop"
+        )
+    return lo, hi
+
+
+class RoundProfiler:
+    """``jax.profiler`` capture around a round window [start, stop).
+
+    Driven by :meth:`observe` with the current round index — the server's
+    round loop and the client servicer (which learns the round from each
+    ``StepRequest``) both just report rounds as they see them; the profiler
+    starts the trace on the first round inside the window and stops it on
+    the first round at/after ``stop`` (or at :meth:`close`). A ``None``
+    ``profile_dir`` makes every method a no-op; a profiler backend failure
+    disables the instance loudly rather than killing the round loop.
+    """
+
+    def __init__(self, profile_dir: str | None, rounds: str = "1:2",
+                 metrics: MetricsLogger | None = None):
+        self.profile_dir = profile_dir
+        self.metrics = metrics
+        self.start_round, self.stop_round = parse_round_window(rounds)
+        self._active = False
+        self._disabled = profile_dir is None
+        self._lock = threading.Lock()
+
+    def observe(self, round_idx: int) -> None:
+        if self._disabled:
+            return
+        with self._lock:
+            if (not self._active and
+                    self.start_round <= round_idx < self.stop_round):
+                self._start(round_idx)
+            elif self._active and round_idx >= self.stop_round:
+                self._stop(round_idx)
+
+    def close(self) -> None:
+        if self._disabled:
+            return
+        with self._lock:
+            if self._active:
+                self._stop(self.stop_round)
+
+    # callers hold self._lock
+    def _start(self, round_idx: int) -> None:
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+        except Exception as err:  # backend without profiler support
+            self._disabled = True
+            if self.metrics is not None:
+                self.metrics.registry.counter("profiler_failures").inc()
+            import logging
+
+            logging.getLogger("RoundProfiler").warning(
+                "jax.profiler unavailable (%s); device profiling disabled",
+                err,
+            )
+            return
+        self._active = True
+        if self.metrics is not None:
+            self.metrics.log(
+                "profiler_started", dir=self.profile_dir, round=round_idx,
+            )
+
+    def _stop(self, round_idx: int) -> None:
+        self._active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as err:
+            self._disabled = True
+            import logging
+
+            logging.getLogger("RoundProfiler").warning(
+                "jax.profiler.stop_trace failed: %s", err,
+            )
+            return
+        if self.metrics is not None:
+            self.metrics.log("profiler_stopped", round=round_idx)
+
+
+class DeviceMemoryMonitor:
+    """Per-device memory gauges (``device_bytes_in_use/<dev>``,
+    ``device_peak_bytes_in_use/<dev>``) from ``jax.local_devices()``'s
+    ``memory_stats()``. Devices are probed once on the first :meth:`sample`;
+    platforms without memory introspection (CPU) leave the device list
+    empty and every later call returns immediately."""
+
+    def __init__(self, registry: MetricRegistry):
+        self.registry = registry
+        self._devices: list[tuple[str, Any]] | None = None
+
+    def _probe(self) -> list[tuple[str, Any]]:
+        devices: list[tuple[str, Any]] = []
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    continue
+                if isinstance(stats, dict) and stats:
+                    devices.append((f"{d.platform}{d.id}", d))
+        except Exception:
+            pass
+        return devices
+
+    def sample(self) -> None:
+        if self._devices is None:
+            self._devices = self._probe()
+        for label, dev in self._devices:
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                self.registry.gauge(f"device_bytes_in_use/{label}").set(
+                    in_use
+                )
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                self.registry.gauge(
+                    f"device_peak_bytes_in_use/{label}"
+                ).set(peak)
+
+
 # ---- run summaries (the `summarize` CLI subcommand's engine) ----------------
 
 def read_metrics(path: str) -> list[dict[str, Any]]:
@@ -550,6 +849,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
     rounds = {"count": 0, "total_s": 0.0, "bytes_pulled": 0.0,
               "bytes_pushed": 0.0}
     slowest: dict[Any, dict] = {}
+    stragglers: dict[Any, dict] = {}
     compile_events: list[dict[str, Any]] = []
     rpc_errors: list[dict[str, Any]] = []
     last_snapshots: dict[str, dict] = {}
@@ -578,6 +878,12 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
                     s["max_poll_s"] = max(
                         s["max_poll_s"], float(r.get("slowest_s", 0))
                     )
+        elif event == "straggler_detected":
+            st = stragglers.setdefault(
+                r.get("client"), {"count": 0, "max_z": 0.0}
+            )
+            st["count"] += 1
+            st["max_z"] = max(st["max_z"], float(r.get("z", 0.0)))
         elif event == "jit_compile":
             compile_events.append(
                 {"what": r.get("what"), "seconds": r.get("seconds")}
@@ -631,6 +937,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
         "spans": spans,
         "rounds": rounds,
         "slowest_clients": slowest,
+        "stragglers": stragglers,
         "step_time": step_time,
         "rpc": rpc,
         "histograms": other_hists,
@@ -753,6 +1060,12 @@ def format_report(s: dict[str, Any]) -> str:
                 f"{worst[1]['rounds_slowest']}/{per} rounds, max poll "
                 f"{_fmt_s(worst[1]['max_poll_s'])})"
             )
+        for cid, st in sorted(s.get("stragglers", {}).items(),
+                              key=lambda kv: -kv[1]["count"]):
+            lines.append(
+                f"  straggler detected: client {cid} x{st['count']} "
+                f"(max z {st['max_z']:.1f})"
+            )
 
     enc = s["counters"].get("codec_encoded_bytes")
     dec = s["counters"].get("codec_decoded_bytes")
@@ -776,3 +1089,412 @@ def format_report(s: dict[str, Any]) -> str:
         lines.append(f"run result: {json.dumps(s['summary'], default=str)}")
 
     return "\n".join(lines)
+
+
+# ---- Prometheus exposition + live ops endpoint ------------------------------
+
+_PROM_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _PROM_NAME_OK.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def render_prometheus(snapshot: dict[str, Any],
+                      prefix: str = "gfedntm") -> str:
+    """Render a :meth:`MetricRegistry.snapshot` dict as Prometheus text
+    exposition (version 0.0.4). Registry names like
+    ``rpc_s/FederationClient.TrainStep`` split at the first ``/`` into the
+    metric family (sanitized) plus a ``key`` label, so per-client and
+    per-method series stay one scrapeable family."""
+    families: dict[str, list[tuple[str, dict[str, Any]]]] = {}
+    for name, snap in snapshot.items():
+        base, _, key = name.partition("/")
+        families.setdefault(_prom_name(base), []).append((key, snap))
+
+    lines: list[str] = []
+    for base in sorted(families):
+        series = sorted(families[base])
+        kind = series[0][1].get("type")
+        full = f"{prefix}_{base}"
+        if kind == "counter":
+            full += "_total"
+        if kind in ("counter", "gauge", "histogram"):
+            lines.append(f"# TYPE {full} {kind}")
+        for key, snap in series:
+            label = f'{{key="{_prom_label(key)}"}}' if key else ""
+            if kind == "counter":
+                lines.append(f"{full}{label} {snap['value']}")
+            elif kind == "gauge":
+                if snap["value"] is not None:
+                    lines.append(f"{full}{label} {snap['value']}")
+            elif kind == "histogram":
+                base_label = (
+                    f'key="{_prom_label(key)}",' if key else ""
+                )
+                cum = 0
+                for edge, count in zip(snap["edges"], snap["counts"]):
+                    cum += count
+                    lines.append(
+                        f'{full}_bucket{{{base_label}le="{edge}"}} {cum}'
+                    )
+                cum += snap["counts"][-1]
+                lines.append(
+                    f'{full}_bucket{{{base_label}le="+Inf"}} {cum}'
+                )
+                lines.append(f"{full}_sum{label} {snap['sum']}")
+                lines.append(f"{full}_count{label} {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class OpsServer:
+    """Live ops endpoint: a stdlib ``ThreadingHTTPServer`` on a daemon
+    thread serving
+
+    - ``/healthz`` — liveness probe (``200 ok``);
+    - ``/metrics`` — Prometheus text exposition of the registry
+      (:func:`render_prometheus`);
+    - ``/status`` — JSON from ``status_fn`` (the federation server's live
+      round / membership / codec view).
+
+    Entirely out of the training hot path: no thread is started unless
+    :meth:`start` is called, and handlers only *read* registry snapshots.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None,
+                 status_fn=None, host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or MetricRegistry()
+        self.status_fn = status_fn
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the actual port
+        (``port=0`` binds an ephemeral one)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        code, ctype, body = 200, "text/plain", b"ok\n"
+                    elif path == "/metrics":
+                        text = render_prometheus(ops.registry.snapshot())
+                        code = 200
+                        ctype = "text/plain; version=0.0.4"
+                        body = text.encode()
+                    elif path == "/status":
+                        status = ops.status_fn() if ops.status_fn else {}
+                        code, ctype = 200, "application/json"
+                        body = json.dumps(
+                            status, default=str, indent=1
+                        ).encode()
+                    else:
+                        code, ctype, body = 404, "text/plain", b"not found\n"
+                except Exception as err:  # never kill the serving thread
+                    code, ctype = 500, "text/plain"
+                    body = f"error: {err}\n".encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ops-server", daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# ---- straggler analytics ----------------------------------------------------
+
+class StragglerDetector:
+    """Rolling per-client step-time EWMAs with z-score outlier flagging.
+
+    Each round the server reports the warmed clients' poll latencies
+    (:meth:`observe_round`); the detector updates one EWMA gauge per client
+    (``client_step_ewma_s/clientN``) and flags any client whose EWMA sits
+    more than ``z_threshold`` standard deviations above the population
+    mean — provided the population is large enough to make a z-score
+    meaningful (``min_clients``), the client has enough history
+    (``min_rounds``), AND its EWMA exceeds ``min_ratio`` × the mean: a
+    z-score alone is scale-invariant, so in a tightly-clustered fleet a
+    client microseconds slower than its peers would otherwise flag.
+    :meth:`status` serves the current per-client view to the ops
+    endpoint's ``/status``.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None,
+                 z_threshold: float = 2.0, alpha: float = 0.3,
+                 min_clients: int = 3, min_rounds: int = 3,
+                 min_ratio: float = 1.5):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.registry = registry
+        self.z_threshold = float(z_threshold)
+        self.alpha = float(alpha)
+        self.min_clients = int(min_clients)
+        self.min_rounds = int(min_rounds)
+        self.min_ratio = float(min_ratio)
+        self._ewma: dict[Any, float] = {}
+        self._rounds: dict[Any, int] = {}
+        self._current: dict[Any, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def observe_round(
+        self, latencies: dict[Any, float]
+    ) -> list[dict[str, Any]]:
+        """Fold one round's per-client latencies in; returns the newly
+        computed stragglers as ``{"client", "z", "ewma_s"}`` dicts."""
+        with self._lock:
+            for cid, lat in latencies.items():
+                prev = self._ewma.get(cid)
+                self._ewma[cid] = (
+                    float(lat) if prev is None
+                    else self.alpha * float(lat) + (1 - self.alpha) * prev
+                )
+                self._rounds[cid] = self._rounds.get(cid, 0) + 1
+                if self.registry is not None:
+                    self.registry.gauge(
+                        f"client_step_ewma_s/client{cid}"
+                    ).set(self._ewma[cid])
+            mature = {
+                cid: e for cid, e in self._ewma.items()
+                if self._rounds[cid] >= self.min_rounds
+            }
+            self._current = {
+                cid: {"ewma_s": e, "z": None, "straggler": False}
+                for cid, e in self._ewma.items()
+            }
+            if len(mature) < self.min_clients:
+                return []
+            values = list(mature.values())
+            mean = sum(values) / len(values)
+            var = sum((v - mean) ** 2 for v in values) / len(values)
+            std = var ** 0.5
+            if std <= 1e-12:
+                return []
+            flagged = []
+            for cid, e in mature.items():
+                z = (e - mean) / std
+                self._current[cid]["z"] = z
+                if (
+                    z > self.z_threshold and e > self.min_ratio * mean
+                    and cid in latencies
+                ):
+                    self._current[cid]["straggler"] = True
+                    flagged.append({"client": cid, "z": z, "ewma_s": e})
+            return flagged
+
+    def forget(self, client_id: Any) -> None:
+        """Evict a departed client: a dropped client's frozen EWMA would
+        otherwise skew the population mean/std forever (inflating std so
+        genuine new stragglers stop flagging) and haunt ``/status``. The
+        already-exported gauge keeps its last value — registries are
+        cumulative — but the client leaves the live population. A rejoin
+        re-warms from scratch, like the server's poll warm-up."""
+        with self._lock:
+            self._ewma.pop(client_id, None)
+            self._rounds.pop(client_id, None)
+            self._current.pop(client_id, None)
+
+    def status(self) -> dict[str, dict[str, Any]]:
+        """JSON-safe per-client view for the ops endpoint."""
+        with self._lock:
+            return {
+                str(cid): dict(state)
+                for cid, state in sorted(self._current.items(), key=str)
+            }
+
+
+# ---- cross-node trace merge (the `trace` CLI subcommand's engine) -----------
+
+def _serve_offset_samples(
+    records: list[dict[str, Any]], remote: str
+) -> list[float]:
+    """``recv - send`` deltas of ``serve`` spans received FROM ``remote``:
+    each sample is (receiver clock − sender clock) + network latency, so
+    the minimum over many samples approaches the clock offset plus the
+    latency floor."""
+    out = []
+    for r in records:
+        if (
+            r.get("event") == "span" and r.get("name") == "serve"
+            and r.get("remote_node") == remote
+            and isinstance(r.get("rpc_send_time"), (int, float))
+            and isinstance(r.get("rpc_recv_time"), (int, float))
+        ):
+            out.append(float(r["rpc_recv_time"]) - float(r["rpc_send_time"]))
+    return out
+
+
+def estimate_clock_offset(
+    node_records: list[dict[str, Any]],
+    ref_records: list[dict[str, Any]],
+    node: str, ref: str,
+) -> float:
+    """Seconds by which ``node``'s wall clock leads the reference's,
+    NTP-style from the paired RPC send/recv stamps: with both directions
+    available the latency floors cancel (``(min fwd − min rev) / 2``); a
+    single direction degrades to the one-way bound."""
+    fwd = _serve_offset_samples(node_records, ref)   # offset + latency
+    rev = _serve_offset_samples(ref_records, node)   # -offset + latency
+    if fwd and rev:
+        return (min(fwd) - min(rev)) / 2.0
+    if fwd:
+        return min(fwd)
+    if rev:
+        return -min(rev)
+    return 0.0
+
+
+def merge_chrome_trace(
+    node_records: dict[str, list[dict[str, Any]]],
+    reference: str | None = None,
+) -> dict[str, Any]:
+    """Merge per-node telemetry streams into one Chrome trace-event JSON
+    (load in Perfetto / chrome://tracing).
+
+    One pid per node (the reference — the node owning the ``round`` spans —
+    first), one tid per emitting thread, every ``span`` event an ``X``
+    slice whose wall-clock start is shifted onto the reference clock by
+    :func:`estimate_clock_offset`. ``serve`` spans carrying a
+    ``remote_parent_id`` additionally get flow arrows from the sender's
+    span, so a round renders as one connected tree across all processes.
+    """
+    if not node_records:
+        raise ValueError("no node records to merge")
+    if reference is None or reference not in node_records:
+        if reference is not None:
+            raise ValueError(
+                f"reference node {reference!r} not among "
+                f"{sorted(node_records)}"
+            )
+        reference = next(
+            (
+                node for node, recs in sorted(node_records.items())
+                if any(
+                    r.get("event") == "span" and r.get("name") == "round"
+                    for r in recs
+                )
+            ),
+            sorted(node_records)[0],
+        )
+
+    offsets = {
+        node: (
+            0.0 if node == reference else estimate_clock_offset(
+                recs, node_records[reference], node, reference
+            )
+        )
+        for node, recs in node_records.items()
+    }
+
+    # Wall-clock zero: earliest aligned span start across all nodes.
+    starts = [
+        float(r["time"]) - float(r.get("seconds", 0.0)) - offsets[node]
+        for node, recs in node_records.items()
+        for r in recs
+        if r.get("event") == "span" and isinstance(r.get("time"), (int, float))
+    ]
+    t0 = min(starts) if starts else 0.0
+
+    order = [reference] + sorted(n for n in node_records if n != reference)
+    events: list[dict[str, Any]] = []
+    # (node, span_id) -> (pid, tid, start_us) for flow binding
+    span_index: dict[tuple[str, int], tuple[int, int, float]] = {}
+    flows: list[tuple[str, dict[str, Any], float]] = []
+
+    for pid, node in enumerate(order):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": node},
+        })
+        tids: dict[Any, int] = {}
+        for r in node_records[node]:
+            if r.get("event") != "span":
+                continue
+            seconds = float(r.get("seconds", 0.0))
+            start_us = (
+                float(r["time"]) - seconds - offsets[node] - t0
+            ) * 1e6
+            tid = tids.setdefault(r.get("thread", 0), len(tids))
+            args = {
+                k: v for k, v in r.items()
+                if k not in ("event", "time", "seconds", "thread", "name")
+                and v is not None
+            }
+            events.append({
+                "name": str(r.get("name", "span")), "cat": "span",
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": round(start_us, 3),
+                "dur": round(max(seconds, 1e-6) * 1e6, 3),
+                "args": args,
+            })
+            if isinstance(r.get("span_id"), int):
+                span_index[(node, r["span_id"])] = (pid, tid, start_us)
+            if (
+                r.get("name") == "serve"
+                and isinstance(r.get("remote_parent_id"), int)
+                and isinstance(r.get("remote_node"), str)
+            ):
+                flows.append((node, r, start_us))
+
+    flow_id = 0
+    for node, r, child_start_us in flows:
+        parent = span_index.get((r["remote_node"], r["remote_parent_id"]))
+        if parent is None:
+            continue
+        flow_id += 1
+        p_pid, p_tid, p_start_us = parent
+        c_pid, c_tid, _ = span_index[(node, r["span_id"])]
+        events.append({
+            "name": "rpc", "cat": "trace", "ph": "s", "id": flow_id,
+            "pid": p_pid, "tid": p_tid,
+            "ts": round(max(p_start_us, 0.0) + 0.5, 3),
+        })
+        events.append({
+            "name": "rpc", "cat": "trace", "ph": "f", "bp": "e",
+            "id": flow_id, "pid": c_pid, "tid": c_tid,
+            "ts": round(child_start_us, 3),
+        })
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "reference": reference,
+            "clock_offsets_s": {n: offsets[n] for n in order},
+            "epoch_origin_unix_s": t0,
+        },
+    }

@@ -27,12 +27,18 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 #: `.log("` with a string literal first arg is a telemetry emission.
 _LOG_CALL = re.compile(r"""\.log\(\s*\n?\s*["']([a-z][a-z0-9_]*)["']""")
 
+#: `span(<logger-expr>, "name", ...)` call sites — the span-name vocabulary
+#: the trace-merge CLI keys on (observability.TRACE_PLANE_SPANS) must keep
+#: existing here, or `trace` would merge streams that can never contain the
+#: spans it aligns and parents by.
+_SPAN_CALL = re.compile(
+    r"""\bspan\(\s*\n?\s*[\w.()\[\]]+\s*,\s*\n?\s*["']([a-z][a-z0-9_]*)["']"""
+)
+
 SCAN_ROOTS = ("gfedntm_tpu", "bench.py")
 
 
-def emitted_events() -> dict[str, list[str]]:
-    """Map of event name -> list of ``path:line`` emission sites."""
-    sites: dict[str, list[str]] = {}
+def _scan_paths() -> list[str]:
     paths: list[str] = []
     for root in SCAN_ROOTS:
         full = os.path.join(REPO, root)
@@ -43,18 +49,37 @@ def emitted_events() -> dict[str, list[str]]:
             paths.extend(
                 os.path.join(dirpath, f) for f in files if f.endswith(".py")
             )
-    for path in sorted(paths):
+    return sorted(paths)
+
+
+def _call_sites(pattern: "re.Pattern") -> dict[str, list[str]]:
+    """Map of matched name -> list of ``path:line`` sites."""
+    sites: dict[str, list[str]] = {}
+    for path in _scan_paths():
         text = open(path).read()
-        for m in _LOG_CALL.finditer(text):
+        for m in pattern.finditer(text):
             line = text.count("\n", 0, m.start()) + 1
             rel = os.path.relpath(path, REPO)
             sites.setdefault(m.group(1), []).append(f"{rel}:{line}")
     return sites
 
 
+def emitted_events() -> dict[str, list[str]]:
+    """Map of event name -> list of ``path:line`` emission sites."""
+    return _call_sites(_LOG_CALL)
+
+
+def declared_spans() -> dict[str, list[str]]:
+    """Map of span name -> list of ``path:line`` span() call sites."""
+    return _call_sites(_SPAN_CALL)
+
+
 def main() -> int:
     sys.path.insert(0, REPO)
-    from gfedntm_tpu.utils.observability import EVENT_SCHEMAS
+    from gfedntm_tpu.utils.observability import (
+        EVENT_SCHEMAS,
+        TRACE_PLANE_SPANS,
+    )
 
     sites = emitted_events()
     if not sites:
@@ -73,9 +98,24 @@ def main() -> int:
         for name, where in sorted(drift.items()):
             sys.stderr.write(f"  {name!r}: {', '.join(where)}\n")
         return 1
+    spans = declared_spans()
+    if not spans:
+        sys.stderr.write("lint_telemetry: found no span() call sites — "
+                         "the span scanner regex is probably broken\n")
+        return 1
+    missing = [n for n in TRACE_PLANE_SPANS if n not in spans]
+    if missing:
+        sys.stderr.write(
+            "trace-plane drift: span names the trace-merge CLI relies on "
+            f"(observability.TRACE_PLANE_SPANS) have no span() call site: "
+            f"{missing}\n"
+        )
+        return 1
     print(
         f"telemetry lint: {len(sites)} distinct events across "
-        f"{sum(len(w) for w in sites.values())} call sites, all registered"
+        f"{sum(len(w) for w in sites.values())} call sites, all "
+        f"registered; {len(spans)} span names cover the trace plane's "
+        f"{list(TRACE_PLANE_SPANS)}"
     )
     return 0
 

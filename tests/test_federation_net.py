@@ -418,3 +418,66 @@ def test_grpc_federation_local_steps(tmp_path):
 def test_server_rejects_invalid_local_steps():
     with pytest.raises(ValueError):
         FederatedServer(min_clients=1, local_steps=0)
+
+
+def test_step_reply_nr_samples_sums_all_local_minibatches():
+    """ADVICE r5: with local_steps E>1 the StepReply must report the
+    samples consumed across ALL E minibatches (sum of mask sums), not the
+    last — possibly partial tail — batch, or sample-weighted FedAvg weights
+    a whole E-step round by one batch."""
+    import logging
+
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.federated.stepper import FederatedStepper
+    from gfedntm_tpu.federation.client import FederatedClientServicer
+    from gfedntm_tpu.models.avitm import AVITM
+
+    docs, vocab, batch = 10, 30, 4  # epoch = batches of 4, 4, 2
+    rng = np.random.default_rng(0)
+    dataset = BowDataset(
+        X=rng.integers(0, 3, size=(docs, vocab)).astype(np.float32),
+        idx2token={i: f"wd{i}" for i in range(vocab)},
+    )
+    model = AVITM(
+        input_size=vocab, n_components=3, hidden_sizes=(8,),
+        batch_size=batch, num_epochs=1, seed=0,
+    )
+    stepper = FederatedStepper(model)
+    stepper.pre_fit(dataset)
+    servicer = FederatedClientServicer(
+        1, stepper, on_stop=lambda: None,
+        logger=logging.getLogger("test"),
+    )
+    reply = servicer.TrainStep(
+        pb.StepRequest(global_iter=0, local_steps=3), None
+    )
+    # the whole epoch ran in one round: 4 + 4 + 2 samples, not the tail 2
+    assert reply.nr_samples == docs
+    assert stepper._last_batch_size == docs - 2 * batch
+
+
+def test_fedavg_weights_by_reply_samples_with_join_time_fallback():
+    """The server's aggregate must weight each contributor by the samples
+    its reply says it consumed THIS round; a reply that reports none (a
+    pre-plane client) falls back to the join-time corpus size."""
+    from gfedntm_tpu.federation import codec
+    from gfedntm_tpu.federation.registry import ClientRecord
+    from gfedntm_tpu.federation.server import build_template_model
+
+    server = FederatedServer(
+        min_clients=2, family="avitm",
+        model_kwargs=dict(n_components=3, hidden_sizes=(8,)),
+    )
+    server.template = build_template_model(
+        "avitm", 30, dict(n_components=3, hidden_sizes=(8,))
+    )
+    tmpl = server._shared_template()
+    bundle = codec.flatdict_to_bundle(tmpl)
+    replies = [
+        (ClientRecord(1, nr_samples=100.0),
+         pb.StepReply(client_id=1, shared=bundle, nr_samples=24.0)),
+        (ClientRecord(2, nr_samples=50.0),
+         pb.StepReply(client_id=2, shared=bundle)),  # reports nothing
+    ]
+    out = server._collect_snapshots(replies, iteration=0)
+    assert [w for w, _snap in out] == [24.0, 50.0]

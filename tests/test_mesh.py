@@ -178,6 +178,32 @@ class TestSliceClientMesh:
             np.testing.assert_allclose(beta[0], beta[c], rtol=1e-5,
                                        atol=1e-6)
 
+    def test_distributed_slice_mesh_rejects_uneven_contributions(self):
+        """A total device count that merely divides evenly is not enough:
+        processes contributing unequal counts would let the reshape mix
+        processes within a row, putting DCN hops on the 'ICI' inner axis
+        (ADVICE r5) — it must fail loudly instead."""
+        import pytest as _pytest
+
+        from gfedntm_tpu.parallel.mesh import distributed_slice_client_mesh
+
+        class FakeDev:
+            def __init__(self, process_index, dev_id):
+                self.process_index = process_index
+                self.id = dev_id
+
+        # 4 devices over 2 processes, but split 3+1 (total still divides)
+        uneven = [FakeDev(0, 0), FakeDev(0, 1), FakeDev(0, 2), FakeDev(1, 3)]
+        with _pytest.raises(ValueError, match="exactly 2 devices"):
+            distributed_slice_client_mesh(devices=uneven, n_proc=2)
+        # declared process count must match the processes actually present
+        one_proc = [FakeDev(0, i) for i in range(4)]
+        with _pytest.raises(ValueError, match="every process"):
+            distributed_slice_client_mesh(devices=one_proc, n_proc=2)
+        # non-divisible totals keep the original loud failure
+        with _pytest.raises(ValueError, match="divide evenly"):
+            distributed_slice_client_mesh(devices=one_proc[:3], n_proc=2)
+
     def test_distributed_slice_client_mesh_single_process(self):
         """Single process: 1 x n_devices grid — the degenerate slice
         axis; the trainer accepts it like any multi-axis mesh."""

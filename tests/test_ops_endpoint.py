@@ -1,0 +1,375 @@
+"""Live ops endpoint + device-hook tests (tier-1): Prometheus text
+exposition (counters/gauges/histograms, name sanitization, every line
+format-parsable), the OpsServer routes against a live in-process HTTP
+server, the FederatedServer's /status payload, the straggler detector's
+z-score flagging, the RoundProfiler window state machine (monkeypatched
+jax.profiler), and the CPU no-op of the device-memory monitor."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gfedntm_tpu.utils.observability import (
+    DeviceMemoryMonitor,
+    MetricRegistry,
+    MetricsLogger,
+    OpsServer,
+    RoundProfiler,
+    StragglerDetector,
+    parse_round_window,
+    render_prometheus,
+)
+
+_PROM_LINE = re.compile(
+    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+)$"
+)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+# ---- Prometheus exposition ---------------------------------------------------
+
+class TestRenderPrometheus:
+    def test_counter_gauge_histogram_families(self):
+        reg = MetricRegistry()
+        reg.counter("rpc_calls").inc(3)
+        reg.gauge("compression_ratio_sent").set(2.5)
+        reg.gauge("unset_gauge")  # value None: must be omitted, not "None"
+        h = reg.histogram("rpc_s/Federation.TrainStep", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = render_prometheus(reg.snapshot())
+        lines = text.strip().splitlines()
+        for line in lines:
+            assert _PROM_LINE.match(line), f"bad line: {line!r}"
+        assert "# TYPE gfedntm_rpc_calls_total counter" in lines
+        assert "gfedntm_rpc_calls_total 3.0" in lines
+        assert "gfedntm_compression_ratio_sent 2.5" in lines
+        assert not any("unset_gauge" in ln and "None" in ln for ln in lines)
+        # histogram: cumulative buckets + +Inf + sum/count, keyed label
+        assert (
+            'gfedntm_rpc_s_bucket{key="Federation.TrainStep",le="0.1"} 1'
+            in lines
+        )
+        assert (
+            'gfedntm_rpc_s_bucket{key="Federation.TrainStep",le="+Inf"} 2'
+            in lines
+        )
+        assert 'gfedntm_rpc_s_count{key="Federation.TrainStep"} 2' in lines
+
+    def test_slash_names_become_key_labels_and_sanitize(self):
+        reg = MetricRegistry()
+        reg.gauge("client_staleness_mb/client7").set(1)
+        reg.gauge("device_bytes_in_use/tpu0").set(12345)
+        reg.counter("weird-name/with spaces").inc()
+        text = render_prometheus(reg.snapshot())
+        for line in text.strip().splitlines():
+            assert _PROM_LINE.match(line), f"bad line: {line!r}"
+        assert 'gfedntm_client_staleness_mb{key="client7"} 1.0' in text
+        assert 'gfedntm_device_bytes_in_use{key="tpu0"} 12345.0' in text
+        assert 'gfedntm_weird_name_total{key="with spaces"} 1.0' in text
+
+    def test_label_values_escaped(self):
+        reg = MetricRegistry()
+        reg.counter('odd/va"lue\\x').inc()
+        text = render_prometheus(reg.snapshot())
+        assert '{key="va\\"lue\\\\x"}' in text
+
+    def test_empty_registry_renders_empty_exposition(self):
+        assert render_prometheus({}) == "\n"
+
+
+# ---- OpsServer routes --------------------------------------------------------
+
+class TestOpsServer:
+    def test_routes_against_live_server(self):
+        reg = MetricRegistry()
+        reg.counter("rpc_calls").inc(7)
+        ops = OpsServer(
+            registry=reg, status_fn=lambda: {"round": 4, "codec": "none"},
+        )
+        port = ops.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            code, ctype, body = _get(base + "/healthz")
+            assert (code, body) == (200, b"ok\n")
+
+            code, ctype, body = _get(base + "/metrics")
+            assert code == 200 and ctype.startswith("text/plain")
+            assert "version=0.0.4" in ctype
+            text = body.decode()
+            for line in text.strip().splitlines():
+                assert _PROM_LINE.match(line), f"bad line: {line!r}"
+            assert "gfedntm_rpc_calls_total 7.0" in text
+
+            code, ctype, body = _get(base + "/status")
+            assert code == 200 and ctype == "application/json"
+            assert json.loads(body) == {"round": 4, "codec": "none"}
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base + "/bogus")
+            assert err.value.code == 404
+        finally:
+            ops.stop()
+
+    def test_status_fn_failure_is_500_not_crash(self):
+        def boom():
+            raise RuntimeError("status exploded")
+
+        ops = OpsServer(status_fn=boom)
+        port = ops.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"http://127.0.0.1:{port}/status")
+            assert err.value.code == 500
+            # the serving thread survived: healthz still answers
+            code, _ctype, body = _get(f"http://127.0.0.1:{port}/healthz")
+            assert (code, body) == (200, b"ok\n")
+        finally:
+            ops.stop()
+
+    def test_no_status_fn_serves_empty_object(self):
+        ops = OpsServer()
+        port = ops.start()
+        try:
+            _code, _ctype, body = _get(f"http://127.0.0.1:{port}/status")
+            assert json.loads(body) == {}
+        finally:
+            ops.stop()
+
+
+class TestFederatedServerStatus:
+    def test_status_of_idle_server_over_http(self, tmp_path):
+        """/status against a live (pre-training) FederatedServer: round 0,
+        declared codec/aggregator, empty membership — the content contract
+        the live-run e2e (test_trace_plane) asserts mid-federation."""
+        from gfedntm_tpu.federation.server import FederatedServer
+
+        metrics = MetricsLogger(validate=True, node="server")
+        server = FederatedServer(
+            min_clients=2, family="avitm",
+            model_kwargs=dict(n_components=3, hidden_sizes=(8,)),
+            metrics=metrics, ops_port=0, wire_codec="delta+fp16",
+            aggregator="fedadam",
+        )
+        addr = server.start("[::]:0")
+        assert addr
+        try:
+            assert server.ops_actual_port
+            base = f"http://127.0.0.1:{server.ops_actual_port}"
+            status = json.loads(_get(base + "/status")[2])
+            assert status["round"] == 0
+            assert status["training_started"] is False
+            assert status["training_done"] is False
+            assert status["codec"] == "delta+fp16"
+            assert status["aggregator"] == "fedadam"
+            assert status["min_clients"] == 2
+            assert status["clients"] == []
+            assert status["stragglers"] == {}
+            assert status["compression"] == {
+                "ratio_sent": None, "ratio_recv": None,
+            }
+            # membership appears as soon as a client registers
+            server.federation.connect_vocab(5, ("tok",), 12.0)
+            status = json.loads(_get(base + "/status")[2])
+            (rec,) = status["clients"]
+            assert rec["client_id"] == 5
+            assert rec["status"] == "active"
+            assert rec["nr_samples"] == 12.0
+            assert rec["last_loss"] is None  # NaN must serialize as null
+            (started,) = metrics.events("ops_server_started")
+            assert started["port"] == server.ops_actual_port
+        finally:
+            server.stop()
+        # stopped: the port no longer answers
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(base + "/healthz", timeout=2)
+
+    def test_no_ops_port_starts_no_ops_server(self):
+        from gfedntm_tpu.federation.server import FederatedServer
+
+        server = FederatedServer(min_clients=1)
+        server.start("[::]:0")
+        try:
+            assert server.ops_actual_port is None
+            assert server._ops_server is None
+        finally:
+            server.stop()
+
+
+# ---- straggler analytics -----------------------------------------------------
+
+class TestStragglerDetector:
+    def test_flags_outlier_after_history(self):
+        reg = MetricRegistry()
+        det = StragglerDetector(
+            registry=reg, z_threshold=1.5, min_clients=3, min_rounds=3,
+        )
+        flagged = []
+        for _ in range(5):
+            flagged = det.observe_round({1: 0.10, 2: 0.11, 3: 0.10, 4: 0.50})
+        assert [f["client"] for f in flagged] == [4]
+        assert flagged[0]["z"] > 1.5
+        assert flagged[0]["ewma_s"] == pytest.approx(0.5, rel=0.05)
+        # per-client EWMA gauges exist for all observed clients
+        for cid in (1, 2, 3, 4):
+            assert reg.get(f"client_step_ewma_s/client{cid}") is not None
+        status = det.status()
+        assert status["4"]["straggler"] is True
+        assert status["1"]["straggler"] is False
+        assert status["4"]["z"] > status["1"]["z"]
+
+    def test_needs_population_and_history(self):
+        det = StragglerDetector(min_clients=3, min_rounds=3)
+        # two clients: never enough population for a z-score
+        for _ in range(10):
+            assert det.observe_round({1: 0.1, 2: 9.9}) == []
+        det = StragglerDetector(min_clients=3, min_rounds=3)
+        # rounds 1-2: history too short even with a wild outlier
+        assert det.observe_round({1: 0.1, 2: 0.1, 3: 5.0}) == []
+        assert det.observe_round({1: 0.1, 2: 0.1, 3: 5.0}) == []
+
+    def test_uniform_population_never_flags(self):
+        det = StragglerDetector(min_clients=3, min_rounds=1)
+        for _ in range(5):
+            assert det.observe_round({1: 0.2, 2: 0.2, 3: 0.2}) == []
+
+    def test_recovered_client_unflags(self):
+        # z_threshold 1.5: one outlier among n clients caps at z=sqrt(n-1),
+        # so the default 2.0 is unreachable in a 4-client population
+        det = StragglerDetector(
+            min_clients=3, min_rounds=2, alpha=0.9, z_threshold=1.5,
+        )
+        for _ in range(4):
+            det.observe_round({1: 0.1, 2: 0.1, 3: 0.1, 4: 1.0})
+        assert det.status()["4"]["straggler"] is True
+        for _ in range(4):
+            det.observe_round({1: 0.1, 2: 0.1, 3: 0.1, 4: 0.1})
+        assert det.status()["4"]["straggler"] is False
+
+    def test_forget_evicts_dropped_client_from_population(self):
+        det = StragglerDetector(min_clients=3, min_rounds=2, z_threshold=1.5)
+        for _ in range(4):
+            det.observe_round({1: 0.1, 2: 0.1, 3: 0.1, 4: 10.0})
+        assert det.status()["4"]["straggler"] is True
+        det.forget(4)  # dropped: its frozen 10s EWMA must leave the stats
+        assert "4" not in det.status()
+        # the remaining tight cluster is undisturbed by the ghost; a NEW
+        # modest straggler is still detectable against it
+        flagged = []
+        for _ in range(4):
+            flagged = det.observe_round({1: 0.1, 2: 0.1, 3: 0.1, 5: 0.5})
+        assert [f["client"] for f in flagged] == [5]
+        det.forget(99)  # unknown id is a no-op
+
+    def test_rejects_bad_alpha(self):
+        with pytest.raises(ValueError):
+            StragglerDetector(alpha=0.0)
+
+
+# ---- device profiler window --------------------------------------------------
+
+class TestRoundProfiler:
+    def test_window_parse(self):
+        assert parse_round_window("1:3") == (1, 3)
+        assert parse_round_window("4") == (4, 5)
+        for bad in ("", "x", "3:3", "5:2", "-1:2", "1:2:3"):
+            with pytest.raises(ValueError):
+                parse_round_window(bad)
+
+    def test_none_dir_is_total_noop(self):
+        prof = RoundProfiler(None)
+        for r in range(5):
+            prof.observe(r)
+        prof.close()  # never touches jax
+
+    def test_window_drives_start_and_stop(self, monkeypatch, tmp_path):
+        import jax
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d, **kw: calls.append(("start", d)),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop", None)),
+        )
+        log = MetricsLogger(validate=True)
+        prof = RoundProfiler(str(tmp_path), rounds="2:4", metrics=log)
+        for r in range(6):
+            prof.observe(r)
+        prof.close()
+        assert calls == [("start", str(tmp_path)), ("stop", None)]
+        (started,) = log.events("profiler_started")
+        assert started["round"] == 2 and started["dir"] == str(tmp_path)
+        (stopped,) = log.events("profiler_stopped")
+        assert stopped["round"] == 4
+
+    def test_close_stops_open_window(self, monkeypatch, tmp_path):
+        import jax
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d, **kw: calls.append("start"),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append("stop"),
+        )
+        prof = RoundProfiler(str(tmp_path), rounds="0:100")
+        prof.observe(0)
+        prof.close()  # run ended mid-window
+        assert calls == ["start", "stop"]
+        prof.close()  # idempotent
+        assert calls == ["start", "stop"]
+
+    def test_profiler_backend_failure_disables_not_raises(
+        self, monkeypatch, tmp_path
+    ):
+        import jax
+
+        def explode(d, **kw):
+            raise RuntimeError("no profiler in this backend")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", explode)
+        log = MetricsLogger(validate=True)
+        prof = RoundProfiler(str(tmp_path), rounds="0:2", metrics=log)
+        prof.observe(0)  # swallowed, disables
+        prof.observe(1)
+        prof.close()
+        assert log.events("profiler_started") == []
+        assert log.registry.get("profiler_failures").value == 1
+
+
+# ---- device memory -----------------------------------------------------------
+
+class TestDeviceMemoryMonitor:
+    def test_sample_is_safe_everywhere(self):
+        """On CPU memory_stats() is unavailable — sample() must probe once,
+        then no-op; on accelerators it fills device_bytes_in_use gauges.
+        Either way: no exceptions, snapshot stays serializable."""
+        reg = MetricRegistry()
+        mon = DeviceMemoryMonitor(reg)
+        mon.sample()
+        mon.sample()  # second call takes the cached-probe path
+        snap = reg.snapshot()
+        json.dumps(snap)  # JSON-safe regardless of platform
+        for name, m in snap.items():
+            if name.startswith("device_bytes_in_use/"):
+                assert m["type"] == "gauge" and m["value"] >= 0
+
+    def test_probe_failure_leaves_empty_device_list(self, monkeypatch):
+        import gfedntm_tpu.utils.observability as obs
+
+        mon = DeviceMemoryMonitor(MetricRegistry())
+        monkeypatch.setattr(
+            obs.DeviceMemoryMonitor, "_probe", lambda self: [],
+        )
+        mon.sample()
+        assert mon._devices == []

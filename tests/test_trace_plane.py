@@ -1,0 +1,465 @@
+"""Cross-process trace plane tests (tier-1): gRPC trace-metadata
+propagation (stub → servicer roundtrip over a real in-process channel,
+missing-metadata tolerance), span trace-id inheritance, the clock-aligning
+Chrome-trace merger on golden two-node logs with skewed clocks, and a
+3-client end-to-end federation whose per-node JSONL streams merge into one
+trace where every round span has child spans from all clients sharing the
+server's trace_id — with the live ops endpoint curled mid-run."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.federation import rpc
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.utils.observability import (
+    NODE_KEY,
+    PARENT_SPAN_KEY,
+    ROUND_KEY,
+    SEND_TIME_KEY,
+    TRACE_ID_KEY,
+    TRACE_PLANE_SPANS,
+    MetricsLogger,
+    ambient_trace_pairs,
+    estimate_clock_offset,
+    extract_trace_context,
+    merge_chrome_trace,
+    new_trace_id,
+    read_metrics,
+    span,
+    trace_pairs,
+    validate_record,
+)
+
+
+# ---- metadata helpers -------------------------------------------------------
+
+class TestTraceContextHelpers:
+    def test_pairs_roundtrip_through_extract(self):
+        pairs = trace_pairs("abc123", 42, 7)
+        pairs += [(NODE_KEY, "client2"), (SEND_TIME_KEY, "12.5")]
+        ctx = extract_trace_context(pairs)
+        assert ctx == {
+            "trace_id": "abc123", "remote_parent_id": 42, "round": 7,
+            "remote_node": "client2", "rpc_send_time": 12.5,
+        }
+
+    def test_extract_tolerates_missing_and_malformed(self):
+        assert extract_trace_context(None) == {}
+        assert extract_trace_context(()) == {}
+        # malformed values are dropped, valid siblings survive
+        ctx = extract_trace_context([
+            (PARENT_SPAN_KEY, "not-an-int"),
+            (ROUND_KEY, "3"),
+            (SEND_TIME_KEY, "junk"),
+            ("some-unrelated-key", "x"),
+        ])
+        assert ctx == {"round": 3}
+
+    def test_new_trace_ids_are_unique_hex(self):
+        ids = {new_trace_id() for _ in range(32)}
+        assert len(ids) == 32
+        assert all(re.fullmatch(r"[0-9a-f]{16}", t) for t in ids)
+
+    def test_span_inherits_trace_id_from_logger_and_parent(self):
+        log = MetricsLogger(validate=True)
+        log.trace_id = "t-log"
+        with span(log, "round") as outer:
+            assert outer.fields["trace_id"] == "t-log"
+            with span(log, "poll") as inner:
+                pass
+        events = {e["name"]: e for e in log.events("span")}
+        assert events["round"]["trace_id"] == "t-log"
+        assert events["poll"]["trace_id"] == "t-log"
+        assert inner.parent_id == outer.span_id
+        # explicit trace_id wins over the logger's
+        with span(log, "serve", trace_id="t-remote"):
+            pass
+        assert log.events("span")[-1]["trace_id"] == "t-remote"
+
+    def test_ambient_pairs_reflect_open_span(self):
+        log = MetricsLogger()
+        log.trace_id = "amb"
+        with span(log, "outer") as sp:
+            pairs = dict(ambient_trace_pairs(log))
+            assert pairs[TRACE_ID_KEY] == "amb"
+            assert pairs[PARENT_SPAN_KEY] == str(sp.span_id)
+        # no open span: trace id only
+        assert dict(ambient_trace_pairs(log)) == {TRACE_ID_KEY: "amb"}
+        # nothing at all: empty (and therefore no metadata)
+        assert ambient_trace_pairs(MetricsLogger()) == []
+
+    def test_trace_plane_span_names_are_the_documented_set(self):
+        assert set(TRACE_PLANE_SPANS) == {"round", "serve"}
+
+
+# ---- stub -> servicer roundtrip over a real channel -------------------------
+
+class _FederationImpl:
+    """Minimal gfedntm.Federation servicer for metadata tests."""
+
+    def OfferVocab(self, request, context):
+        return pb.Ack(code=0, detail="ok")
+
+    def GetGlobalSetup(self, request, context):  # pragma: no cover
+        raise NotImplementedError
+
+    def ReadyForTraining(self, request, context):
+        return pb.Ack(code=0, detail="ok")
+
+
+@pytest.fixture()
+def federation_pair():
+    """(server_metrics, client_metrics, stub) over a live in-process gRPC
+    server with the traced dispatch installed."""
+    server_metrics = MetricsLogger(validate=True, node="server")
+    client_metrics = MetricsLogger(validate=True, node="client1")
+    grpc_server = rpc.make_server(max_workers=2)
+    rpc.add_service(
+        grpc_server, "gfedntm.Federation", _FederationImpl(),
+        metrics=server_metrics,
+    )
+    port = grpc_server.add_insecure_port("[::]:0")
+    grpc_server.start()
+    channel = rpc.make_channel(f"localhost:{port}")
+    stub = rpc.ServiceStub(
+        channel, "gfedntm.Federation", metrics=client_metrics, peer="server",
+    )
+    yield server_metrics, client_metrics, stub
+    channel.close()
+    grpc_server.stop(0)
+
+
+class TestMetadataPropagation:
+    def test_ambient_span_context_reaches_servicer(self, federation_pair):
+        server_metrics, client_metrics, stub = federation_pair
+        client_metrics.trace_id = "roundtrip01"
+        with span(client_metrics, "join", client=1) as sp:
+            stub.OfferVocab(
+                pb.VocabOffer(client_id=1, tokens=["a"], nr_samples=1.0)
+            )
+        (serve,) = server_metrics.events("span")
+        assert serve["name"] == "serve"
+        assert serve["method"] == "Federation.OfferVocab"
+        assert serve["trace_id"] == "roundtrip01"
+        assert serve["remote_parent_id"] == sp.span_id
+        assert serve["remote_node"] == "client1"
+        assert serve["client"] == 1
+        # same host, same clock: the paired stamps bracket the dispatch
+        assert serve["rpc_send_time"] <= serve["rpc_recv_time"]
+        assert serve["node"] == "server"
+
+    def test_explicit_metadata_overrides_ambient(self, federation_pair):
+        server_metrics, client_metrics, stub = federation_pair
+        client_metrics.trace_id = "ambient-loses"
+        stub.ReadyForTraining(
+            pb.JoinRequest(client_id=2),
+            metadata=trace_pairs("explicit-wins", 99, 5),
+        )
+        (serve,) = server_metrics.events("span")
+        assert serve["trace_id"] == "explicit-wins"
+        assert serve["remote_parent_id"] == 99
+        assert serve["round"] == 5
+
+    def test_missing_metadata_tolerated(self):
+        """A metrics=None stub attaches no metadata; the servicer-side
+        serve span still logs, with no trace fields."""
+        server_metrics = MetricsLogger(validate=True, node="server")
+        grpc_server = rpc.make_server(max_workers=2)
+        rpc.add_service(
+            grpc_server, "gfedntm.Federation", _FederationImpl(),
+            metrics=server_metrics,
+        )
+        port = grpc_server.add_insecure_port("[::]:0")
+        grpc_server.start()
+        channel = rpc.make_channel(f"localhost:{port}")
+        try:
+            stub = rpc.ServiceStub(channel, "gfedntm.Federation")
+            stub.OfferVocab(
+                pb.VocabOffer(client_id=3, tokens=["b"], nr_samples=2.0)
+            )
+            (serve,) = server_metrics.events("span")
+            assert serve["name"] == "serve"
+            assert "trace_id" not in serve
+            assert "remote_node" not in serve
+            assert "rpc_send_time" not in serve
+            assert serve["client"] == 3
+        finally:
+            channel.close()
+            grpc_server.stop(0)
+
+
+# ---- golden trace merge with skewed clocks ----------------------------------
+
+def _span(name, span_id, t_end, seconds, **fields):
+    r = {
+        "event": "span", "name": name, "span_id": span_id,
+        "parent_id": fields.pop("parent_id", None), "seconds": seconds,
+        "time": t_end, "ok": True, "thread": fields.pop("thread", 1),
+        **fields,
+    }
+    validate_record(r)
+    return r
+
+
+#: The golden scenario: client1's wall clock runs exactly +5 s ahead of the
+#: server's; true one-way network latency is 10 ms in both directions.
+_SKEW, _LAT = 5.0, 0.01
+
+
+def _golden_nodes():
+    t = 1_700_000_000.0  # server-true epoch origin
+    server = [
+        # reverse-direction pairing: client -> server join RPC
+        _span("serve", 50, t + 1.0, 0.2, method="Federation.OfferVocab",
+              remote_node="client1", client=1,
+              rpc_send_time=(t + 0.8) + _SKEW,          # client clock
+              rpc_recv_time=(t + 0.8) + _LAT),          # server clock
+        # the round root
+        _span("round", 101, t + 21.0, 1.0, round=0, trace_id="tg1"),
+    ]
+    # forward pairing: the server's round-0 poll dispatched at t+20.0
+    poll_recv_true = t + 20.0 + _LAT
+    client = [
+        _span("serve", 7, poll_recv_true + _SKEW + 0.1, 0.1,
+              method="FederationClient.TrainStep", trace_id="tg1",
+              remote_node="server", remote_parent_id=101, round=0,
+              rpc_send_time=t + 20.0,                   # server clock
+              rpc_recv_time=poll_recv_true + _SKEW),    # client clock
+    ]
+    return {"server": server, "client1": client}
+
+
+class TestTraceMerge:
+    def test_offset_estimate_recovers_skew(self):
+        nodes = _golden_nodes()
+        off = estimate_clock_offset(
+            nodes["client1"], nodes["server"], "client1", "server"
+        )
+        # both directions available: latency floors cancel exactly
+        assert off == pytest.approx(_SKEW, abs=1e-6)
+
+    def test_offset_single_direction_degrades_to_bound(self):
+        nodes = _golden_nodes()
+        off = estimate_clock_offset(nodes["client1"], [], "client1", "server")
+        assert off == pytest.approx(_SKEW + _LAT, abs=1e-6)
+        off = estimate_clock_offset([], nodes["server"], "client1", "server")
+        assert off == pytest.approx(_SKEW - _LAT, abs=1e-6)
+        assert estimate_clock_offset([], [], "a", "b") == 0.0
+
+    def test_merged_trace_aligns_clocks_and_links_round_tree(self):
+        trace = merge_chrome_trace(_golden_nodes())
+        meta = trace["otherData"]
+        assert meta["reference"] == "server"  # owns the round spans
+        assert meta["clock_offsets_s"]["client1"] == pytest.approx(
+            _SKEW, abs=1e-6
+        )
+
+        names = {
+            e["args"]["name"]: e["pid"]
+            for e in trace["traceEvents"] if e["ph"] == "M"
+        }
+        assert set(names) == {"server", "client1"}
+        slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        by = {(e["pid"], e["name"], e["args"].get("span_id")): e
+              for e in slices}
+        rnd = by[(names["server"], "round", 101)]
+        child = by[(names["client1"], "serve", 7)]
+        # aligned: the client's TrainStep slice starts one latency after
+        # the poll left the server, well inside the round span — a raw
+        # (unaligned) merge would put it 5 s out.
+        assert child["ts"] - rnd["ts"] == pytest.approx(
+            _LAT * 1e6, abs=2e3
+        )
+        assert rnd["ts"] <= child["ts"] <= rnd["ts"] + rnd["dur"]
+        assert child["args"]["trace_id"] == rnd["args"]["trace_id"] == "tg1"
+
+        # the cross-process parent link renders as a flow arrow pair
+        flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        start = next(e for e in flows if e["ph"] == "s")
+        finish = next(e for e in flows if e["ph"] == "f")
+        assert start["pid"] == names["server"]
+        assert finish["pid"] == names["client1"]
+        assert start["id"] == finish["id"]
+
+    def test_merge_rejects_unknown_reference_and_empty(self):
+        with pytest.raises(ValueError, match="reference node"):
+            merge_chrome_trace(_golden_nodes(), reference="nope")
+        with pytest.raises(ValueError, match="no node records"):
+            merge_chrome_trace({})
+
+
+# ---- 3-client end-to-end: per-node streams -> one round tree ----------------
+
+def _tiny_corpora(n_clients, docs=10, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(40)]
+    from gfedntm_tpu.data.loaders import RawCorpus
+
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+_PROM_LINE = re.compile(
+    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+)$"
+)
+
+
+class TestThreeClientE2E:
+    def test_per_node_streams_merge_into_one_round_tree(self, tmp_path):
+        """The acceptance scenario: a 3-client in-process federation with
+        per-node JSONL loggers produces streams the `trace` CLI merges into
+        one Chrome trace where every round span has child serve spans from
+        all 3 clients sharing its trace_id; the live ops endpoint serves
+        Prometheus-parsable /metrics and a /status reporting the round and
+        membership during the same run."""
+        from gfedntm_tpu.cli import main as cli_main
+        from gfedntm_tpu.federation.client import Client
+        from gfedntm_tpu.federation.server import FederatedServer
+
+        n = 3
+        paths = {
+            "server": str(tmp_path / "server" / "metrics.jsonl"),
+            **{
+                f"client{c + 1}": str(
+                    tmp_path / f"client{c + 1}" / "metrics.jsonl"
+                )
+                for c in range(n)
+            },
+        }
+        loggers = {
+            node: MetricsLogger(path, validate=True, node=node)
+            for node, path in paths.items()
+        }
+        model_kwargs = dict(
+            n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=1,
+            seed=0,
+        )
+        server = FederatedServer(
+            min_clients=n, family="avitm", model_kwargs=model_kwargs,
+            max_iters=50, save_dir=str(tmp_path / "server"),
+            metrics=loggers["server"], ops_port=0,
+        )
+        addr = server.start("[::]:0")
+        assert server.ops_actual_port
+        base = f"http://127.0.0.1:{server.ops_actual_port}"
+
+        clients = [
+            Client(
+                client_id=c + 1, corpus=corpus, server_address=addr,
+                max_features=40, save_dir=str(tmp_path / f"client{c + 1}"),
+                metrics=loggers[f"client{c + 1}"],
+            )
+            for c, corpus in enumerate(_tiny_corpora(n))
+        ]
+        threads = [
+            threading.Thread(target=c.run, daemon=True) for c in clients
+        ]
+        for t in threads:
+            t.start()
+
+        # the ops endpoint is live from start(), before training completes
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert resp.status == 200 and resp.read() == b"ok\n"
+
+        assert server.wait_done(timeout=300.0)
+        for t in threads:
+            t.join(timeout=60.0)
+
+        # --- live ops endpoint, while the server is still up ---
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            metrics_text = resp.read().decode()
+        for line in metrics_text.strip().splitlines():
+            assert _PROM_LINE.match(line), f"bad Prometheus line: {line!r}"
+        assert "gfedntm_rpc_calls_total" in metrics_text
+        assert "gfedntm_client_poll_s_bucket" in metrics_text
+        assert "gfedntm_client_step_ewma_s" in metrics_text
+
+        with urllib.request.urlopen(base + "/status", timeout=10) as resp:
+            status = json.loads(resp.read())
+        assert status["round"] == server.global_iterations >= 1
+        assert status["training_done"] is True
+        assert status["codec"] == "none"
+        assert status["trace_id"] == server.trace_id
+        assert len(status["clients"]) == n
+        assert {c["client_id"] for c in status["clients"]} == {1, 2, 3}
+        assert all(c["status"] == "active" for c in status["clients"])
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert err.value.code == 404
+
+        for c in clients:
+            c.shutdown()
+        server.stop()
+        for logger in loggers.values():
+            logger.close()
+
+        # --- per-node JSONL: every client's serve spans share the trace ---
+        trace_id = server.trace_id
+        assert trace_id
+        streams = {node: read_metrics(path) for node, path in paths.items()}
+        for node, records in streams.items():
+            for r in records:
+                validate_record(r)
+                assert r["node"] == node
+        assert any(
+            r["event"] == "trace_started" and r["trace_id"] == trace_id
+            for r in streams["server"]
+        )
+        for c in range(1, n + 1):
+            serve = [
+                r for r in streams[f"client{c}"]
+                if r["event"] == "span" and r["name"] == "serve"
+                and r.get("trace_id") == trace_id
+            ]
+            assert serve, f"client{c} has no spans in trace {trace_id}"
+            assert any(isinstance(r.get("round"), int) for r in serve)
+
+        # --- the trace CLI merges them into one tree ---
+        out = str(tmp_path / "trace.json")
+        rc = cli_main(["trace", *paths.values(), "-o", out])
+        assert rc == 0
+        with open(out) as fh:
+            trace = json.load(fh)
+        assert trace["otherData"]["reference"] == "server"
+        pid_names = {
+            e["pid"]: e["args"]["name"]
+            for e in trace["traceEvents"] if e["ph"] == "M"
+        }
+        assert set(pid_names.values()) == set(paths)
+        slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        rounds = [
+            e for e in slices
+            if e["name"] == "round" and pid_names[e["pid"]] == "server"
+        ]
+        assert rounds and all(
+            e["args"]["trace_id"] == trace_id for e in rounds
+        )
+        for rnd in rounds:
+            children = {
+                pid_names[e["pid"]]
+                for e in slices
+                if e["name"] == "serve"
+                and e["args"].get("trace_id") == trace_id
+                and e["args"].get("round") == rnd["args"]["round"]
+                and pid_names[e["pid"]] != "server"
+            }
+            assert children == {f"client{c}" for c in range(1, n + 1)}, (
+                f"round {rnd['args']['round']} missing client children: "
+                f"{children}"
+            )
+        # cross-process links materialized as flow arrows
+        assert any(e["ph"] == "s" for e in trace["traceEvents"])
+        assert any(e["ph"] == "f" for e in trace["traceEvents"])
