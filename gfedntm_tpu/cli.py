@@ -115,6 +115,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="server mode: server-optimizer learning rate for "
                         "fedavgm/fedadam/fedyogi (default: each "
                         "aggregator's own)")
+    # Data-plane hardening (README "Robust aggregation & divergence
+    # recovery"): byzantine-robust mean stage, update admission gate,
+    # divergence rollback.
+    p.add_argument("--robust_aggregator", type=str, default=None,
+                   help="server mode: byzantine-robust mean stage "
+                        "substituted for the sample-weighted average — "
+                        "'trimmed_mean:<frac>' (coordinate-wise), 'median' "
+                        "(coordinate-wise), or 'krum:<f>' (multi-Krum "
+                        "tolerating f byzantine clients); composes with "
+                        "any --aggregator (default: plain weighted mean)")
+    p.add_argument("--max_update_norm", type=float, default=None,
+                   help="server mode: hard L2 cap on each admitted client "
+                        "update's distance from the current global model — "
+                        "larger updates are norm-clipped, gradient-"
+                        "clipping style (default: no cap)")
+    p.add_argument("--outlier_mad_k", type=float, default=4.0,
+                   help="server mode: reject a client update whose norm "
+                        "exceeds the round cohort's median + k*MAD "
+                        "(0 disables the outlier screen; finiteness and "
+                        "shape conformance always apply)")
+    p.add_argument("--divergence_patience", type=int, default=3,
+                   help="server mode: consecutive unhealthy rounds (loss "
+                        "or parameter-norm explosion vs their EWMAs) "
+                        "before the server rolls the global model back to "
+                        "the last good checkpoint; a non-finite aggregate "
+                        "rolls back immediately (0 disables the guardian)")
     p.add_argument("--wire_codec", type=str, default=None,
                    help="wire-compression spec, '+'-joined stages of "
                         "'delta', 'topk:<frac>', 'fp16'/'bf16' (e.g. "
@@ -241,7 +267,9 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     )
     aggregator_kwargs = {}
     if getattr(args, "server_lr", None) is not None:
-        if getattr(args, "aggregator", "fedavg") == "fedavg":
+        if getattr(args, "aggregator", "fedavg") not in (
+            "fedavgm", "fedadam", "fedyogi"
+        ):
             raise SystemExit("--server_lr needs a server-optimizer "
                              "aggregator (fedavgm/fedadam/fedyogi)")
         aggregator_kwargs["server_lr"] = args.server_lr
@@ -259,14 +287,20 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         quorum_fraction=getattr(args, "quorum_fraction", 0.5),
         aggregator=getattr(args, "aggregator", "fedavg"),
         aggregator_kwargs=aggregator_kwargs,
+        robust_aggregator=getattr(args, "robust_aggregator", None),
+        max_update_norm=getattr(args, "max_update_norm", None),
+        outlier_mad_k=getattr(args, "outlier_mad_k", 4.0),
+        divergence_patience=getattr(args, "divergence_patience", 3),
         wire_codec=getattr(args, "wire_codec", None) or "none",
         ops_port=getattr(args, "ops_port", None),
         profiler=profiler,
     )
     if getattr(args, "resume", False):
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
         try:
             round_idx = server.restore_from_checkpoint()
-        except FileNotFoundError as err:
+        except (FileNotFoundError, CheckpointIntegrityError) as err:
             raise SystemExit(f"--resume: {err}")
         logging.info("resuming federation from round %d", round_idx)
     port = args.listen_port if args.listen_port is not None else 50051

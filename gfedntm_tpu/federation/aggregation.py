@@ -21,6 +21,17 @@ step a strategy call:
 State is flat ``{"slot::tensor/key": np.ndarray}`` dicts — directly
 ``np.savez``-able; the ``::`` separator cannot collide with the ``/`` used
 inside tensor keys.
+
+Byzantine-robust estimation (PR 5, README "Robust aggregation & divergence
+recovery"): every aggregator's *mean stage* is pluggable. The default
+:class:`WeightedMean` is the reference's sample-weighted average verbatim;
+``trimmed_mean:<frac>`` / ``median`` / ``krum:<f>`` substitute a
+statistically robust location estimate for it, so a bounded number of
+adversarial or broken client updates cannot drag the aggregate arbitrarily
+far (the heavy-tailed-noise sensitivity the FALD line formalizes,
+arXiv:2112.05120). The estimator composes with the server-optimizer
+aggregators: FedAvgM/FedAdam/FedYogi treat ``estimate - current_global``
+as the pseudo-gradient exactly as before, just from a robust estimate.
 """
 
 from __future__ import annotations
@@ -38,6 +49,12 @@ __all__ = [
     "AGGREGATORS",
     "make_aggregator",
     "weighted_mean",
+    "RobustEstimator",
+    "WeightedMean",
+    "TrimmedMean",
+    "Median",
+    "Krum",
+    "make_estimator",
 ]
 
 def weighted_mean(snapshots) -> dict[str, np.ndarray]:
@@ -52,11 +69,177 @@ def weighted_mean(snapshots) -> dict[str, np.ndarray]:
     }
 
 
+# ---- robust mean-stage estimators -------------------------------------------
+
+class RobustEstimator:
+    """The mean stage of an aggregate step: ``(weight, flat-snapshot)``
+    pairs → one flat estimate. Stateless and deterministic."""
+
+    name = "mean"
+
+    def __call__(self, snapshots) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class WeightedMean(RobustEstimator):
+    """The default (non-robust) estimator: the reference's sample-weighted
+    mean, bit-for-bit (see :func:`weighted_mean`)."""
+
+    def __call__(self, snapshots):
+        return weighted_mean(snapshots)
+
+
+def _stacked(snapshots) -> "tuple[list[str], dict[str, np.ndarray]]":
+    """Per-key ``[n_clients, ...]`` float32 stacks of the snapshots."""
+    keys = sorted(snapshots[0][1])
+    return keys, {
+        k: np.stack([np.asarray(s[k], np.float32) for _w, s in snapshots])
+        for k in keys
+    }
+
+
+def _cast_like(est: dict[str, np.ndarray], snapshots) -> dict[str, np.ndarray]:
+    ref = snapshots[0][1]
+    return {
+        k: np.asarray(v, dtype=np.asarray(ref[k]).dtype)
+        for k, v in est.items()
+    }
+
+
+class TrimmedMean(RobustEstimator):
+    """Coordinate-wise trimmed mean (Yin et al., 2018): per coordinate,
+    drop the ``floor(frac * n)`` largest AND smallest client values, then
+    average the rest unweighted. Tolerates up to ``floor(frac * n)``
+    byzantine clients per coordinate; weights are deliberately ignored —
+    a byzantine client must not be able to buy influence by inflating its
+    claimed sample count."""
+
+    def __init__(self, frac: float = 0.2):
+        if not 0.0 <= frac < 0.5:
+            raise ValueError(
+                f"trimmed_mean fraction must be in [0, 0.5), got {frac}"
+            )
+        self.frac = float(frac)
+        self.name = f"trimmed_mean:{self.frac:g}"
+
+    def __call__(self, snapshots):
+        n = len(snapshots)
+        # frac < 0.5 guarantees 2t < n: at least one value survives the
+        # trim for every cohort size.
+        t = int(self.frac * n)
+        keys, stacks = _stacked(snapshots)
+        est = {}
+        for k in keys:
+            s = np.sort(stacks[k], axis=0)
+            est[k] = s[t:n - t].mean(axis=0)
+        return _cast_like(est, snapshots)
+
+
+class Median(RobustEstimator):
+    """Coordinate-wise median (the frac→0.5 limit of the trimmed mean):
+    the strongest per-coordinate breakdown point, at the cost of ignoring
+    half the cohort's information per coordinate."""
+
+    name = "median"
+
+    def __call__(self, snapshots):
+        keys, stacks = _stacked(snapshots)
+        return _cast_like(
+            {k: np.median(stacks[k], axis=0) for k in keys}, snapshots
+        )
+
+
+class Krum(RobustEstimator):
+    """Multi-Krum (Blanchard et al., 2017) over flattened updates: each
+    client is scored by the summed squared distance to its ``n - f - 2``
+    nearest peers; the ``n - f`` best-scored clients are kept and averaged
+    with their sample weights (they are all honest-cluster members by
+    selection, so weighting is safe again). Unlike the coordinate-wise
+    estimators this drops whole *clients*, so a single totally-bogus
+    update (NaN tensors included — non-finite rows score ``inf`` and are
+    never selected) cannot leak into any coordinate."""
+
+    def __init__(self, f: int = 1):
+        if f < 0:
+            raise ValueError(f"krum byzantine count must be >= 0, got {f}")
+        self.f = int(f)
+        self.name = f"krum:{self.f}"
+
+    def __call__(self, snapshots):
+        n = len(snapshots)
+        if n - self.f < 2:
+            # Too small a cohort to score against itself — fall back to the
+            # median rather than silently trusting everyone.
+            return Median()(snapshots)
+        keys = sorted(snapshots[0][1])
+        flat = np.stack([
+            np.concatenate([
+                np.asarray(s[k], np.float32).ravel() for k in keys
+            ])
+            for _w, s in snapshots
+        ])
+        # Pairwise squared distances via the gram identity
+        # ‖a−b‖² = ‖a‖² + ‖b‖² − 2a·b — O(n² + nD) memory, where the
+        # broadcasted difference cube would be O(n²D) (gigabytes at fleet
+        # scale). Anything non-finite (a NaN update, or an overflow
+        # against one) becomes +inf so it can neither be selected nor
+        # poison an honest client's score.
+        sq = np.einsum("ij,ij->i", flat, flat)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+        d2 = np.where(np.isfinite(d2), np.maximum(d2, 0.0), np.inf)
+        np.fill_diagonal(d2, np.inf)
+        k_near = max(1, n - self.f - 2)
+        neighbor_d2 = np.sort(d2, axis=1)[:, :k_near]
+        scores = neighbor_d2.sum(axis=1)
+        m = max(1, n - self.f)
+        chosen = np.argsort(scores, kind="stable")[:m]
+        return weighted_mean([snapshots[i] for i in chosen])
+
+
+_ESTIMATORS: dict[str, type] = {
+    "mean": WeightedMean, "trimmed_mean": TrimmedMean, "median": Median,
+    "krum": Krum,
+}
+
+
+def make_estimator(
+    spec: "str | RobustEstimator | None",
+) -> RobustEstimator:
+    """Parse a robust-estimator spec: ``mean`` (default), ``median``,
+    ``trimmed_mean[:<frac>]``, ``krum[:<f>]``."""
+    if isinstance(spec, RobustEstimator):
+        return spec
+    raw = (spec or "mean").strip().lower()
+    name, _, arg = raw.partition(":")
+    cls = _ESTIMATORS.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown robust estimator {raw!r} (want one of "
+            f"{sorted(_ESTIMATORS)}, with trimmed_mean:<frac> / krum:<f>)"
+        )
+    if not arg:
+        return cls()
+    if cls is TrimmedMean:
+        return cls(float(arg))
+    if cls is Krum:
+        return cls(int(arg))
+    raise ValueError(f"estimator {name!r} takes no {arg!r} argument")
+
+
+# ---- aggregators -------------------------------------------------------------
+
 class ServerAggregator:
     """One round's aggregate step: ``snapshots`` (per-client ``(weight,
     flat-snapshot)`` pairs, already decoded and key-validated) plus the
     server's ``current_global`` (the last broadcast average, or the template
     init before round 0) map to the new global parameters.
+
+    ``estimator`` swaps the mean stage for a byzantine-robust location
+    estimate (see :func:`make_estimator`); the default
+    :class:`WeightedMean` keeps every aggregator numerically identical to
+    its pre-robustness behaviour. A non-default estimator is reflected in
+    :attr:`name` (e.g. ``"fedadam+median"``) so checkpoint compatibility
+    checks see the full aggregation configuration.
 
     Stateless aggregators return ``None`` from :meth:`state_dict`; stateful
     ones return a flat npz-able array dict and accept it back via
@@ -64,6 +247,16 @@ class ServerAggregator:
     """
 
     name = "base"
+
+    def __init__(self, estimator: "str | RobustEstimator | None" = None):
+        self.estimator = make_estimator(estimator)
+        if self.estimator.name != "mean":
+            # Instance attribute shadows the class name: the composition is
+            # part of the aggregator's identity (checkpoints, /status).
+            self.name = f"{type(self).name}+{self.estimator.name}"
+
+    def _mean(self, snapshots) -> dict[str, np.ndarray]:
+        return self.estimator(snapshots)
 
     def aggregate(
         self,
@@ -84,12 +277,13 @@ class ServerAggregator:
 
 
 class FedAvg(ServerAggregator):
-    """The reference semantics: assign the sample-weighted mean."""
+    """The reference semantics: assign the sample-weighted mean (or, with a
+    robust estimator, assign the robust estimate)."""
 
     name = "fedavg"
 
     def aggregate(self, snapshots, current_global=None):
-        return weighted_mean(snapshots)
+        return self._mean(snapshots)
 
 
 class _SlottedAggregator(ServerAggregator):
@@ -99,7 +293,8 @@ class _SlottedAggregator(ServerAggregator):
     #: slot names this aggregator carries (e.g. ("m",) or ("m", "v")).
     slots: tuple[str, ...] = ()
 
-    def __init__(self, server_lr: float = 1.0):
+    def __init__(self, server_lr: float = 1.0, estimator=None):
+        super().__init__(estimator)
         self.server_lr = float(server_lr)
         self._state: dict[str, dict[str, np.ndarray]] = {
             s: {} for s in self.slots
@@ -113,7 +308,7 @@ class _SlottedAggregator(ServerAggregator):
         return arr
 
     def aggregate(self, snapshots, current_global):
-        mean = weighted_mean(snapshots)
+        mean = self._mean(snapshots)
         out: dict[str, np.ndarray] = {}
         for key, avg in mean.items():
             cur = np.asarray(current_global[key])
@@ -163,8 +358,9 @@ class FedAvgM(_SlottedAggregator):
     name = "fedavgm"
     slots = ("m",)
 
-    def __init__(self, server_lr: float = 1.0, beta: float = 0.9):
-        super().__init__(server_lr)
+    def __init__(self, server_lr: float = 1.0, beta: float = 0.9,
+                 estimator=None):
+        super().__init__(server_lr, estimator=estimator)
         self.beta = float(beta)
 
     def _update(self, key, delta):
@@ -184,8 +380,8 @@ class FedAdam(_SlottedAggregator):
     slots = ("m", "v")
 
     def __init__(self, server_lr: float = 0.02, beta1: float = 0.9,
-                 beta2: float = 0.99, tau: float = 1e-3):
-        super().__init__(server_lr)
+                 beta2: float = 0.99, tau: float = 1e-3, estimator=None):
+        super().__init__(server_lr, estimator=estimator)
         self.beta1, self.beta2, self.tau = (
             float(beta1), float(beta2), float(tau)
         )
@@ -220,18 +416,47 @@ AGGREGATORS: dict[str, type] = {
 
 
 def make_aggregator(
-    spec: "str | ServerAggregator | None", **kwargs: Any
+    spec: "str | ServerAggregator | None",
+    robust: "str | RobustEstimator | None" = None,
+    **kwargs: Any,
 ) -> ServerAggregator:
-    """Resolve a CLI name (or pass through an instance) to an aggregator."""
+    """Resolve a CLI name (or pass through an instance) to an aggregator.
+
+    ``robust`` is a robust-estimator spec (``--robust_aggregator``:
+    ``median``, ``trimmed_mean:<frac>``, ``krum:<f>``) substituted for the
+    aggregator's weighted-mean stage. A robust spec passed AS the
+    aggregator name (e.g. ``spec="median"``) is accepted too and means
+    plain assignment of the robust estimate (FedAvg semantics)."""
     if isinstance(spec, ServerAggregator):
-        if kwargs:
-            raise ValueError("kwargs are for by-name construction only")
+        if kwargs or robust is not None:
+            raise ValueError(
+                "kwargs/robust are for by-name construction only"
+            )
         return spec
     name = (spec or "fedavg").strip().lower()
     cls = AGGREGATORS.get(name)
     if cls is None:
-        raise ValueError(
-            f"unknown aggregator {name!r} (want one of "
-            f"{sorted(AGGREGATORS)})"
-        )
-    return cls(**kwargs)
+        # Not a server-optimizer name: accept a bare robust spec as
+        # "fedavg with that estimator".
+        try:
+            est = make_estimator(name)
+        except ValueError:
+            raise ValueError(
+                f"unknown aggregator {name!r} (want one of "
+                f"{sorted(AGGREGATORS)}, or a robust estimator spec "
+                f"median / trimmed_mean:<frac> / krum:<f>)"
+            ) from None
+        if robust is not None:
+            raise ValueError(
+                f"aggregator {name!r} is itself a robust estimator; "
+                "drop the extra robust spec"
+            )
+        if kwargs:
+            raise ValueError(
+                f"aggregator {name!r} assigns the robust estimate "
+                f"directly and takes no server-optimizer kwargs "
+                f"({sorted(kwargs)}); use fedavgm/fedadam/fedyogi with "
+                "robust= for that"
+            )
+        return FedAvg(estimator=est)
+    return cls(estimator=make_estimator(robust), **kwargs)

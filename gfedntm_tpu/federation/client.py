@@ -159,6 +159,21 @@ class FederatedClientServicer:
                     client_id=self.client_id, finished=True,
                     current_epoch=self.stepper.current_epoch,
                 )
+            if request.reset_session:
+                # Divergence-rollback re-broadcast: the server discarded
+                # the trajectory our codec session state describes. Drop
+                # delta references AND the error-feedback residual BEFORE
+                # decoding — the push is self-contained, and no mass from
+                # the rolled-back trajectory may leak into later uplinks.
+                self.logger.warning(
+                    "client %d: server ordered a codec session reset "
+                    "(divergence rollback at round %d)",
+                    self.client_id, int(request.round),
+                )
+                if self.downlink is not None:
+                    self.downlink.reset()
+                if self.uplink is not None:
+                    self.uplink.reset()
             if self.downlink is not None:
                 try:
                     average = self.downlink.decode(

@@ -175,6 +175,19 @@ class _Session:
             {} if self.codec.lossy else None
         )
 
+    def reset(self) -> None:
+        """Forget every delta reference and error-feedback residual this
+        session carries — the divergence-rollback path (README "Robust
+        aggregation & divergence recovery"): after the server restores a
+        checkpointed round, references derived from the diverged trajectory
+        must not be decoded (or deltaed) against, and residuals holding
+        un-delivered diverged mass must not be re-injected into the
+        restored state. The next encode after a reset is self-contained."""
+        if self.residual is not None:
+            self.residual = {}
+        if self.metrics is not None:
+            self.metrics.registry.counter("codec_resets").inc()
+
     # ---- encode ------------------------------------------------------------
     def _encode(
         self,
@@ -354,6 +367,15 @@ class UplinkEncoder(_Session):
         self._ref: dict[str, np.ndarray] | None = None
         self._ref_round = -1
 
+    def reset(self) -> None:
+        """Drop the applied-aggregate reference AND the error-feedback
+        residual (a rollback re-broadcast's ``reset_session``): the next
+        snapshot is encoded self-contained and carries no mass from the
+        discarded trajectory."""
+        self._ref = None
+        self._ref_round = -1
+        super().reset()
+
     def note_aggregate(
         self, tensors: Mapping[str, np.ndarray], round_idx: int
     ) -> None:
@@ -391,6 +413,14 @@ class UplinkDecoder(_Session):
         while len(self._refs) > self.max_refs:
             self._refs.popitem(last=False)
 
+    def reset(self) -> None:
+        """Drop the whole broadcast-view cache (divergence rollback): an
+        uplink deltaed against a pre-rollback broadcast now raises
+        :class:`ReferenceMismatch` — loud, and healed by the rolled-back
+        re-broadcast."""
+        self._refs.clear()
+        super().reset()
+
     def decode(self, bundle: pb.TensorBundle) -> dict[str, np.ndarray]:
         reference = None
         if bundle.ref_round > 0:
@@ -415,6 +445,13 @@ class DownlinkEncoder(_Session):
         super().__init__(codec_, metrics=metrics, role=role)
         self._last_view: dict[str, np.ndarray] | None = None
         self._last_round = -1
+
+    def reset(self) -> None:
+        """Forget the last broadcast view (divergence rollback): the next
+        push is encoded self-contained regardless of ``allow_delta``."""
+        self._last_view = None
+        self._last_round = -1
+        super().reset()
 
     def encode(
         self,
@@ -443,6 +480,14 @@ class DownlinkDecoder(_Session):
         self._ref: dict[str, np.ndarray] | None = None
         self._ref_round = -1
         self.residual = None
+
+    def reset(self) -> None:
+        """Drop the last-applied broadcast reference (a rollback
+        re-broadcast's ``reset_session``); the incoming push must then be
+        self-contained."""
+        self._ref = None
+        self._ref_round = -1
+        super().reset()
 
     def decode(
         self, bundle: pb.TensorBundle, round_idx: int

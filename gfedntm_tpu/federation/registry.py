@@ -49,6 +49,10 @@ class ClientRecord:
     status: str = ACTIVE
     consecutive_failures: int = 0
     next_retry_round: int = 0
+    # Why the client is (or last was) in probation: "rpc" for transport
+    # failures, "poisoned" for updates the admission gate rejected,
+    # "divergence" for a quarantine after a global-model rollback.
+    suspect_reason: str = ""
 
 
 @dataclass
@@ -99,6 +103,7 @@ class Federation:
             rec.status = ACTIVE
             rec.consecutive_failures = 0
             rec.next_retry_round = 0
+            rec.suspect_reason = ""
             self._cond.notify_all()
             return rec
 
@@ -126,12 +131,15 @@ class Federation:
 
     def mark_suspect(
         self, client_id: int, address: str, round_idx: int,
-        probation_rounds: int = 3,
+        probation_rounds: int = 3, reason: str = "rpc",
     ) -> str | None:
         """Record one failed round for a client: ACTIVE/SUSPECT clients gain
         a consecutive-failure count and a backed-off ``next_retry_round``
         (1, 2, 4, ... rounds out, capped); after ``probation_rounds``
-        consecutive failures the drop becomes permanent. Returns the
+        consecutive failures the drop becomes permanent. ``reason`` tags
+        WHY the client is on probation ("rpc" transport failures,
+        "poisoned" for gate-rejected updates, "divergence" for a rollback
+        quarantine) — surfaced in the membership snapshot. Returns the
         client's new status, or None when the failure is stale (the client
         rejoined on a different address since the RPC was issued)."""
         with self._lock:
@@ -139,6 +147,7 @@ class Federation:
             if rec is None or rec.address != address:
                 return None
             rec.consecutive_failures += 1
+            rec.suspect_reason = reason
             if rec.consecutive_failures >= probation_rounds:
                 rec.status = DROPPED
                 rec.finished = True
@@ -161,6 +170,7 @@ class Federation:
             rec.status = ACTIVE
             rec.consecutive_failures = 0
             rec.next_retry_round = 0
+            rec.suspect_reason = ""
             return True
 
     def update_progress(
@@ -237,6 +247,7 @@ class Federation:
                     ),
                     "consecutive_failures": c.consecutive_failures,
                     "next_retry_round": c.next_retry_round,
+                    "suspect_reason": c.suspect_reason,
                 }
                 for c in self.get_clients()
             ]

@@ -15,9 +15,11 @@ client). This module provides the two building blocks of the recovery story:
   the server's round-scoped probation (``registry.mark_suspect``), which
   re-polls the client on a later round instead of re-issuing the step.
 - :class:`FaultInjector` — deterministic scripted per-call failures (drop,
-  delay, error-code), seeded, injectable into both the client-side stub and
-  the servicer dispatch path, so every recovery path is exercisable
-  in-process without flaky socket games.
+  delay, error-code) AND per-reply payload corruptions (``nan`` /
+  ``scale:<x>`` / ``random`` applied to the tensor bundle of a response),
+  seeded, injectable into both the client-side stub and the servicer
+  dispatch path, so every recovery path — transport-level and
+  data-plane — is exercisable in-process without flaky socket games.
 
 Both are pure-Python and dependency-free beyond ``grpc`` (already a
 federation dependency); neither touches the wire format.
@@ -145,15 +147,27 @@ class InjectedRpcError(grpc.RpcError):
         return self._detail
 
 
+#: FaultSpec kinds that act BEFORE the call (fail/slow the RPC itself) vs
+#: AFTER it (mutate the reply payload in place).
+_BEFORE_KINDS = frozenset({"error", "delay"})
+_AFTER_KINDS = frozenset({"corrupt"})
+
+
 @dataclass
 class FaultSpec:
     """One scripted fault: fires on the next ``times`` matching calls.
 
     ``kind``: ``"error"`` raises ``code``; ``"drop"`` is shorthand for an
     ``UNAVAILABLE`` error (a dropped connection); ``"delay"`` sleeps
-    ``delay_s`` then lets the call proceed. ``peer=""`` matches any peer.
-    ``probability < 1`` fires probabilistically from the injector's seeded
-    RNG (still deterministic for a fixed seed and call order).
+    ``delay_s`` then lets the call proceed; ``"corrupt"`` mutates the
+    reply's tensor payload per ``payload`` — ``"nan"`` (every float value
+    becomes NaN), ``"scale:<x>"`` (values multiplied by ``x``, e.g. an
+    adversarially boosted update), or ``"random"`` (values replaced with
+    seeded noise). ``peer=""`` matches any peer. ``skip`` lets that many
+    matching calls pass untouched before the fault arms (e.g. poison round
+    4, not round 0). ``probability < 1`` fires probabilistically from the
+    injector's seeded RNG (still deterministic for a fixed seed and call
+    order).
     """
 
     method: str
@@ -163,12 +177,25 @@ class FaultSpec:
     times: int = 1
     peer: str = ""
     probability: float = 1.0
+    payload: str = ""
+    skip: int = 0
 
     def __post_init__(self):
-        if self.kind not in ("error", "drop", "delay"):
+        if self.kind not in ("error", "drop", "delay", "corrupt"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "drop":
             self.kind, self.code = "error", grpc.StatusCode.UNAVAILABLE
+        if self.kind == "corrupt":
+            if not (
+                self.payload in ("nan", "random")
+                or self.payload.startswith("scale:")
+            ):
+                raise ValueError(
+                    f"corrupt fault needs payload 'nan', 'scale:<x>' or "
+                    f"'random', got {self.payload!r}"
+                )
+            if self.payload.startswith("scale:"):
+                float(self.payload.split(":", 1)[1])  # validate eagerly
 
 
 class FaultInjector:
@@ -194,11 +221,14 @@ class FaultInjector:
     def script(self, method: str, kind: str = "error", *,
                code: grpc.StatusCode = grpc.StatusCode.UNAVAILABLE,
                delay_s: float = 0.0, times: int = 1, peer: str = "",
-               probability: float = 1.0) -> FaultSpec:
-        """Queue a fault for the next ``times`` matching calls."""
+               probability: float = 1.0, payload: str = "",
+               skip: int = 0) -> FaultSpec:
+        """Queue a fault for the next ``times`` matching calls (after
+        letting ``skip`` matching calls through untouched)."""
         spec = FaultSpec(
             method=method, kind=kind, code=code, delay_s=delay_s,
             times=times, peer=peer, probability=probability,
+            payload=payload, skip=skip,
         )
         with self._lock:
             self._specs.append(spec)
@@ -212,31 +242,44 @@ class FaultInjector:
                 if method is None or s.method == method
             )
 
+    def _consume(self, method: str, peer: str,
+                 kinds: frozenset) -> FaultSpec | None:
+        """Pop one firing from the FIFO-matched spec for this call (must be
+        called under the lock). A spec still inside its ``skip`` window
+        absorbs the call without firing."""
+        spec = next(
+            (
+                s for s in self._specs
+                if s.times > 0 and s.method == method
+                and s.peer in ("", peer) and s.kind in kinds
+            ),
+            None,
+        )
+        if spec is None:
+            return None
+        if spec.skip > 0:
+            spec.skip -= 1
+            return None
+        if spec.probability < 1.0 and (
+            self._rng.random() >= spec.probability
+        ):
+            return None
+        spec.times -= 1
+        if spec.times <= 0:
+            self._specs.remove(spec)
+        self.fired.append((method, peer, spec.kind))
+        if self.metrics is not None:
+            self.metrics.registry.counter("faults_injected").inc()
+        return spec
+
     def before_call(self, service: str, method: str, request: Any = None,
                     peer: str = "") -> None:
         """Consult the script for one call; raises/sleeps per the matched
         spec, or returns immediately when nothing matches."""
         with self._lock:
-            spec = next(
-                (
-                    s for s in self._specs
-                    if s.times > 0 and s.method == method
-                    and s.peer in ("", peer)
-                ),
-                None,
-            )
-            if spec is None:
-                return
-            if spec.probability < 1.0 and (
-                self._rng.random() >= spec.probability
-            ):
-                return
-            spec.times -= 1
-            if spec.times <= 0:
-                self._specs.remove(spec)
-            self.fired.append((method, peer, spec.kind))
-            if self.metrics is not None:
-                self.metrics.registry.counter("faults_injected").inc()
+            spec = self._consume(method, peer, _BEFORE_KINDS)
+        if spec is None:
+            return
         # Act OUTSIDE the lock: a scripted delay must not serialize every
         # other injected call behind it.
         if spec.kind == "delay":
@@ -246,3 +289,58 @@ class FaultInjector:
             spec.code,
             f"injected {spec.kind} for {service}/{method} (peer={peer!r})",
         )
+
+    def after_call(self, service: str, method: str, response: Any = None,
+                   peer: str = "") -> Any:
+        """Consult the script AFTER a successful call: a matched ``corrupt``
+        spec mutates the response's tensor bundle in place (the caller sees
+        a reply whose payload the remote peer "emitted" corrupted — NaN
+        tensors, adversarially scaled updates, random garbage). Returns the
+        (possibly mutated) response."""
+        with self._lock:
+            spec = self._consume(method, peer, _AFTER_KINDS)
+        if spec is None or response is None:
+            return response
+        bundle = getattr(response, "shared", None)
+        if bundle is not None and getattr(bundle, "tensors", None):
+            corrupt_bundle(
+                bundle, spec.payload,
+                seed=self._rng.randrange(2**32),
+            )
+        return response
+
+
+def corrupt_bundle(bundle: Any, payload: str, seed: int = 0) -> None:
+    """Corrupt every float tensor record of a ``TensorBundle`` in place.
+
+    Operates on the WIRE values buffer (whatever dtype/codec the record
+    ships — raw, dense-quantized, or top-k sparse), so it composes with any
+    negotiated compression: the decoder sees exactly what a byzantine peer
+    would have sent. ``payload`` is ``"nan"`` (all values → NaN),
+    ``"scale:<x>"`` (values × x) or ``"random"`` (values ← seeded
+    N(0, 10) noise)."""
+    import numpy as np
+
+    from gfedntm_tpu.federation import codec as _codec
+
+    rng = np.random.default_rng(seed)
+    for rec in bundle.tensors:
+        wire_name = rec.wire_dtype or rec.dtype
+        try:
+            wire_dtype = _codec.np_dtype(wire_name)
+        except Exception:
+            continue
+        if np.dtype(wire_dtype).kind != "f":
+            continue
+        arr = np.frombuffer(rec.data, dtype=wire_dtype).copy()
+        if arr.size == 0:
+            continue
+        if payload == "nan":
+            arr[:] = np.nan
+        elif payload.startswith("scale:"):
+            arr *= np.asarray(
+                float(payload.split(":", 1)[1]), dtype=arr.dtype
+            )
+        else:  # "random"
+            arr[:] = rng.normal(0.0, 10.0, arr.size).astype(arr.dtype)
+        rec.data = arr.tobytes()

@@ -150,7 +150,15 @@ def _with_deadline(fn, default_timeout: float | None, metrics=None,
         if fault_injector is not None:
             fault_injector.before_call(service, method, request, peer=peer)
         if metrics is None:
-            return fn(request, timeout=timeout, **kwargs)
+            response = fn(request, timeout=timeout, **kwargs)
+            if fault_injector is not None:
+                # Reply-payload faults (kind="corrupt"): the caller sees a
+                # response the peer "emitted" corrupted — the data-plane
+                # counterpart of the pre-call transport faults.
+                response = fault_injector.after_call(
+                    service, method, response, peer=peer
+                )
+            return response
         # Trace-context propagation: explicit caller metadata (the server's
         # poll/push workers pass trace_pairs with the round span) wins;
         # otherwise attach the ambient span context. The node label and a
@@ -193,6 +201,10 @@ def _with_deadline(fn, default_timeout: float | None, metrics=None,
             raise
         hist.observe(time.perf_counter() - t0)
         bytes_recv.inc(response.ByteSize())
+        if fault_injector is not None:
+            response = fault_injector.after_call(
+                service, method, response, peer=peer
+            )
         return response
 
     if retry_policy is None:
@@ -223,7 +235,10 @@ class ServiceStub:
     retries transient failures with backoff; ``fault_injector`` (a
     :class:`~gfedntm_tpu.federation.resilience.FaultInjector`) fails
     scripted calls before they reach the wire — each retry attempt
-    re-consults the script, so an N-times fault costs N attempts."""
+    re-consults the script, so an N-times fault costs N attempts — and
+    corrupts scripted replies after they return (``kind="corrupt"``
+    payload faults: the data-plane chaos the admission gate defends
+    against)."""
 
     def __init__(
         self,

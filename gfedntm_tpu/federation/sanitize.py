@@ -1,0 +1,326 @@
+"""Update admission gate: the data-plane trust boundary of the federation.
+
+PR 2 hardened the *transport* plane (retry/probation/quorum); until PR 5 the
+*data* plane was fully trusting — any tensor a client returned flowed
+straight into the round average, and the per-minibatch exchange (the
+reference gFedNTM design) makes that a one-round total poisoning: a single
+NaN coordinate, exploded norm, or adversarially scaled payload is averaged
+in and re-broadcast to every client. Practical-FL surveys name unreliable
+client updates a first-class failure mode alongside stragglers
+(arXiv:2405.20431 §4), and the FALD analysis (arXiv:2112.05120) shows how
+sensitive the averaged model is to heavy-tailed per-client noise.
+
+:class:`UpdateGate` screens every decoded client snapshot before it can
+enter the aggregate step:
+
+1. **conformance** — key set, per-tensor shape AND dtype must match the
+   server's shared template (the skew-skip logic that used to live inline
+   in ``server._collect_snapshots``);
+2. **finiteness** — every tensor must be NaN/Inf-free;
+3. **norm screening** — the update norm ``||snapshot - current_global||``
+   is tested against the round cohort's ``median + k * MAD`` (a robust
+   outlier test that needs no tuning against absolute scales), and
+   optionally hard-clipped to ``max_update_norm`` (gradient-clipping
+   semantics: the direction is kept, the influence is bounded).
+
+Rejected updates are excluded from the average, logged as
+``update_rejected`` telemetry events with a machine-readable reason code,
+and counted per client; ``consecutive(client)`` lets the server feed
+repeat offenders into the PR 2 probation machinery
+(``Federation.mark_suspect(reason="poisoned")``) so a persistently
+poisonous client is backed off and eventually dropped exactly like a
+persistently unreachable one.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = ["Rejection", "GateResult", "UpdateGate", "update_norm"]
+
+# Reason codes (the `update_rejected` event's `reason` field vocabulary).
+KEY_SKEW = "key_skew"
+SHAPE_SKEW = "shape_skew"
+DTYPE_SKEW = "dtype_skew"
+NONFINITE = "nonfinite"
+NORM_OUTLIER = "norm_outlier"
+
+#: MAD → sigma for normally distributed data (the usual robust-scale
+#: consistency constant).
+_MAD_SIGMA = 1.4826
+
+
+def update_norm(
+    snapshot: Mapping[str, np.ndarray],
+    reference: Mapping[str, np.ndarray],
+) -> float:
+    """Global L2 norm of ``snapshot - reference`` over the shared subset
+    (float64 accumulation — a poisoned float32 update can overflow a
+    same-dtype square)."""
+    total = 0.0
+    for key, value in snapshot.items():
+        d = (
+            np.asarray(value, np.float64)
+            - np.asarray(reference[key], np.float64)
+        )
+        total += float(np.dot(d.ravel(), d.ravel()))
+    return float(np.sqrt(total))
+
+
+@dataclass
+class Rejection:
+    """One gated-out update: who, why, and with what norm (NaN when the
+    rejection happened before the norm stage)."""
+
+    client_id: int
+    reason: str
+    detail: str
+    norm: float = float("nan")
+
+
+@dataclass
+class GateResult:
+    """Outcome of one round's admission pass."""
+
+    accepted: list  # [(client_id, weight, snapshot)]
+    rejected: list  # [Rejection]
+    clipped: list  # [(client_id, norm, max_norm)]
+
+
+class UpdateGate:
+    """Per-round admission screening of decoded client snapshots.
+
+    ``mad_k <= 0`` disables the cohort outlier test; ``max_update_norm``
+    ``None`` disables the hard clip; ``check_finite=False`` turns the gate
+    into a pure conformance check (the pre-PR 5 behaviour — used by tests
+    that need to demonstrate unprotected poisoning). The MAD test only
+    runs on cohorts of at least ``min_cohort`` candidates: a median over
+    one or two updates is not a statistic.
+    """
+
+    def __init__(
+        self,
+        *,
+        check_finite: bool = True,
+        mad_k: float = 4.0,
+        mad_rel_floor: float = 0.5,
+        max_update_norm: float | None = None,
+        min_cohort: int = 3,
+        suspect_after: int = 2,
+        metrics: Any = None,
+        logger: logging.Logger | None = None,
+    ):
+        if mad_rel_floor < 0:
+            raise ValueError(
+                f"mad_rel_floor must be >= 0, got {mad_rel_floor}"
+            )
+        if max_update_norm is not None and max_update_norm <= 0:
+            raise ValueError(
+                f"max_update_norm must be > 0, got {max_update_norm}"
+            )
+        if suspect_after < 1:
+            raise ValueError(
+                f"suspect_after must be >= 1, got {suspect_after}"
+            )
+        self.check_finite = bool(check_finite)
+        self.mad_k = float(mad_k)
+        # Scale floor as a fraction of the median norm: with a tiny cohort
+        # the MAD collapses toward 0 and every deviation would read as an
+        # outlier; the floor keeps the rejection threshold at least
+        # (1 + mad_k * mad_rel_floor) x the median.
+        self.mad_rel_floor = float(mad_rel_floor)
+        self.max_update_norm = (
+            None if max_update_norm is None else float(max_update_norm)
+        )
+        self.min_cohort = int(min_cohort)
+        self.suspect_after = int(suspect_after)
+        self.metrics = metrics
+        self.logger = logger or logging.getLogger("UpdateGate")
+        self._expected_keys: frozenset[str] | None = None
+        self._expected_shapes: dict[str, tuple] = {}
+        self._expected_dtypes: dict[str, np.dtype] = {}
+        # Consecutive rejection streak per client (reset on acceptance):
+        # the "repeated offender" signal the server feeds into probation.
+        self._streak: dict[int, int] = {}
+        self.total_rejections: dict[int, int] = {}
+
+    # ---- template ----------------------------------------------------------
+    def set_template(self, template: Mapping[str, np.ndarray]) -> None:
+        """Pin the authoritative key/shape/dtype contract (the server's
+        shared template subset)."""
+        self._expected_keys = frozenset(template)
+        self._expected_shapes = {
+            k: tuple(np.asarray(v).shape) for k, v in template.items()
+        }
+        self._expected_dtypes = {
+            k: np.asarray(v).dtype for k, v in template.items()
+        }
+
+    def consecutive(self, client_id: int) -> int:
+        """Current consecutive-rejection streak for one client."""
+        return self._streak.get(client_id, 0)
+
+    # ---- per-candidate checks ----------------------------------------------
+    def _conformance(self, client_id: int, snap: Mapping) -> Rejection | None:
+        if self._expected_keys is None:
+            return None
+        if frozenset(snap) != self._expected_keys:
+            missing = sorted(self._expected_keys - set(snap))[:3]
+            unexpected = sorted(set(snap) - self._expected_keys)[:3]
+            return Rejection(
+                client_id, KEY_SKEW,
+                f"missing={missing}, unexpected={unexpected}",
+            )
+        for key in snap:
+            arr = np.asarray(snap[key])
+            want = self._expected_shapes[key]
+            if tuple(arr.shape) != want:
+                return Rejection(
+                    client_id, SHAPE_SKEW,
+                    f"{key}: {tuple(arr.shape)} != {want}",
+                )
+            if arr.dtype != self._expected_dtypes[key]:
+                return Rejection(
+                    client_id, DTYPE_SKEW,
+                    f"{key}: {arr.dtype} != {self._expected_dtypes[key]}",
+                )
+        return None
+
+    @staticmethod
+    def _nonfinite(client_id: int, snap: Mapping) -> Rejection | None:
+        for key in sorted(snap):
+            arr = np.asarray(snap[key])
+            if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+                bad = int(arr.size - np.isfinite(arr).sum())
+                return Rejection(
+                    client_id, NONFINITE,
+                    f"{key}: {bad}/{arr.size} non-finite values",
+                )
+        return None
+
+    def _outlier_threshold(self, norms: list[float]) -> float | None:
+        """The cohort's rejection threshold, or None when the MAD test
+        cannot run (disabled, or cohort too small)."""
+        if self.mad_k <= 0 or len(norms) < self.min_cohort:
+            return None
+        arr = np.asarray(norms, np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        scale = max(_MAD_SIGMA * mad, self.mad_rel_floor * med, 1e-12)
+        return med + self.mad_k * scale
+
+    # ---- the round pass ----------------------------------------------------
+    def admit_round(
+        self,
+        candidates: "list[tuple[int, float, dict[str, np.ndarray]]]",
+        current_global: Mapping[str, np.ndarray],
+        round_idx: int,
+    ) -> GateResult:
+        """Screen one round's ``(client_id, weight, snapshot)`` candidates.
+
+        Order matters: conformance and finiteness run per candidate; norms
+        are then computed for the structurally-sound survivors ONLY (a
+        shape-skewed or NaN update must not pollute the cohort statistics
+        it is judged against); MAD outliers are rejected on raw norms;
+        finally the hard clip bounds whoever remains. Telemetry and streak
+        bookkeeping happen here so every caller gets identical accounting.
+        """
+        rejected: list[Rejection] = []
+        clipped: list[tuple[int, float, float]] = []
+        sound: list[tuple[int, float, dict, float]] = []
+        for client_id, weight, snap in candidates:
+            rej = self._conformance(client_id, snap)
+            if rej is None and self.check_finite:
+                rej = self._nonfinite(client_id, snap)
+            if rej is not None:
+                rejected.append(rej)
+                continue
+            norm = (
+                update_norm(snap, current_global)
+                if (self.mad_k > 0 or self.max_update_norm is not None)
+                and self.check_finite
+                else float("nan")
+            )
+            sound.append((client_id, weight, snap, norm))
+
+        threshold = self._outlier_threshold(
+            [n for _c, _w, _s, n in sound if np.isfinite(n)]
+        )
+        accepted: list[tuple[int, float, dict]] = []
+        for client_id, weight, snap, norm in sound:
+            if threshold is not None and norm > threshold:
+                rejected.append(Rejection(
+                    client_id, NORM_OUTLIER,
+                    f"update norm {norm:.3e} > cohort threshold "
+                    f"{threshold:.3e}",
+                    norm=norm,
+                ))
+                continue
+            if (
+                self.max_update_norm is not None
+                and np.isfinite(norm) and norm > self.max_update_norm
+            ):
+                factor = self.max_update_norm / norm
+                snap = {
+                    k: np.asarray(
+                        np.asarray(current_global[k], np.float64)
+                        + factor * (
+                            np.asarray(v, np.float64)
+                            - np.asarray(current_global[k], np.float64)
+                        ),
+                        dtype=np.asarray(v).dtype,
+                    )
+                    for k, v in snap.items()
+                }
+                clipped.append((client_id, norm, self.max_update_norm))
+            accepted.append((client_id, weight, snap))
+
+        self._account(accepted, rejected, clipped, round_idx)
+        return GateResult(accepted=accepted, rejected=rejected,
+                          clipped=clipped)
+
+    def _account(self, accepted, rejected, clipped, round_idx: int) -> None:
+        m = self.metrics
+        for client_id, _w, _s in accepted:
+            self._streak.pop(client_id, None)
+        for rej in rejected:
+            self._streak[rej.client_id] = (
+                self._streak.get(rej.client_id, 0) + 1
+            )
+            self.total_rejections[rej.client_id] = (
+                self.total_rejections.get(rej.client_id, 0) + 1
+            )
+            self.logger.warning(
+                "round %d: rejecting client %d update (%s: %s); excluding "
+                "it from the average", round_idx, rej.client_id, rej.reason,
+                rej.detail,
+            )
+            if m is not None:
+                m.registry.counter("updates_rejected").inc()
+                m.registry.counter(f"updates_rejected/{rej.reason}").inc()
+                if rej.reason in (KEY_SKEW, SHAPE_SKEW, DTYPE_SKEW):
+                    # Historical conformance counter, kept for dashboard
+                    # continuity with the PR 2 skew-skip logic.
+                    m.registry.counter("key_skew_excluded").inc()
+                event = dict(
+                    client=rej.client_id, round=round_idx,
+                    reason=rej.reason, detail=rej.detail,
+                )
+                if np.isfinite(rej.norm):
+                    event["norm"] = rej.norm
+                m.log("update_rejected", **event)
+        for client_id, norm, max_norm in clipped:
+            self.logger.warning(
+                "round %d: clipping client %d update norm %.3e -> %.3e",
+                round_idx, client_id, norm, max_norm,
+            )
+            if m is not None:
+                m.registry.counter("updates_clipped").inc()
+                m.log(
+                    "update_clipped", client=client_id, round=round_idx,
+                    norm=norm, max_norm=max_norm,
+                )

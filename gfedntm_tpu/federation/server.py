@@ -27,7 +27,13 @@ sleep × N clients per step plus 2N fresh channels, SURVEY.md §3.3):
 - round state (``last_average`` + round counter + membership) is
   **checkpointed** every ``checkpoint_every`` rounds, and a crashed server
   restarted with :meth:`FederatedServer.restore_from_checkpoint` continues
-  from the checkpointed round while clients rejoin.
+  from the checkpointed round while clients rejoin;
+- the data plane is hardened too (README "Robust aggregation & divergence
+  recovery"): every decoded reply passes an **update admission gate**
+  (conformance, finiteness, cohort norm screening) before it can enter the
+  aggregate, the mean stage may be **byzantine-robust**
+  (trimmed-mean/median/Krum), and a **divergence guardian** rolls the
+  global model back to the last good checkpoint when it diverges anyway.
 """
 
 from __future__ import annotations
@@ -55,7 +61,9 @@ from gfedntm_tpu.federation.compression import (
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
+from gfedntm_tpu.federation.sanitize import UpdateGate
 from gfedntm_tpu.models.avitm import AVITM
+from gfedntm_tpu.train.guardian import DivergenceGuardian
 from gfedntm_tpu.models.ctm import CTM
 from gfedntm_tpu.utils.observability import (
     OpsServer,
@@ -118,6 +126,12 @@ class FederatedServer:
         fault_injector=None,
         aggregator="fedavg",
         aggregator_kwargs: dict[str, Any] | None = None,
+        robust_aggregator: str | None = None,
+        sanitize: bool = True,
+        max_update_norm: float | None = None,
+        outlier_mad_k: float = 4.0,
+        divergence_patience: int = 3,
+        divergence_loss_factor: float = 4.0,
         wire_codec: str = "none",
         codec_ref_cache: int = 8,
         ops_port: int | None = None,
@@ -165,7 +179,30 @@ class FederatedServer:
         # FedAvgM/FedAdam/FedYogi carry server-optimizer state across
         # rounds (checkpointed with the round state, so --resume keeps it).
         self.aggregator = make_aggregator(
-            aggregator, **(aggregator_kwargs or {})
+            aggregator, robust=robust_aggregator,
+            **(aggregator_kwargs or {})
+        )
+        # Data-plane defense (README "Robust aggregation & divergence
+        # recovery"), three layers: (1) the update admission gate screens
+        # every decoded reply (conformance always; finiteness + norm
+        # screening unless sanitize=False) and feeds repeat offenders into
+        # probation; (2) the aggregator above may carry a byzantine-robust
+        # mean stage; (3) the divergence guardian watches the aggregate
+        # itself and triggers a checkpoint rollback when the global model
+        # diverges anyway (divergence_patience=0 disables it).
+        self.update_gate = UpdateGate(
+            check_finite=bool(sanitize),
+            mad_k=float(outlier_mad_k) if sanitize else 0.0,
+            max_update_norm=max_update_norm if sanitize else None,
+            metrics=metrics, logger=self.logger,
+        )
+        self.guardian = (
+            DivergenceGuardian(
+                patience=divergence_patience,
+                loss_factor=divergence_loss_factor,
+                metrics=metrics, logger=self.logger,
+            )
+            if divergence_patience > 0 else None
         )
         # Wire codec, negotiated with every client at join time: the
         # GlobalSetup advertises this id, ReadyForTraining verifies the
@@ -179,6 +216,12 @@ class FederatedServer:
         # Clients that acked the most recent push — a push may only be
         # delta-encoded when every recipient holds the previous broadcast.
         self._push_acked: set[int] = set()
+        # Set by a divergence rollback: the NEXT push carries
+        # Aggregate.reset_session so every recipient drops its wire-codec
+        # session state (delta refs + error-feedback residuals) before
+        # applying — no mass from the discarded trajectory survives
+        # client-side.
+        self._session_reset_pending = False
 
         # Clients whose compile-dominated first poll has been seen (and
         # excluded from the poll-latency/straggler stats).
@@ -227,6 +270,11 @@ class FederatedServer:
         self._expected_keys: frozenset[str] | None = None
         self._expected_shapes: dict[str, tuple] | None = None
         self._ckpt = None
+        # Bookkeeping of the most recent admitted cohort, written by
+        # _collect_snapshots and read by the guardian step: (client_id,
+        # weight, reported loss) per accepted reply. Single-threaded use —
+        # only the training loop touches it.
+        self._round_accepted: list[tuple[int, float, float]] = []
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self, address: str = "[::]:50051") -> str:
@@ -309,6 +357,10 @@ class FederatedServer:
             metric = reg.get(name) if reg is not None else None
             return metric.value if metric is not None else None
 
+        def count(name):
+            metric = reg.get(name) if reg is not None else None
+            return int(metric.value) if metric is not None else 0
+
         return {
             "round": int(self.global_iterations),
             "max_iters": int(self.max_iters),
@@ -327,6 +379,25 @@ class FederatedServer:
                 "ratio_recv": gauge("compression_ratio_recv"),
             },
             "stragglers": self.straggler.status(),
+            # Data-plane defense view (README "Robust aggregation &
+            # divergence recovery"): every rejection/clip/rollback is
+            # visible here as well as in the JSONL stream.
+            "data_plane": {
+                "sanitize": self.update_gate.check_finite,
+                "outlier_mad_k": self.update_gate.mad_k,
+                "max_update_norm": self.update_gate.max_update_norm,
+                "updates_rejected": count("updates_rejected"),
+                "updates_clipped": count("updates_clipped"),
+                "rejections_by_client": dict(
+                    self.update_gate.total_rejections
+                ),
+                "divergence_rollbacks": count("divergence_rollbacks"),
+                "clients_quarantined": count("clients_quarantined"),
+                "guardian_healthy": (
+                    self.guardian.healthy if self.guardian is not None
+                    else None
+                ),
+            },
         }
 
     def wait_done(self, timeout: float | None = None) -> bool:
@@ -468,21 +539,35 @@ class FederatedServer:
         restored average is applied onto the template so rejoining clients
         replicate the TRAINED state, not a fresh init. Call before
         :meth:`start`. Returns the restored round; raises
-        ``FileNotFoundError`` when there is nothing to resume."""
+        ``FileNotFoundError`` when there is nothing to resume and
+        :class:`~gfedntm_tpu.train.checkpoint.CheckpointIntegrityError`
+        (after a ``checkpoint_invalid`` telemetry event) when what exists
+        is corrupt — a broken ``--resume`` must say what is broken and how
+        to recover, not dump a JSON traceback."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
         ckpt = self._checkpointer()
-        meta = ckpt.load_meta()
-        if meta is None or ckpt.latest_round() is None:
-            raise FileNotFoundError(
-                f"no federation checkpoint under {ckpt.directory}"
+        try:
+            meta = ckpt.load_meta()
+            if meta is None or ckpt.latest_round() is None:
+                raise FileNotFoundError(
+                    f"no federation checkpoint under {ckpt.directory}"
+                )
+            self.global_vocab = Vocabulary(tuple(meta["vocab"]))
+            self.template = build_template_model(
+                self.family, len(self.global_vocab), self.model_kwargs
             )
-        self.global_vocab = Vocabulary(tuple(meta["vocab"]))
-        self.template = build_template_model(
-            self.family, len(self.global_vocab), self.model_kwargs
-        )
-        template = self._shared_template()
-        self._expected_keys = frozenset(template)
-        self._expected_shapes = {k: v.shape for k, v in template.items()}
-        round_idx, average = ckpt.restore_round(template)
+            template = self._shared_template()
+            self._expected_keys = frozenset(template)
+            self._expected_shapes = {k: v.shape for k, v in template.items()}
+            self.update_gate.set_template(template)
+            round_idx, average = ckpt.restore_round(template)
+        except CheckpointIntegrityError as err:
+            self.logger.error("cannot resume: %s", err)
+            if self.metrics is not None:
+                self.metrics.registry.counter("checkpoint_invalid").inc()
+                self.metrics.log("checkpoint_invalid", reason=str(err))
+            raise
         self.last_average = average
         self.global_iterations = int(round_idx)
         self._restore_aggregator_state(ckpt, meta, round_idx)
@@ -623,15 +708,18 @@ class FederatedServer:
         return entry[2]
 
     def _note_client_failure(self, rec, addr: str, round_idx: int,
-                             exc: Exception, what: str) -> None:
+                             exc: Exception, what: str,
+                             reason: str = "rpc") -> None:
         """Round-level failure accounting: probation with per-round backoff
         (``SUSPECT``) for ``probation_rounds`` consecutive failed rounds,
         then the permanent drop. ALL failure classes go through probation —
         a deterministic error simply fails its probation and drops within a
-        bounded number of rounds, while a transient one recovers."""
+        bounded number of rounds, while a transient one recovers. ``reason``
+        distinguishes transport failures ("rpc") from data-plane ones
+        ("poisoned" gate rejections, "divergence" quarantines)."""
         status = self.federation.mark_suspect(
             rec.client_id, addr, round_idx,
-            probation_rounds=self.probation_rounds,
+            probation_rounds=self.probation_rounds, reason=reason,
         )
         if status is None:  # stale: the client rejoined on a new address
             return
@@ -661,7 +749,7 @@ class FederatedServer:
                 self.metrics.log(
                     "client_suspect", client=rec.client_id,
                     failures=rec.consecutive_failures, status=status,
-                    round=round_idx,
+                    round=round_idx, reason=reason,
                 )
 
     def _note_round_poll(self, round_sp, polled, replies, iteration) -> None:
@@ -721,26 +809,50 @@ class FederatedServer:
                 ),
             )
 
+    def _current_global(self) -> dict[str, np.ndarray]:
+        """The parameters every client stepped from this round: the last
+        broadcast average, or the template init before round 0 — the
+        reference point for both the admission gate's update norms and the
+        server-optimizer pseudo-gradient."""
+        return (
+            self.last_average if self.last_average is not None
+            else self._shared_template()
+        )
+
+    def _ensure_template(self) -> None:
+        if self._expected_keys is None:
+            template = self._shared_template()
+            self._expected_keys = frozenset(template)
+            self._expected_shapes = {k: v.shape for k, v in template.items()}
+            self.update_gate.set_template(template)
+
     def _collect_snapshots(
-        self, replies: list, iteration: int
+        self, replies: list, iteration: int,
+        was_suspect: frozenset = frozenset(),
     ) -> list[tuple[float, dict[str, np.ndarray]]]:
-        """Decode a round's replies into ``(weight, flat-snapshot)`` pairs,
-        excluding any reply whose shared-key set OR array shapes do not
-        match the template's — a version-skewed (or corrupted) client must
-        cost the round one contributor, not ``KeyError`` (or a broadcast
-        ``ValueError``: same keys over a different consensus vocab is the
-        likelier skew) the whole average.
+        """Decode a round's replies and pass them through the update
+        admission gate (:class:`~gfedntm_tpu.federation.sanitize.UpdateGate`):
+        conformance (key set / shapes / dtypes vs the shared template),
+        per-tensor finiteness, and the cohort update-norm outlier screen.
+        Anything the gate rejects costs the round one contributor — never a
+        ``KeyError`` in the average or a poisoned broadcast — and repeat
+        offenders are fed into the probation machinery with
+        ``reason="poisoned"``.
+
+        Recovery is admission-scoped: a suspect client (``was_suspect``)
+        only clears probation when its update is *accepted*, not merely
+        because its RPC succeeded — a poisoner that answers politely must
+        not oscillate in and out of probation forever.
 
         The FedAvg weight is the reply's ``nr_samples`` — the samples the
         client actually consumed this round (summed over all E local
         minibatches, ADVICE r5) — falling back to the client's join-time
         corpus size for replies that don't report one."""
-        if self._expected_keys is None:
-            template = self._shared_template()
-            self._expected_keys = frozenset(template)
-            self._expected_shapes = {k: v.shape for k, v in template.items()}
+        self._ensure_template()
         m = self.metrics
-        snapshots: list[tuple[float, dict[str, np.ndarray]]] = []
+        records: dict[int, Any] = {}
+        losses: dict[int, float] = {}
+        candidates: list[tuple[int, float, dict[str, np.ndarray]]] = []
         for rec, reply in replies:
             try:
                 if self.wire_codec.identity:
@@ -766,38 +878,52 @@ class FederatedServer:
                         round=iteration,
                     )
                 continue
-            if frozenset(snap) != self._expected_keys:
-                missing = sorted(self._expected_keys - set(snap))[:3]
-                unexpected = sorted(set(snap) - self._expected_keys)[:3]
-                self.logger.warning(
-                    "round %d: client %d reply keys mismatch the shared "
-                    "template (missing=%s, unexpected=%s); excluding it "
-                    "from the average", iteration, rec.client_id,
-                    missing, unexpected,
-                )
-                if m is not None:
-                    m.registry.counter("key_skew_excluded").inc()
-                continue
-            skewed = {
-                k: (v.shape, self._expected_shapes[k])
-                for k, v in snap.items()
-                if tuple(v.shape) != tuple(self._expected_shapes[k])
-            }
-            if skewed:
-                k, (got, want) = next(iter(sorted(skewed.items())))
-                self.logger.warning(
-                    "round %d: client %d reply shapes mismatch the shared "
-                    "template (%d keys, e.g. %s: %s != %s); excluding it "
-                    "from the average", iteration, rec.client_id,
-                    len(skewed), k, got, want,
-                )
-                if m is not None:
-                    m.registry.counter("key_skew_excluded").inc()
-                continue
-            snapshots.append(
-                (float(reply.nr_samples) or rec.nr_samples, snap)
+            records[rec.client_id] = rec
+            losses[rec.client_id] = float(reply.loss)
+            candidates.append(
+                (rec.client_id,
+                 float(reply.nr_samples) or rec.nr_samples, snap)
             )
-        return snapshots
+
+        result = self.update_gate.admit_round(
+            candidates, self._current_global(), iteration
+        )
+        # Repeat offenders enter probation exactly like transport failures:
+        # backoff, then the permanent drop — a client that only ever sends
+        # poison must leave the federation in bounded time.
+        for rej in result.rejected:
+            rec = records[rej.client_id]
+            if (
+                self.update_gate.consecutive(rej.client_id)
+                >= self.update_gate.suspect_after
+            ):
+                self._note_client_failure(
+                    rec, rec.address, iteration,
+                    RuntimeError(f"{rej.reason}: {rej.detail}"),
+                    "update admission", reason="poisoned",
+                )
+        # Admission-scoped recovery (see docstring).
+        for client_id, _w, _s in result.accepted:
+            if client_id in was_suspect and self.federation.mark_recovered(
+                client_id
+            ):
+                self.logger.info(
+                    "client %d recovered (update admitted at round %d)",
+                    client_id, iteration,
+                )
+                if m is not None:
+                    m.registry.counter("client_recoveries").inc()
+                    m.log(
+                        "client_recovered", client=client_id,
+                        round=iteration,
+                    )
+        self._round_accepted = [
+            (client_id, weight, losses[client_id])
+            for client_id, weight, _snap in result.accepted
+        ]
+        return [
+            (weight, snap) for _client_id, weight, snap in result.accepted
+        ]
 
     def _encode_push(
         self, average: dict[str, np.ndarray], iteration: int, replies: list
@@ -806,11 +932,15 @@ class FederatedServer:
         delta-encoded push is only legal when every recipient holds the
         previous broadcast (acked it); otherwise the push is
         self-contained. The client-held view of this push becomes an
-        uplink delta reference for the following rounds."""
+        uplink delta reference for the following rounds. A pending
+        session reset (divergence rollback) rides out on this push's
+        ``reset_session`` flag."""
+        reset_session = self._session_reset_pending
+        self._session_reset_pending = False
         if self.wire_codec.identity:
             return pb.Aggregate(
                 shared=codec.flatdict_to_bundle(average, metrics=self.metrics),
-                round=iteration,
+                round=iteration, reset_session=reset_session,
             )
         repliers = {rec.client_id for rec, _reply in replies}
         allow_delta = bool(self._push_acked) and repliers <= self._push_acked
@@ -818,7 +948,113 @@ class FederatedServer:
             average, round_idx=iteration, allow_delta=allow_delta
         )
         self._uplink_dec.note_push(iteration, client_view)
-        return pb.Aggregate(shared=bundle, round=iteration)
+        return pb.Aggregate(
+            shared=bundle, round=iteration, reset_session=reset_session,
+        )
+
+    def _divergence_rollback(
+        self, iteration: int, verdict: str
+    ) -> "dict[str, np.ndarray] | None":
+        """Restore the last good checkpointed round after a divergence
+        verdict and return its average (the rollback re-broadcast), or
+        ``None`` when nothing safe exists to restore.
+
+        Alongside the parameters: the wire-codec sessions are reset (a
+        delta-encoded push against the diverged broadcast chain would
+        mis-decode on rolled-back state — the re-broadcast is
+        self-contained and rebuilds the reference chain), the aggregator's
+        optimizer state is rolled back to the same round, clients whose
+        admitted weight dominated the unhealthy streak are quarantined via
+        probation, and the guardian's baselines are re-anchored."""
+        m = self.metrics
+        restored: dict[str, np.ndarray] | None = None
+        restored_round: int | None = None
+        if self.save_dir is not None:
+            try:
+                ckpt = self._checkpointer()
+                if ckpt.latest_round() is not None:
+                    self._ensure_template()
+                    restored_round, restored = ckpt.restore_round(
+                        self._shared_template()
+                    )
+                    self._restore_aggregator_state(
+                        ckpt, ckpt.load_meta() or {}, restored_round
+                    )
+            except Exception:
+                self.logger.exception(
+                    "round %d: divergence rollback restore failed",
+                    iteration,
+                )
+                restored, restored_round = None, None
+        if restored is None:
+            # No checkpoint to return to. A non-finite aggregate must
+            # still never reach a client — fall back to the last broadcast
+            # state (or the template init); a loss/norm explosion with no
+            # checkpoint keeps the computed average (nothing better
+            # exists) and the guardian keeps watching.
+            if verdict != "nonfinite_global":
+                # No reset here: the guardian stays unhealthy, so the
+                # periodic checkpoint stays withheld (the diverged state
+                # must never become a future rollback target) and the
+                # verdict keeps firing — loud every round — until the
+                # signals recover on their own or an operator steps in.
+                self.logger.error(
+                    "round %d: divergence (%s) but no checkpoint to roll "
+                    "back to; continuing with the current aggregate",
+                    iteration, verdict,
+                )
+                return None
+            restored = self._current_global()
+            self.logger.error(
+                "round %d: non-finite aggregate and no checkpoint; "
+                "re-broadcasting the last finite state instead",
+                iteration,
+            )
+        # The compressed-push reference chains describe the diverged
+        # trajectory — drop them all so the rollback re-broadcast (and
+        # everything after it) is decoded only against post-rollback state.
+        # Clients hold session state too (delta refs AND error-feedback
+        # residuals carrying un-delivered diverged mass): the re-broadcast
+        # orders them to reset theirs via Aggregate.reset_session.
+        self._push_acked.clear()
+        self._session_reset_pending = True
+        if not self.wire_codec.identity:
+            self._uplink_dec.reset()
+            self._downlink_enc.reset()
+        quarantined = self.guardian.dominant_contributors()
+        for client_id in quarantined:
+            rec = next(
+                (c for c in self.federation.get_clients()
+                 if c.client_id == client_id), None,
+            )
+            if rec is None:
+                continue
+            self._note_client_failure(
+                rec, rec.address, iteration,
+                RuntimeError(f"dominated the diverged rounds ({verdict})"),
+                "divergence quarantine", reason="divergence",
+            )
+            if m is not None:
+                m.registry.counter("clients_quarantined").inc()
+                m.log(
+                    "client_quarantined", client=client_id,
+                    round=iteration, reason=verdict,
+                )
+        self.guardian.note_rollback()
+        self.logger.warning(
+            "round %d: DIVERGENCE (%s) — rolled back to %s, quarantined "
+            "%s", iteration, verdict,
+            f"checkpointed round {restored_round}"
+            if restored_round is not None else "last finite state",
+            quarantined or "nobody",
+        )
+        if m is not None:
+            m.registry.counter("divergence_rollbacks").inc()
+            event = dict(round=iteration, reason=verdict)
+            if restored_round is not None:
+                event["restored_round"] = int(restored_round)
+            m.log("divergence_rollback", **event)
+        return restored
 
     def _skip_below_quorum(self, iteration: int, got: int, membership: int,
                            quorum: int, what: str) -> None:
@@ -917,13 +1153,20 @@ class FederatedServer:
                         self.trace_id, round_sp.span_id, iteration
                     )
 
+                # Suspects entering this round's poll: probation clearance
+                # moved to update ADMISSION (see _collect_snapshots) — the
+                # set is snapshotted here because a successful RPC alone no
+                # longer proves the client is healthy.
+                was_suspect = frozenset(
+                    rec.client_id for rec in active
+                    if rec.status == SUSPECT
+                )
+
                 # 1. concurrent poll: one local step per client. The round
                 # span is handed down explicitly — pool threads don't
                 # inherit the loop thread's contextvars.
                 def poll(rec):
                     addr = rec.address  # snapshot: rejoin may change it mid-RPC
-                    was_suspect = rec.status == SUSPECT
-                    prior_failures = rec.consecutive_failures
                     t0 = time.perf_counter()
                     try:
                         stub = self._stub_for(stubs, rec)
@@ -942,19 +1185,6 @@ class FederatedServer:
                             timeout=120.0 + 2.0 * self.local_steps,
                             **rpc_kwargs,
                         )
-                        if was_suspect and self.federation.mark_recovered(
-                            rec.client_id
-                        ):
-                            self.logger.info(
-                                "client %d recovered after %d failed rounds",
-                                rec.client_id, prior_failures,
-                            )
-                            if m is not None:
-                                m.registry.counter("client_recoveries").inc()
-                                m.log(
-                                    "client_recovered", client=rec.client_id,
-                                    round=iteration,
-                                )
                         return rec, reply, time.perf_counter() - t0
                     except Exception as exc:
                         self._note_client_failure(
@@ -1011,24 +1241,45 @@ class FederatedServer:
                 # round's contributors — clients that finished early or
                 # were dropped must not dilute the average.
                 with span(m, "average", parent=round_sp):
-                    snapshots = self._collect_snapshots(replies, iteration)
+                    snapshots = self._collect_snapshots(
+                        replies, iteration, was_suspect
+                    )
                     if len(snapshots) < quorum:
-                        # Key-skew exclusions can take a round that passed
-                        # the reply quorum back below it — skip, same as a
-                        # below-quorum poll, so the average never comes
-                        # from fewer contributors than the quorum promises.
+                        # Gate exclusions (skew, non-finite, norm outliers)
+                        # can take a round that passed the reply quorum back
+                        # below it — skip, same as a below-quorum poll, so
+                        # the average never comes from fewer contributors
+                        # than the quorum promises.
                         self._skip_below_quorum(
                             iteration, len(snapshots), membership, quorum,
-                            "usable after key validation",
+                            "admitted by the update gate",
                         )
                         continue
-                    current = (
-                        self.last_average if self.last_average is not None
-                        else self._shared_template()
-                    )
                     average = self.aggregator.aggregate(
-                        snapshots, current_global=current
+                        snapshots, current_global=self._current_global()
                     )
+                    # Divergence backstop: the guardian judges the fresh
+                    # aggregate BEFORE it becomes last_average or reaches
+                    # any client; a verdict swaps in the restored
+                    # checkpoint state instead (the rollback re-broadcast).
+                    if self.guardian is not None:
+                        verdict = self.guardian.observe(
+                            iteration,
+                            losses=[
+                                loss for _c, _w, loss in
+                                self._round_accepted
+                            ],
+                            average=average,
+                            contributors=[
+                                (c, w) for c, w, _l in self._round_accepted
+                            ],
+                        )
+                        if verdict is not None:
+                            restored = self._divergence_rollback(
+                                iteration, verdict
+                            )
+                            if restored is not None:
+                                average = restored
                     self.last_average = average
                     agg = self._encode_push(average, iteration, replies)
 
@@ -1073,7 +1324,12 @@ class FederatedServer:
                 self.checkpoint_every > 0 and self.save_dir is not None
                 and self.last_average is not None
                 and self.global_iterations % self.checkpoint_every == 0
+                and (self.guardian is None or self.guardian.healthy)
             ):
+                # While the guardian has an open unhealthy streak, the
+                # periodic checkpoint is withheld: the state it would
+                # persist is exactly what a rollback may be about to
+                # discard, and the rollback target must stay good.
                 self._save_round_checkpoint()
             if m is not None and iteration % 50 == 0:
                 # Periodic snapshot alongside the progress event so even a

@@ -124,8 +124,15 @@ class AVITM:
         # Compute dtype for the network's matmuls ("bfloat16" feeds the MXU
         # at twice the f32 rate; parameters and BatchNorm statistics stay
         # float32 — standard mixed precision). ELBO-parity tests run f32.
+        # PRECISION ASSUMPTION (ADVICE r5): under "bfloat16" the fused
+        # decoder streams x_bow in bf16 storage too, which represents
+        # integer counts exactly only up to 256 — corpora whose most
+        # frequent term exceeds 255 occurrences in a document are silently
+        # quantized. _device_data screens for this once per corpus and
+        # warns loudly (train.steps.check_bf16_bow_counts).
         assert compute_dtype in ("float32", "bfloat16")
         self.compute_dtype = compute_dtype
+        self._bf16_bow_checked = False
 
         self.best_loss_train = float("inf")
         self.epoch_losses: list[float] = []
@@ -243,6 +250,14 @@ class AVITM:
         return 1.0
 
     def _device_data(self, dataset: BowDataset) -> dict[str, Any]:
+        if self.compute_dtype == "bfloat16" and not self._bf16_bow_checked:
+            # One-time host-side screen for the bf16 count-quantization
+            # hazard (see the compute_dtype note in __init__) — inside the
+            # jitted programs there is no way to warn.
+            from gfedntm_tpu.train.steps import check_bf16_bow_counts
+
+            self._bf16_bow_checked = True
+            check_bf16_bow_counts(dataset.X, self.logger)
         return {"x_bow": jnp.asarray(dataset.X)}
 
     # ---- training ----------------------------------------------------------

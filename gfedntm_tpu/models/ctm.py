@@ -113,6 +113,13 @@ class CTM(AVITM):
         return float(self.weights.get("beta", 1.0))
 
     def _device_data(self, dataset: CTMDataset) -> dict[str, Any]:
+        if self.compute_dtype == "bfloat16" and not self._bf16_bow_checked:
+            # Same one-time bf16 count-quantization screen as AVITM's
+            # (see the compute_dtype note in AVITM.__init__).
+            from gfedntm_tpu.train.steps import check_bf16_bow_counts
+
+            self._bf16_bow_checked = True
+            check_bf16_bow_counts(dataset.X, self.logger)
         data = {
             "x_bow": jnp.asarray(dataset.X),
             "x_ctx": jnp.asarray(dataset.X_ctx),

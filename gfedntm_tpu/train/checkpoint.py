@@ -25,6 +25,13 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+class CheckpointIntegrityError(RuntimeError):
+    """A federation checkpoint is unusable (truncated/corrupt sidecar JSON,
+    or the sidecar and the orbax round directories disagree). Raised with
+    an actionable message instead of letting a raw ``JSONDecodeError`` /
+    ``KeyError`` traceback surface mid ``--resume``."""
+
+
 class CheckpointManager:
     """Thin orbax wrapper: numbered step checkpoints under one directory."""
 
@@ -46,6 +53,9 @@ class CheckpointManager:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
 
     def restore(self, target: Any, step: int | None = None) -> Any:
         """Restore into the structure/shardings of ``target`` (a live state
@@ -77,9 +87,12 @@ class FederationCheckpointer:
     membership snapshot — lives in an atomically-replaced
     ``federation.json`` next to the round directories. The orbax
     ``latest_step`` is the authoritative resume round; the sidecar is
-    rewritten after each array save, so after a crash between the two it is
-    at most one checkpoint stale in membership (never in keys — those are
-    fixed by the model config).
+    rewritten after each array save, and :meth:`restore_round` verifies the
+    two agree — after a crash between the writes it falls back (loudly) to
+    the round the sidecar describes when that round is still on disk,
+    while a corrupt/truncated sidecar or an unreconcilable mismatch
+    surfaces as :class:`CheckpointIntegrityError` with a recovery hint,
+    never as a raw traceback mid ``--resume``.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
@@ -149,18 +162,45 @@ class FederationCheckpointer:
         ``None`` when the aggregator was stateless (no file)."""
         if not os.path.exists(self.aggregator_path):
             return None
-        with np.load(self.aggregator_path) as data:
-            arrays = {k: data[k] for k in data.files if k != "__round__"}
-            return int(data["__round__"]), arrays
+        try:
+            with np.load(self.aggregator_path) as data:
+                arrays = {k: data[k] for k in data.files if k != "__round__"}
+                return int(data["__round__"]), arrays
+        except (OSError, ValueError, KeyError) as err:
+            raise CheckpointIntegrityError(
+                f"aggregator state {self.aggregator_path} is corrupt "
+                f"({err}); delete it to restart the server optimizer cold"
+            ) from err
 
     def latest_round(self) -> int | None:
         return self._mgr.latest_step()
 
     def load_meta(self) -> dict[str, Any] | None:
+        """The sidecar metadata, or ``None`` when absent. A sidecar that
+        exists but cannot be parsed (truncated write, disk corruption) or
+        lacks its required keys raises :class:`CheckpointIntegrityError`
+        with a recovery hint rather than a raw traceback."""
         if not os.path.exists(self.meta_path):
             return None
         with open(self.meta_path) as fh:
-            return json.load(fh)
+            try:
+                meta = json.load(fh)
+            except json.JSONDecodeError as err:
+                raise CheckpointIntegrityError(
+                    f"federation sidecar {self.meta_path} is truncated or "
+                    f"corrupt ({err}); restore it from a backup, or delete "
+                    f"the checkpoint directory {self.directory} to start "
+                    "the federation fresh"
+                ) from err
+        missing = [k for k in ("round", "average_keys") if k not in meta]
+        if missing:
+            raise CheckpointIntegrityError(
+                f"federation sidecar {self.meta_path} is missing required "
+                f"keys {missing}; it was not written by this server "
+                f"version — delete the checkpoint directory "
+                f"{self.directory} to start fresh"
+            )
+        return meta
 
     def restore_round(
         self, template: dict[str, np.ndarray], step: int | None = None
@@ -178,11 +218,39 @@ class FederationCheckpointer:
                 f"checkpoint avg keys not in template (model config "
                 f"changed since the checkpoint?): {missing[:3]}"
             )
+        explicit_step = step is not None
         step = self.latest_round() if step is None else step
         if step is None:
             raise FileNotFoundError(
                 f"no round checkpoint under {self.directory}"
             )
+        meta_round = int(meta["round"])
+        if not explicit_step and meta_round != int(step):
+            # The two halves are written orbax-first, sidecar-second, so a
+            # crash between the writes leaves the sidecar one checkpoint
+            # behind the newest orbax round. The round the sidecar DOES
+            # describe is usually still on disk (max_to_keep > 1): resume
+            # from it — loudly — instead of pairing round-R arrays with
+            # round-R' metadata or demanding manual surgery.
+            if meta_round in self._mgr.all_steps():
+                import logging
+
+                logging.getLogger("FederationCheckpointer").warning(
+                    "checkpoint sidecar describes round %d but the newest "
+                    "orbax round is %d (crash between the two writes?); "
+                    "resuming from round %d, whose halves agree",
+                    meta_round, int(step), meta_round,
+                )
+                step = meta_round
+            else:
+                raise CheckpointIntegrityError(
+                    f"checkpoint round mismatch under {self.directory}: "
+                    f"the orbax rounds are {self._mgr.all_steps()} but "
+                    f"the sidecar {self.meta_path} describes round "
+                    f"{meta_round}, which is not among them (mixed runs "
+                    "or corruption); delete the checkpoint directory to "
+                    "start fresh"
+                )
         arrays = self._mgr.restore(
             [np.asarray(template[k]) for k in keys], step=step
         )

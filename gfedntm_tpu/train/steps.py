@@ -29,6 +29,34 @@ from gfedntm_tpu.models.losses import (
 from gfedntm_tpu.models.networks import DecoderNetwork
 from gfedntm_tpu.utils.observability import timed_jit
 
+#: bfloat16 has an 8-bit significand: integers are exactly representable
+#: only up to 2**8 = 256. BoW term counts above that are silently rounded
+#: when x_bow rides the fused kernel's bf16 storage path (ADVICE r5).
+BF16_EXACT_COUNT_MAX = 256.0
+
+
+def check_bf16_bow_counts(x_bow, logger=None) -> bool:
+    """Host-side screen for the bf16-storage precision hazard: returns True
+    (and warns loudly through ``logger``) when ``x_bow`` carries counts
+    the bf16 fused-loss storage path cannot represent exactly — i.e.
+    ``max > 256``. Call it ONCE per corpus, outside jit, wherever the BoW
+    matrix is staged to the device; the jitted programs cannot warn."""
+    import numpy as np
+
+    x_max = float(np.max(x_bow)) if np.size(x_bow) else 0.0
+    if x_max <= BF16_EXACT_COUNT_MAX:
+        return False
+    if logger is not None:
+        logger.warning(
+            "compute_dtype='bfloat16' with BoW counts up to %.0f: bf16 "
+            "represents integers exactly only up to %.0f, so the most "
+            "frequent terms of long documents will be silently quantized "
+            "in the fused reconstruction loss. Use compute_dtype='float32'"
+            " (or cap counts in preprocessing) if exact counts matter.",
+            x_max, BF16_EXACT_COUNT_MAX,
+        )
+    return True
+
 
 def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
     return {k: jnp.take(v, idx, axis=0) for k, v in data.items() if v is not None}
@@ -70,6 +98,9 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
     # bf16-compute models stream beta/x through the kernel in bf16 storage
     # too (f32 accumulation — see _pad_core): the loss is bandwidth-bound,
     # so halving its HBM traffic is where compute_dtype actually pays.
+    # Precision assumption (ADVICE r5): bf16 storage keeps x_bow counts
+    # exact only up to BF16_EXACT_COUNT_MAX — AVITM._device_data screens
+    # the corpus host-side and warns once when that is violated.
     storage = (
         "bfloat16"
         if getattr(module, "dtype", jnp.float32) == jnp.bfloat16

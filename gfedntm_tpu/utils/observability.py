@@ -89,6 +89,13 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "quorum_skip": frozenset({"round", "got", "needed"}),
     "checkpoint": frozenset({"round"}),
     "watchdog_fired": frozenset({"client", "idle_s"}),
+    # data-plane defense (update admission gate / divergence guardian;
+    # see README "Robust aggregation & divergence recovery")
+    "update_rejected": frozenset({"client", "round", "reason"}),
+    "update_clipped": frozenset({"client", "round", "norm", "max_norm"}),
+    "divergence_rollback": frozenset({"round", "reason"}),
+    "client_quarantined": frozenset({"client", "round"}),
+    "checkpoint_invalid": frozenset({"reason"}),
     # wire codec negotiation + delta-reference discipline (federation
     # compression subsystem; see README "Aggregation strategies & wire
     # compression")
@@ -532,6 +539,18 @@ NODE_KEY = "x-gfedntm-node"
 #: trace context + the paired send/recv clock stamps). lint_telemetry.py
 #: verifies both names still exist as span() call sites.
 TRACE_PLANE_SPANS: tuple[str, ...] = ("round", "serve")
+
+#: Data-plane defense events (update admission gate, divergence guardian,
+#: checkpoint integrity — README "Robust aggregation & divergence
+#: recovery"). lint_telemetry.py verifies each still has an emission call
+#: site: the defense must never be silently disconnected from telemetry.
+DATA_PLANE_EVENTS: tuple[str, ...] = (
+    "update_rejected",
+    "update_clipped",
+    "divergence_rollback",
+    "client_quarantined",
+    "checkpoint_invalid",
+)
 
 
 def new_trace_id() -> str:

@@ -77,6 +77,7 @@ def declared_spans() -> dict[str, list[str]]:
 def main() -> int:
     sys.path.insert(0, REPO)
     from gfedntm_tpu.utils.observability import (
+        DATA_PLANE_EVENTS,
         EVENT_SCHEMAS,
         TRACE_PLANE_SPANS,
     )
@@ -98,6 +99,19 @@ def main() -> int:
         for name, where in sorted(drift.items()):
             sys.stderr.write(f"  {name!r}: {', '.join(where)}\n")
         return 1
+    # Reverse direction for the data-plane defense events: each must keep
+    # at least one emission site AND a schema entry — a refactor that
+    # disconnects (or de-registers) the admission gate / guardian / ckpt
+    # integrity telemetry would otherwise pass silently.
+    unemitted = [e for e in DATA_PLANE_EVENTS if e not in sites]
+    unregistered = [e for e in DATA_PLANE_EVENTS if e not in EVENT_SCHEMAS]
+    if unemitted or unregistered:
+        sys.stderr.write(
+            "data-plane telemetry drift: "
+            f"events with no .log() call site: {unemitted}; "
+            f"events missing from EVENT_SCHEMAS: {unregistered}\n"
+        )
+        return 1
     spans = declared_spans()
     if not spans:
         sys.stderr.write("lint_telemetry: found no span() call sites — "
@@ -115,7 +129,8 @@ def main() -> int:
         f"telemetry lint: {len(sites)} distinct events across "
         f"{sum(len(w) for w in sites.values())} call sites, all "
         f"registered; {len(spans)} span names cover the trace plane's "
-        f"{list(TRACE_PLANE_SPANS)}"
+        f"{list(TRACE_PLANE_SPANS)}; all {len(DATA_PLANE_EVENTS)} "
+        "data-plane defense events wired"
     )
     return 0
 

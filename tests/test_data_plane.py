@@ -1,0 +1,910 @@
+"""Data-plane hardening suite (tier-1): update admission gate,
+byzantine-robust aggregation, divergence detection + checkpoint rollback,
+payload-corruption fault injection, and checkpoint integrity.
+
+The `chaos` tests run real gRPC federations in-process where one client is
+scripted (via the FaultInjector's payload faults) to emit NaN / 100x-scaled
+updates — the acceptance scenarios of ISSUE 5: robust aggregation matches
+the honest-clients-only baseline while the poisoned client lands in
+probation, and a scripted divergence triggers exactly one rollback to the
+last good checkpointed round before training resumes to completion.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation import codec
+from gfedntm_tpu.federation.aggregation import (
+    FedAdam,
+    FedAvg,
+    Krum,
+    Median,
+    TrimmedMean,
+    make_aggregator,
+    make_estimator,
+    weighted_mean,
+)
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.compression import (
+    DownlinkEncoder,
+    ReferenceMismatch,
+    UplinkDecoder,
+    UplinkEncoder,
+    WireCodec,
+)
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
+from gfedntm_tpu.federation.resilience import FaultInjector, corrupt_bundle
+from gfedntm_tpu.federation.sanitize import UpdateGate, update_norm
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model
+from gfedntm_tpu.train.checkpoint import (
+    CheckpointIntegrityError,
+    FederationCheckpointer,
+)
+from gfedntm_tpu.train.guardian import DivergenceGuardian
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _snaps(*vecs, weight=1.0):
+    return [(weight, {"x": np.asarray(v, np.float32)}) for v in vecs]
+
+
+# ---- robust estimators ------------------------------------------------------
+
+class TestEstimators:
+    honest = ([1.0, 2.0], [1.1, 2.1], [0.9, 1.9])
+
+    def test_median_ignores_scaled_attacker(self):
+        est = Median()(_snaps(*self.honest, [100.0, 200.0]))
+        np.testing.assert_allclose(est["x"], [1.05, 2.05], rtol=1e-5)
+
+    def test_trimmed_mean_drops_extremes(self):
+        est = TrimmedMean(0.25)(_snaps(*self.honest, [100.0, 200.0]))
+        np.testing.assert_allclose(est["x"], [1.05, 2.05], rtol=1e-5)
+        # frac too large for the cohort degrades gracefully to the median
+        est = TrimmedMean(0.49)(_snaps(*self.honest))
+        np.testing.assert_allclose(est["x"], [1.0, 2.0], rtol=1e-5)
+        with pytest.raises(ValueError):
+            TrimmedMean(0.5)
+
+    def test_krum_selects_honest_cluster(self):
+        est = Krum(1)(_snaps(*self.honest, [100.0, 200.0]))
+        np.testing.assert_allclose(est["x"], [1.0, 2.0], rtol=1e-5)
+
+    def test_krum_never_selects_nonfinite(self):
+        est = Krum(1)(_snaps(*self.honest, [np.nan, np.nan]))
+        assert np.isfinite(est["x"]).all()
+        np.testing.assert_allclose(est["x"], [1.0, 2.0], rtol=1e-5)
+
+    def test_krum_tiny_cohort_falls_back_to_median(self):
+        est = Krum(2)(_snaps([1.0, 2.0], [3.0, 4.0]))
+        np.testing.assert_allclose(est["x"], [2.0, 3.0])
+
+    def test_estimators_keep_dtype(self):
+        out = Median()(_snaps(*self.honest))
+        assert out["x"].dtype == np.float32
+
+    def test_make_estimator_specs(self):
+        assert make_estimator(None).name == "mean"
+        assert make_estimator("median").name == "median"
+        assert make_estimator("trimmed_mean:0.25").name == "trimmed_mean:0.25"
+        assert make_estimator("krum:2").f == 2
+        with pytest.raises(ValueError):
+            make_estimator("geometric_median")
+        with pytest.raises(ValueError):
+            make_estimator("median:0.5")
+
+    def test_aggregator_composition_and_names(self):
+        assert make_aggregator("fedavg").name == "fedavg"  # unchanged
+        agg = make_aggregator("fedadam", robust="median")
+        assert agg.name == "fedadam+median"
+        assert make_aggregator("median").name == "fedavg+median"
+        assert make_aggregator("krum:1").name == "fedavg+krum:1"
+        with pytest.raises(ValueError):
+            make_aggregator("median", robust="krum:1")
+        with pytest.raises(ValueError):
+            make_aggregator("blah")
+        # a bare robust spec has no server optimizer: reject its kwargs
+        # cleanly instead of a TypeError deep in FedAvg.__init__
+        with pytest.raises(ValueError, match="server-optimizer"):
+            make_aggregator("median", server_lr=0.5)
+
+    def test_robust_estimate_feeds_server_optimizer(self):
+        """A composed fedadam+median must move toward the MEDIAN, not the
+        attacker-dragged mean."""
+        current = {"x": np.zeros(2, np.float32)}
+        snaps = _snaps(*self.honest, [1000.0, 2000.0])
+        plain = FedAdam(server_lr=0.5).aggregate(snaps, current)
+        robust = FedAdam(server_lr=0.5, estimator="median").aggregate(
+            snaps, current
+        )
+        mean_est = weighted_mean(snaps)["x"]
+        # same update rule, different estimate: the robust pseudo-gradient
+        # is bounded by the honest cluster
+        assert np.all(np.abs(robust["x"]) < np.abs(mean_est))
+        assert plain is not robust
+
+    def test_fedavg_with_estimator_assigns_estimate(self):
+        snaps = _snaps(*self.honest, [100.0, 200.0])
+        out = FedAvg(estimator="trimmed_mean:0.25").aggregate(snaps)
+        np.testing.assert_allclose(out["x"], [1.05, 2.05], rtol=1e-5)
+
+
+# ---- update admission gate --------------------------------------------------
+
+def _gate(**kw):
+    kw.setdefault("metrics", MetricsLogger(validate=True))
+    gate = UpdateGate(**kw)
+    gate.set_template({"a": np.zeros((2,), np.float32),
+                       "b": np.zeros((3,), np.float32)})
+    return gate
+
+
+def _cand(client_id, a=(0.1, 0.1), b=(0.1, 0.1, 0.1), weight=1.0):
+    return (client_id, weight,
+            {"a": np.asarray(a, np.float32), "b": np.asarray(b, np.float32)})
+
+
+REF = {"a": np.zeros((2,), np.float32), "b": np.zeros((3,), np.float32)}
+
+
+class TestUpdateGate:
+    def test_conformance_rejections(self):
+        gate = _gate()
+        bad_keys = (1, 1.0, {"a": np.zeros(2, np.float32)})
+        bad_shape = (2, 1.0, {"a": np.zeros(5, np.float32),
+                              "b": np.zeros(3, np.float32)})
+        bad_dtype = (3, 1.0, {"a": np.zeros(2, np.float64),
+                              "b": np.zeros(3, np.float32)})
+        res = gate.admit_round(
+            [_cand(4), bad_keys, bad_shape, bad_dtype], REF, round_idx=0
+        )
+        assert [c for c, _w, _s in res.accepted] == [4]
+        reasons = {r.client_id: r.reason for r in res.rejected}
+        assert reasons == {1: "key_skew", 2: "shape_skew", 3: "dtype_skew"}
+        reg = gate.metrics.registry
+        assert reg.counter("updates_rejected").value == 3
+        # dashboard continuity with the PR 2 conformance counter
+        assert reg.counter("key_skew_excluded").value == 3
+        events = gate.metrics.events("update_rejected")
+        assert len(events) == 3 and all("reason" in e for e in events)
+
+    def test_nonfinite_rejected_with_detail(self):
+        gate = _gate()
+        nan = _cand(7, a=(np.nan, 0.0))
+        res = gate.admit_round([_cand(1), nan], REF, round_idx=3)
+        assert [r.client_id for r in res.rejected] == [7]
+        assert res.rejected[0].reason == "nonfinite"
+        assert "a" in res.rejected[0].detail
+
+    def test_nonfinite_passes_when_disabled(self):
+        gate = _gate(check_finite=False, mad_k=0.0)
+        res = gate.admit_round([_cand(1, a=(np.nan, 0.0))], REF, 0)
+        assert len(res.accepted) == 1 and not res.rejected
+
+    def test_norm_outlier_needs_cohort(self):
+        gate = _gate(mad_k=4.0)
+        huge = _cand(9, a=(1e4, 1e4), b=(1e4, 1e4, 1e4))
+        # cohort of 2: MAD is meaningless, nothing rejected
+        res = gate.admit_round([_cand(1), huge], REF, 0)
+        assert not res.rejected
+        # cohort of 4: the outlier goes
+        res = gate.admit_round(
+            [_cand(1), _cand(2), _cand(3), huge], REF, 1
+        )
+        assert [r.client_id for r in res.rejected] == [9]
+        assert res.rejected[0].reason == "norm_outlier"
+        assert res.rejected[0].norm > 1e4
+
+    def test_mad_zero_disables_outlier_screen(self):
+        gate = _gate(mad_k=0.0)
+        huge = _cand(9, a=(1e4, 1e4))
+        res = gate.admit_round(
+            [_cand(1), _cand(2), _cand(3), huge], REF, 0
+        )
+        assert not res.rejected
+
+    def test_hard_clip_bounds_influence(self):
+        gate = _gate(mad_k=0.0, max_update_norm=0.5)
+        big = _cand(5, a=(3.0, 4.0), b=(0.0, 0.0, 0.0))  # norm 5
+        res = gate.admit_round([big], REF, 0)
+        assert len(res.accepted) == 1 and not res.rejected
+        assert res.clipped == [(5, pytest.approx(5.0), 0.5)]
+        _cid, _w, snap = res.accepted[0]
+        assert update_norm(snap, REF) == pytest.approx(0.5, rel=1e-6)
+        # direction preserved
+        np.testing.assert_allclose(
+            snap["a"] / np.linalg.norm(snap["a"]), [0.6, 0.8], rtol=1e-5
+        )
+        assert gate.metrics.registry.counter("updates_clipped").value == 1
+        assert gate.metrics.events("update_clipped")[0]["client"] == 5
+
+    def test_consecutive_streak_resets_on_acceptance(self):
+        gate = _gate()
+        nan = _cand(7, a=(np.nan, 0.0))
+        gate.admit_round([nan], REF, 0)
+        gate.admit_round([nan], REF, 1)
+        assert gate.consecutive(7) == 2
+        assert gate.total_rejections[7] == 2
+        gate.admit_round([_cand(7)], REF, 2)
+        assert gate.consecutive(7) == 0
+        assert gate.total_rejections[7] == 2  # totals never reset
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            UpdateGate(max_update_norm=0.0)
+        with pytest.raises(ValueError):
+            UpdateGate(suspect_after=0)
+
+
+# ---- divergence guardian ----------------------------------------------------
+
+class TestGuardian:
+    def test_nonfinite_global_is_immediate(self):
+        g = DivergenceGuardian(patience=5)
+        avg = {"x": np.array([1.0, np.nan], np.float32)}
+        assert g.observe(0, [1.0], avg) == "nonfinite_global"
+        assert not g.healthy
+
+    def test_loss_explosion_respects_patience(self):
+        g = DivergenceGuardian(patience=2, loss_factor=4.0)
+        avg = {"x": np.ones(2, np.float32)}
+        for r in range(3):
+            assert g.observe(r, [100.0], avg) is None
+        assert g.healthy
+        assert g.observe(3, [1e5], avg, [(1, 1.0)]) is None  # streak 1
+        assert not g.healthy
+        assert g.observe(4, [1e5], avg, [(1, 1.0)]) == "loss_explosion"
+
+    def test_healthy_round_resets_streak(self):
+        g = DivergenceGuardian(patience=2, loss_factor=4.0)
+        avg = {"x": np.ones(2, np.float32)}
+        g.observe(0, [100.0], avg)
+        assert g.observe(1, [1e5], avg) is None
+        assert g.observe(2, [100.0], avg) is None  # recovered on its own
+        assert g.healthy
+        assert g.observe(3, [1e5], avg) is None  # streak restarts at 1
+
+    def test_bad_rounds_do_not_drag_the_baseline(self):
+        g = DivergenceGuardian(patience=3, loss_factor=4.0)
+        avg = {"x": np.ones(2, np.float32)}
+        g.observe(0, [100.0], avg)
+        g.observe(1, [1e5], avg)
+        g.observe(2, [1e5], avg)
+        # EWMA still anchored at ~100: the third bad round trips
+        assert g.observe(3, [1e5], avg) == "loss_explosion"
+
+    def test_norm_explosion(self):
+        g = DivergenceGuardian(patience=1, norm_factor=10.0)
+        small = {"x": np.ones(4, np.float32)}
+        assert g.observe(0, [1.0], small) is None
+        assert g.observe(1, [1.0], {"x": np.full(4, 1e3, np.float32)}) \
+            == "norm_explosion"
+
+    def test_dominant_contributors(self):
+        g = DivergenceGuardian(patience=2, loss_factor=4.0,
+                               dominance_factor=2.0)
+        avg = {"x": np.ones(2, np.float32)}
+        g.observe(0, [1.0], avg)
+        g.observe(1, [1e9], avg, [(1, 10.0), (2, 1.0), (3, 1.0)])
+        assert g.dominant_contributors() == [1]
+        g.note_rollback()
+        assert g.healthy and g.dominant_contributors() == []
+
+    def test_single_byzantine_loss_report_cannot_force_rollback(self):
+        """StepReply.loss is attacker-controlled: one admitted client
+        reporting NaN / 1e30 losses forever must never trip a divergence
+        (the round statistic is a median over finite reports)."""
+        g = DivergenceGuardian(patience=1, loss_factor=4.0)
+        avg = {"x": np.ones(2, np.float32)}
+        for r in range(6):
+            lie = np.nan if r % 2 else 1e30
+            assert g.observe(r, [100.0, 101.0, 99.0, lie], avg) is None
+            assert g.healthy
+        # ... but a cohort-wide non-finite report is a real signal
+        assert g.observe(9, [np.nan, np.nan, np.nan], avg) \
+            == "loss_explosion"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DivergenceGuardian(patience=0)
+        with pytest.raises(ValueError):
+            DivergenceGuardian(loss_factor=1.0)
+
+
+# ---- payload-corruption faults ----------------------------------------------
+
+def _bundle(values):
+    return codec.flatdict_to_bundle(
+        {"x": np.asarray(values, np.float32),
+         "n": np.array([3], np.int32)}
+    )
+
+
+class TestCorruptFaults:
+    def test_corrupt_bundle_modes(self):
+        b = _bundle([1.0, 2.0, 3.0])
+        corrupt_bundle(b, "nan")
+        out = codec.bundle_to_flatdict(b)
+        assert np.isnan(out["x"]).all()
+        assert out["n"].tolist() == [3]  # integer records untouched
+
+        b = _bundle([1.0, 2.0, 3.0])
+        corrupt_bundle(b, "scale:100")
+        np.testing.assert_allclose(
+            codec.bundle_to_flatdict(b)["x"], [100.0, 200.0, 300.0]
+        )
+
+        b = _bundle([1.0, 2.0, 3.0])
+        corrupt_bundle(b, "random", seed=7)
+        r1 = codec.bundle_to_flatdict(b)["x"]
+        b2 = _bundle([1.0, 2.0, 3.0])
+        corrupt_bundle(b2, "random", seed=7)
+        np.testing.assert_array_equal(r1, codec.bundle_to_flatdict(b2)["x"])
+        assert not np.allclose(r1, [1.0, 2.0, 3.0])
+
+    def test_invalid_corrupt_spec_rejected(self):
+        inj = FaultInjector(seed=0)
+        with pytest.raises(ValueError):
+            inj.script("TrainStep", kind="corrupt", payload="explode")
+        with pytest.raises(ValueError):
+            inj.script("TrainStep", kind="corrupt")
+
+    def test_after_call_corrupts_matching_reply_with_skip(self):
+        inj = FaultInjector(seed=0)
+        inj.script("TrainStep", kind="corrupt", payload="nan", times=1,
+                   peer="client1", skip=2)
+        for i in range(2):  # skip window: untouched
+            reply = pb.StepReply(client_id=1, shared=_bundle([1.0, 2.0]))
+            inj.after_call("svc", "TrainStep", reply, peer="client1")
+            assert np.isfinite(
+                codec.bundle_to_flatdict(reply.shared)["x"]
+            ).all()
+        assert inj.fired == []
+        # wrong peer / wrong direction: untouched
+        other = pb.StepReply(client_id=2, shared=_bundle([1.0, 2.0]))
+        inj.after_call("svc", "TrainStep", other, peer="client2")
+        inj.before_call("svc", "TrainStep", peer="client1")  # no raise
+        assert np.isfinite(codec.bundle_to_flatdict(other.shared)["x"]).all()
+        # armed now
+        reply = pb.StepReply(client_id=1, shared=_bundle([1.0, 2.0]))
+        inj.after_call("svc", "TrainStep", reply, peer="client1")
+        assert np.isnan(codec.bundle_to_flatdict(reply.shared)["x"]).all()
+        assert inj.fired == [("TrainStep", "client1", "corrupt")]
+        assert inj.pending() == 0
+
+    def test_corrupt_composes_with_wire_codec(self):
+        """Scaling the WIRE values of a delta+fp16 uplink must decode to a
+        correspondingly poisoned snapshot server-side."""
+        wc = WireCodec("delta+fp16")
+        enc = UplinkEncoder(wc)
+        dec = UplinkDecoder(wc)
+        ref = {"x": np.ones(4, np.float32)}
+        enc.note_aggregate(ref, 0)
+        dec.note_push(0, ref)
+        bundle = enc.encode({"x": ref["x"] + 0.25})
+        corrupt_bundle(bundle, "scale:100")
+        out = dec.decode(bundle)
+        np.testing.assert_allclose(out["x"], 1.0 + 25.0, rtol=1e-2)
+
+
+# ---- wire-codec session reset (rollback support) ----------------------------
+
+class TestCodecReset:
+    def test_downlink_encoder_reset_forces_self_contained(self):
+        m = MetricsLogger(validate=True)
+        enc = DownlinkEncoder(WireCodec("delta"), metrics=m)
+        avg = {"x": np.ones(3, np.float32)}
+        enc.encode(avg, round_idx=0)
+        bundle, _view = enc.encode(avg, round_idx=1, allow_delta=True)
+        assert bundle.ref_round == 1  # deltaed against round 0
+        enc.reset()
+        bundle, _view = enc.encode(avg, round_idx=2, allow_delta=True)
+        assert bundle.ref_round == 0  # self-contained despite allow_delta
+        assert m.registry.counter("codec_resets").value == 1
+
+    def test_uplink_decoder_reset_drops_reference_cache(self):
+        wc = WireCodec("delta")
+        enc = UplinkEncoder(wc)
+        dec = UplinkDecoder(wc)
+        ref = {"x": np.ones(3, np.float32)}
+        enc.note_aggregate(ref, 0)
+        dec.note_push(0, ref)
+        bundle = enc.encode({"x": ref["x"] + 1.0})
+        assert dec.decode(bundle)  # decodes fine with the cached ref
+        dec.reset()
+        with pytest.raises(ReferenceMismatch):
+            dec.decode(enc.encode({"x": ref["x"] + 2.0}))
+
+    def test_reset_clears_error_feedback_residual_and_ref(self):
+        enc = UplinkEncoder(WireCodec("delta+topk:0.5"))
+        enc.note_aggregate({"x": np.zeros(4, np.float32)}, 0)
+        enc.encode({"x": np.array([1.0, 0.1, 0.2, 3.0], np.float32)})
+        assert any(np.any(v) for v in enc.residual.values())
+        enc.reset()
+        assert enc.residual == {}
+        # the applied-aggregate reference is gone too: the next snapshot
+        # encodes self-contained, carrying no diverged-trajectory mass
+        bundle = enc.encode({"x": np.ones(4, np.float32)})
+        assert bundle.ref_round == 0
+
+    def test_reset_session_flag_resets_client_sessions(self):
+        """An Aggregate carrying reset_session must drop the client's
+        delta refs AND error-feedback residual before applying (the
+        divergence-rollback re-broadcast contract)."""
+        from gfedntm_tpu.federation.client import FederatedClientServicer
+        from gfedntm_tpu.federation.compression import DownlinkDecoder
+
+        class _Stepper:
+            current_mb = 1
+            current_epoch = 0
+            finished = False
+
+            def delta_update_fit(self, averaged):
+                import types
+                self.applied = averaged
+                return types.SimpleNamespace(
+                    epoch_ended=False, finished=False, current_epoch=0,
+                    epoch_loss=None,
+                )
+
+        wc = WireCodec("delta+topk:0.5")
+        uplink = UplinkEncoder(wc)
+        downlink = DownlinkDecoder(wc)
+        # seed session state as if rounds already ran
+        ref = {"x": np.ones(4, np.float32)}
+        uplink.note_aggregate(ref, 3)
+        downlink._ref, downlink._ref_round = dict(ref), 3
+        uplink.encode({"x": np.array([2.0, 1.0, 1.1, 1.2], np.float32)})
+        assert uplink.residual and uplink._ref is not None
+
+        import logging
+        servicer = FederatedClientServicer(
+            1, _Stepper(), on_stop=lambda: None,
+            logger=logging.getLogger("t"), uplink=uplink, downlink=downlink,
+        )
+        enc = DownlinkEncoder(wc)
+        bundle, _view = enc.encode({"x": np.full(4, 5.0, np.float32)},
+                                   round_idx=7)
+        servicer.ApplyAggregate(
+            pb.Aggregate(shared=bundle, round=7, reset_session=True), None
+        )
+        assert uplink.residual == {} or not any(
+            np.any(v) for v in uplink.residual.values()
+        )
+        # the uplink ref is the freshly applied push, not the old round-3
+        # state; the downlink ref was rebuilt from the reset too
+        assert uplink._ref_round == 7 and downlink._ref_round == 7
+        np.testing.assert_allclose(uplink._ref["x"], 5.0, rtol=1e-2)
+
+
+# ---- checkpoint integrity ---------------------------------------------------
+
+class TestCheckpointIntegrity:
+    def _saved(self, tmp_path):
+        ckpt = FederationCheckpointer(str(tmp_path))
+        ckpt.save_round(4, {"a": np.ones(2, np.float32)}, [], vocab=["x"])
+        return ckpt
+
+    def test_corrupt_sidecar_fails_actionably(self, tmp_path):
+        ckpt = self._saved(tmp_path)
+        with open(ckpt.meta_path, "w") as fh:
+            fh.write('{"round": 4, "average_keys": ["a"')  # truncated
+        with pytest.raises(CheckpointIntegrityError, match="truncated"):
+            ckpt.load_meta()
+        ckpt.close()
+
+    def test_missing_required_keys_fail(self, tmp_path):
+        ckpt = self._saved(tmp_path)
+        with open(ckpt.meta_path, "w") as fh:
+            json.dump({"vocab": ["x"]}, fh)
+        with pytest.raises(CheckpointIntegrityError, match="average_keys"):
+            ckpt.load_meta()
+        ckpt.close()
+
+    def test_round_mismatch_with_no_matching_round_fails(self, tmp_path):
+        ckpt = self._saved(tmp_path)
+        meta = ckpt.load_meta()
+        meta["round"] = 2  # a round that never existed on disk
+        with open(ckpt.meta_path, "w") as fh:
+            json.dump(meta, fh)
+        with pytest.raises(CheckpointIntegrityError, match="mismatch"):
+            ckpt.restore_round({"a": np.zeros(2, np.float32)})
+        ckpt.close()
+
+    def test_stale_sidecar_falls_back_to_its_own_round(self, tmp_path):
+        """The benign crash window — orbax wrote round 6, the crash landed
+        before the sidecar rewrite, so the sidecar still describes round
+        4: resume must come back from round 4 (whose halves agree), not
+        fail demanding manual surgery."""
+        ckpt = FederationCheckpointer(str(tmp_path))
+        ckpt.save_round(4, {"a": np.full(2, 4.0, np.float32)}, [],
+                        vocab=["x"])
+        stale = open(ckpt.meta_path).read()
+        ckpt.save_round(6, {"a": np.full(2, 6.0, np.float32)}, [],
+                        vocab=["x"])
+        with open(ckpt.meta_path, "w") as fh:
+            fh.write(stale)  # crash-between-writes simulation
+        step, restored = ckpt.restore_round({"a": np.zeros(2, np.float32)})
+        assert step == 4
+        np.testing.assert_allclose(restored["a"], 4.0)
+        ckpt.close()
+
+    def test_corrupt_aggregator_state_fails_actionably(self, tmp_path):
+        ckpt = self._saved(tmp_path)
+        with open(ckpt.aggregator_path, "wb") as fh:
+            fh.write(b"not an npz")
+        with pytest.raises(CheckpointIntegrityError, match="aggregator"):
+            ckpt.load_aggregator_state()
+        ckpt.close()
+
+    def test_server_resume_emits_checkpoint_invalid_event(self, tmp_path):
+        m = MetricsLogger(validate=True)
+        crashed = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            save_dir=str(tmp_path),
+        )
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        tokens = tuple(f"tok{i:02d}" for i in range(30))
+        crashed.global_vocab = Vocabulary(tokens)
+        crashed.template = build_template_model(
+            "avitm", len(tokens), MODEL_KWARGS
+        )
+        crashed.last_average = dict(crashed._shared_template())
+        crashed.global_iterations = 3
+        crashed._save_round_checkpoint()
+        meta_path = crashed._checkpointer().meta_path
+        with open(meta_path, "w") as fh:
+            fh.write("{broken")
+        resumed = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            save_dir=str(tmp_path), metrics=m,
+        )
+        with pytest.raises(CheckpointIntegrityError):
+            resumed.restore_from_checkpoint()
+        assert m.registry.counter("checkpoint_invalid").value == 1
+        assert m.events("checkpoint_invalid")[0]["reason"]
+
+
+# ---- registry probation reasons ---------------------------------------------
+
+def test_mark_suspect_records_reason_in_snapshot():
+    fed = Federation(min_clients=1)
+    fed.connect_vocab(1, ("a",), 2.0)
+    fed.connect_ready(1, "localhost:1")
+    assert fed.mark_suspect(1, "localhost:1", 0, reason="poisoned") \
+        == SUSPECT
+    snap = fed.membership_snapshot()[0]
+    assert snap["suspect_reason"] == "poisoned"
+    assert fed.mark_recovered(1)
+    assert fed.membership_snapshot()[0]["suspect_reason"] == ""
+    fed.mark_suspect(1, "localhost:1", 1, probation_rounds=1,
+                     reason="divergence")
+    snap = fed.membership_snapshot()[0]
+    assert snap["status"] == DROPPED and snap["suspect_reason"] == "divergence"
+
+
+# ---- server-level admission wiring ------------------------------------------
+
+class TestServerAdmission:
+    def _server(self, **kw):
+        base = dict(min_clients=1, family="avitm",
+                    model_kwargs=MODEL_KWARGS,
+                    metrics=MetricsLogger(validate=True))
+        base.update(kw)
+        server = FederatedServer(**base)
+        server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+        return server
+
+    def _reply(self, client_id, snap, loss=1.0):
+        return pb.StepReply(
+            client_id=client_id, shared=codec.flatdict_to_bundle(snap),
+            loss=loss, nr_samples=4.0,
+        )
+
+    def test_nan_reply_rejected_then_probation_then_drop(self):
+        from gfedntm_tpu.federation.registry import ClientRecord
+
+        server = self._server(probation_rounds=2)
+        server.federation.connect_vocab(1, ("a",), 4.0)
+        server.federation.connect_ready(1, "localhost:1")
+        rec = server.federation.get_clients()[0]
+        tmpl = server._shared_template()
+        poisoned = {
+            k: np.full_like(v, np.nan) if v.dtype.kind == "f" else v
+            for k, v in tmpl.items()
+        }
+        good_rec = ClientRecord(2, nr_samples=4.0)
+
+        out = server._collect_snapshots(
+            [(rec, self._reply(1, poisoned)),
+             (good_rec, self._reply(2, tmpl))], iteration=0,
+        )
+        assert len(out) == 1  # round 0: rejected, streak 1, still ACTIVE
+        assert rec.status == "active"
+        out = server._collect_snapshots(
+            [(rec, self._reply(1, poisoned)),
+             (good_rec, self._reply(2, tmpl))], iteration=1,
+        )
+        assert len(out) == 1  # round 1: streak 2 -> suspect("poisoned")
+        assert rec.status == SUSPECT and rec.suspect_reason == "poisoned"
+        out = server._collect_snapshots(
+            [(rec, self._reply(1, poisoned)),
+             (good_rec, self._reply(2, tmpl))], iteration=2,
+        )
+        assert rec.status == DROPPED  # probation_rounds=2 exhausted
+        m = server.metrics
+        assert m.registry.counter("updates_rejected").value == 3
+        suspects = m.events("client_suspect")
+        assert suspects and all(s["reason"] == "poisoned" for s in suspects)
+
+    def test_recovery_is_admission_scoped(self):
+        """A suspect whose RPC succeeds but whose update is rejected must
+        NOT recover; one whose update is admitted must."""
+        server = self._server()
+        server.federation.connect_vocab(1, ("a",), 4.0)
+        server.federation.connect_ready(1, "localhost:1")
+        rec = server.federation.get_clients()[0]
+        server.federation.mark_suspect(1, "localhost:1", 0, reason="poisoned")
+        tmpl = server._shared_template()
+        poisoned = {
+            k: np.full_like(v, np.nan) if v.dtype.kind == "f" else v
+            for k, v in tmpl.items()
+        }
+        server._collect_snapshots(
+            [(rec, self._reply(1, poisoned))], iteration=1,
+            was_suspect=frozenset({1}),
+        )
+        assert rec.status == SUSPECT  # polite poisoner stays on probation
+        server._collect_snapshots(
+            [(rec, self._reply(1, tmpl))], iteration=2,
+            was_suspect=frozenset({1}),
+        )
+        assert rec.status == "active"
+        m = server.metrics
+        assert m.registry.counter("client_recoveries").value == 1
+        assert m.events("client_recovered")[0]["round"] == 2
+
+    def test_status_exposes_data_plane(self):
+        server = self._server(max_update_norm=9.0)
+        status = server._status()
+        dp = status["data_plane"]
+        assert dp["sanitize"] is True
+        assert dp["max_update_norm"] == 9.0
+        assert dp["updates_rejected"] == 0
+        assert dp["guardian_healthy"] is True
+        off = self._server(sanitize=False, divergence_patience=0)
+        dp = off._status()["data_plane"]
+        assert dp["sanitize"] is False and dp["guardian_healthy"] is None
+
+
+# ---- CLI knobs --------------------------------------------------------------
+
+def test_parser_data_plane_flags():
+    p = build_parser()
+    args = p.parse_args([])
+    assert args.robust_aggregator is None
+    assert args.max_update_norm is None
+    assert args.outlier_mad_k == 4.0
+    assert args.divergence_patience == 3
+    args = p.parse_args([
+        "--robust_aggregator", "trimmed_mean:0.25",
+        "--max_update_norm", "50", "--outlier_mad_k", "0",
+        "--divergence_patience", "2",
+    ])
+    assert args.robust_aggregator == "trimmed_mean:0.25"
+    assert args.max_update_norm == 50.0
+    assert args.outlier_mad_k == 0.0 and args.divergence_patience == 2
+
+
+# ---- bf16 BoW count screen (ADVICE r5) --------------------------------------
+
+def test_bf16_bow_count_warning(caplog):
+    import logging
+
+    from gfedntm_tpu.train.steps import check_bf16_bow_counts
+
+    logger = logging.getLogger("bf16check")
+    with caplog.at_level(logging.WARNING):
+        assert not check_bf16_bow_counts(
+            np.full((4, 8), 256.0, np.float32), logger
+        )
+    assert not caplog.records
+    with caplog.at_level(logging.WARNING):
+        assert check_bf16_bow_counts(
+            np.full((4, 8), 257.0, np.float32), logger
+        )
+    assert any("quantized" in r.message for r in caplog.records)
+    assert not check_bf16_bow_counts(np.zeros((0, 8)), logger)
+
+
+def test_bf16_model_screens_corpus_once(caplog):
+    import logging
+
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.models.avitm import AVITM
+
+    model = AVITM(input_size=16, n_components=2, hidden_sizes=(4,),
+                  batch_size=4, num_epochs=1, compute_dtype="bfloat16")
+    X = np.zeros((4, 16), np.float32)
+    X[0, 0] = 300.0
+    ds = BowDataset(X=X, idx2token={i: f"t{i}" for i in range(16)})
+    with caplog.at_level(logging.WARNING):
+        model._device_data(ds)
+        model._device_data(ds)  # second call: already screened
+    warns = [r for r in caplog.records if "quantized" in r.message]
+    assert len(warns) == 1
+
+
+# ---- chaos: poisoned federations over real gRPC -----------------------------
+
+def _corpora(n_clients, docs, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(45)]
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+def _run_federation(tmp_path, corpora, tag, *, injector=None, metrics=None,
+                    poisoned_peer=None, payload=None, fault_times=64,
+                    fault_skip=0, **server_kw):
+    """Drive one in-process federation to completion; returns (server,
+    clients). ``poisoned_peer`` scripts a payload fault against that
+    client's TrainStep replies (all of them by default; ``fault_skip``
+    lets that many clean rounds pass first)."""
+    if injector is None and poisoned_peer is not None:
+        injector = FaultInjector(seed=0, metrics=metrics)
+    if poisoned_peer is not None:
+        injector.script("TrainStep", kind="corrupt", payload=payload,
+                        times=fault_times, peer=poisoned_peer,
+                        skip=fault_skip)
+    base = dict(
+        min_clients=len(corpora), family="avitm",
+        model_kwargs=MODEL_KWARGS, max_iters=40,
+        save_dir=str(tmp_path / f"{tag}-server"), metrics=metrics,
+        fault_injector=injector, checkpoint_every=0, round_backoff_s=0.05,
+    )
+    base.update(server_kw)
+    server = FederatedServer(**base)
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"{tag}-c{c + 1}"),
+               metrics=metrics)
+        for c, corpus in enumerate(corpora)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=600), f"{tag}: did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+    return server, clients
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("robust,payload,reason", [
+    ("trimmed_mean:0.25", "scale:100", "norm_outlier"),
+    ("median", "nan", "nonfinite"),
+    ("krum:1", "scale:100", "norm_outlier"),
+])
+def test_poisoned_client_rejected_robust_matches_honest_baseline(
+    tmp_path, robust, payload, reason,
+):
+    """ISSUE 5 acceptance: a 4-client federation where client 4 emits NaN /
+    100x-scaled updates finishes with a final global model matching the
+    3-honest-client baseline (same robust aggregator), and the poisoned
+    client lands in probation with reason="poisoned"."""
+    corpora = _corpora(4, docs=24, seed=5)
+    baseline_server, _ = _run_federation(
+        tmp_path, corpora[:3], f"base-{reason}",
+        robust_aggregator=robust, outlier_mad_k=6.0,
+    )
+    base_betas = baseline_server.global_betas
+    assert base_betas is not None and np.isfinite(base_betas).all()
+
+    metrics = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, corpora, f"poison-{reason}", metrics=metrics,
+        poisoned_peer="client4", payload=payload,
+        robust_aggregator=robust, outlier_mad_k=6.0,
+    )
+    assert server.global_betas is not None
+    np.testing.assert_allclose(
+        server.global_betas, base_betas, rtol=1e-4, atol=1e-5,
+    )
+    # the poisoned client's updates were rejected with the expected reason
+    rejections = metrics.events("update_rejected")
+    assert rejections and all(
+        e["client"] == 4 and e["reason"] == reason for e in rejections
+    )
+    assert metrics.registry.counter("updates_rejected").value >= 2
+    # ... and it landed in probation (reason "poisoned"), eventually the
+    # permanent drop — while the honest clients trained to completion
+    rec = {r.client_id: r for r in server.federation.get_clients()}[4]
+    assert rec.status in (SUSPECT, DROPPED)
+    assert rec.suspect_reason == "poisoned"
+    suspects = metrics.events("client_suspect")
+    assert suspects and all(s["reason"] == "poisoned" for s in suspects)
+    for c in clients[:3]:
+        assert c.stepper.finished
+    # visible in /status too
+    dp = server._status()["data_plane"]
+    assert dp["updates_rejected"] >= 2
+    assert dp["rejections_by_client"].get(4, 0) >= 2
+
+
+@pytest.mark.chaos
+def test_plain_fedavg_without_gate_degrades(tmp_path):
+    """The control leg: with the admission gate disabled and no robust
+    aggregator, one NaN-emitting client poisons the global model in one
+    round — the degradation the data plane exists to prevent."""
+    metrics = MetricsLogger(validate=True)
+    kwargs = dict(MODEL_KWARGS, num_epochs=1)
+    server, _clients = _run_federation(
+        tmp_path, _corpora(4, docs=16, seed=5), "degrade", metrics=metrics,
+        poisoned_peer="client4", payload="nan",
+        model_kwargs=kwargs, sanitize=False, divergence_patience=0,
+    )
+    assert server.global_betas is not None
+    assert not np.isfinite(server.global_betas).all()
+    assert metrics.registry.counter("updates_rejected").value == 0
+
+
+@pytest.mark.chaos
+def test_divergence_rollback_then_recovery(tmp_path):
+    """ISSUE 5 acceptance: a scripted one-shot NaN poisoning (gate off, so
+    it reaches the aggregate) triggers exactly ONE rollback to the last
+    good checkpointed round; the re-broadcast resets the delta-reference
+    cache (self-contained push, zero codec_ref_miss) and training resumes
+    to completion with a finite model."""
+    metrics = MetricsLogger(validate=True)
+    kwargs = dict(MODEL_KWARGS, num_epochs=3)  # 9 rounds of 3 steps each
+    server, clients = _run_federation(
+        tmp_path, _corpora(3, docs=24, seed=9), "rollback", metrics=metrics,
+        poisoned_peer="client1", payload="nan", fault_times=1,
+        fault_skip=4,  # rounds 0-3 clean -> checkpoints at 2 and 4
+        model_kwargs=kwargs, sanitize=False,
+        checkpoint_every=2, wire_codec="delta",
+    )
+    # exactly one rollback, to the last good checkpointed round (4), with
+    # the immediate non-finite verdict
+    rollbacks = metrics.events("divergence_rollback")
+    assert len(rollbacks) == 1
+    assert rollbacks[0]["reason"] == "nonfinite_global"
+    assert rollbacks[0]["round"] == 4
+    assert rollbacks[0]["restored_round"] == 4
+    assert metrics.registry.counter("divergence_rollbacks").value == 1
+    # the re-broadcast reset BOTH server-side codec sessions AND (via the
+    # push's reset_session flag) every recipient's uplink+downlink pair
+    # (3 clients x 2), and nothing ever mis-decoded against the
+    # rolled-back state
+    assert metrics.registry.counter("codec_resets").value == 2 + 3 * 2
+    assert metrics.registry.counter("codec_ref_miss").value == 0
+    # training resumed past the rollback to completion, model finite
+    assert server.global_iterations == 9
+    assert server.global_betas is not None
+    assert np.isfinite(server.global_betas).all()
+    for c in clients:
+        assert c.stepper.finished and c.results is not None
+    # the periodic checkpoints continued after recovery
+    assert server._checkpointer().latest_round() > 4
