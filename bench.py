@@ -27,6 +27,25 @@ being SIGKILLed mid-phase (rc=124, BENCH_r05).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
 phase timings (compile vs steady-state) and per-step wall-clock as extra
 keys.
+
+MFU / throughput accounting (ISSUE 6 satellite): ``vs_baseline`` always
+compares against the measured torch AVITM **on this host's CPU** — so its
+meaning flips with ``"backend"``: on an accelerator backend (``"tpu"`` /
+``"axon"``) a vs_baseline of 300x is accelerator-vs-CPU and MFU
+(``mfu_vs_bf16_peak``, normalized to the v5e bf16 MXU peak) is the honest
+utilization number; on ``"backend": "cpu"`` the run is the *fallback* —
+vs_baseline ~1x means "our CPU path ties torch's CPU path" and the MFU
+field is meaningless-by-construction (~1e-4: a CPU program measured
+against a TPU peak), NOT an accelerator regression. Every emitted record
+therefore names its backend, and any abandoned accelerator attempt is
+recorded in ``accel_timeout_phase`` + ``accel_attempts`` (per-attempt
+sub-deadline, reason, stderr tail) so a CPU number can never silently
+pose as the chip's. ``run_phase_timings`` breaks the run phase down by
+wall-clock (corpus synth, compile fit, steady fit, trace fit, torch
+baseline, staging) — the diagnosis surface for the BENCH_r03-r05 run-
+phase timeouts; set ``BENCH_PROFILE_DIR`` to additionally wrap a phase
+window in the PR 4 ``RoundProfiler`` (``BENCH_PROFILE_ROUNDS``, default
+``1:2`` = the compile fit, phase indices in ``_BENCH_PHASES``).
 """
 
 from __future__ import annotations
@@ -105,6 +124,18 @@ def _probe_backend() -> str:
     return "cpu"
 
 
+# Phase indices for the run-phase RoundProfiler window (BENCH_PROFILE_DIR /
+# BENCH_PROFILE_ROUNDS): the profiler treats each bench phase as one
+# "round", so e.g. "2:3" captures a jax.profiler trace of the steady fit.
+_BENCH_PHASES = (
+    "synthetic_corpus",        # 0
+    "compile_and_first_run",   # 1
+    "steady_state_fit",        # 2
+    "trace_fit",               # 3
+    "torch_baseline",          # 4
+)
+
+
 def run(backend: str) -> dict:
     import jax
 
@@ -122,6 +153,7 @@ def run(backend: str) -> dict:
     from gfedntm_tpu.utils.observability import (
         DeviceMemoryMonitor,
         MetricsLogger,
+        RoundProfiler,
         phase_timer,
         trace,
         validate_record,
@@ -150,6 +182,18 @@ def run(backend: str) -> dict:
         keep_records=True,
     )
 
+    # PR 4 device-profiling hooks, aimed at the run phase itself: the
+    # r03-r05 trajectory silently degraded to CPU because this phase hung
+    # on the accelerator with no per-phase evidence. With BENCH_PROFILE_DIR
+    # set, a jax.profiler window wraps the _BENCH_PHASES window named by
+    # BENCH_PROFILE_ROUNDS (default "1:2": the compile fit).
+    profiler = RoundProfiler(
+        os.environ.get("BENCH_PROFILE_DIR") or None,
+        rounds=os.environ.get("BENCH_PROFILE_ROUNDS", "1:2"),
+        metrics=metrics,
+    )
+
+    profiler.observe(_BENCH_PHASES.index("synthetic_corpus"))
     with phase_timer(metrics, "synthetic_corpus"):
         corpus = generate_synthetic_corpus(
             vocab_size=vocab, n_topics=k, n_docs=docs_per_node,
@@ -175,6 +219,7 @@ def run(backend: str) -> dict:
     # sampled after the compile fit (peak includes compile scratch) and
     # after the steady fit, landing in the same registry snapshot.
     devmem = DeviceMemoryMonitor(metrics.registry)
+    profiler.observe(_BENCH_PHASES.index("compile_and_first_run"))
     t0 = time.perf_counter()
     with phase_timer(metrics, "compile_and_first_run"):
         warm = trainer.fit(datasets, metrics=metrics)
@@ -194,6 +239,7 @@ def run(backend: str) -> dict:
     # np.asarray/tree_map), so the trace is captured on a separate,
     # untimed fit below.
     n_before = len(metrics.events("phase"))
+    profiler.observe(_BENCH_PHASES.index("steady_state_fit"))
     t0 = time.perf_counter()
     with phase_timer(metrics, "steady_state_fit"):
         result = trainer.fit(datasets, metrics=metrics)
@@ -220,6 +266,7 @@ def run(backend: str) -> dict:
     )
     traced_fit_s = None
     if trace_dir is not None:
+        profiler.observe(_BENCH_PHASES.index("trace_fit"))
         t0 = time.perf_counter()
         try:
             # metrics=None: profiler overhead inflates segment times ~5x,
@@ -259,6 +306,7 @@ def run(backend: str) -> dict:
     # its compute-only best case). Falls back to the committed artifact
     # if the live run is unavailable.
     torch_docs_per_sec, torch_src = None, None
+    profiler.observe(_BENCH_PHASES.index("torch_baseline"))
     try:
         sys.path.insert(0, os.path.join(_REPO_ROOT, "experiments_scripts"))
         from torch_baseline import run_torch_baseline
@@ -352,6 +400,20 @@ def run(backend: str) -> dict:
             "docs_per_node": docs_per_node, "epochs": epochs,
         },
     }
+    profiler.close()
+    # Per-phase wall-clock of THIS run phase (the r03-r05 timeout
+    # diagnosis surface): every phase_timer event aggregated by name,
+    # plus the untimed trace fit. When an accelerator attempt times out,
+    # the partial JSONL at BENCH_METRICS_PATH still holds whatever phases
+    # completed — the hang is bracketed by the first missing phase.
+    timings: dict[str, float] = {}
+    for r in metrics.events("phase"):
+        timings[r["phase"]] = round(
+            timings.get(r["phase"], 0.0) + r["seconds"], 3
+        )
+    if traced_fit_s is not None:
+        timings["trace_fit"] = traced_fit_s
+    result["run_phase_timings"] = timings
     # The full bench record goes into the telemetry stream too, schema-
     # linted so the documented event contract can't silently drift.
     validate_record(metrics.log("bench_result", **result))
@@ -659,7 +721,8 @@ def _phase_main(phase: str, backend: str) -> None:
 
 
 def _run_phase(
-    phase: str, backend: str, timeout_s: float, retries: int = 1
+    phase: str, backend: str, timeout_s: float, retries: int = 1,
+    failures: "list[dict] | None" = None,
 ):
     """Run a bench phase in a SUBPROCESS with a hard timeout.
 
@@ -669,7 +732,20 @@ def _run_phase(
     timeout + retry on a FRESH tunnel connection instead of the whole
     bench, and the orchestrator below stays stdlib-only so it cannot hang.
     Returns the parsed JSON or None.
+
+    ``failures`` (if given) collects one machine-readable record per
+    failed attempt — phase, backend, the sub-deadline it ran under, a
+    reason code (``timeout`` / ``rc`` / ``bad_json``) and a stderr tail —
+    so an abandoned accelerator attempt leaves evidence in the final
+    JSON (``accel_attempts``) instead of silently shipping CPU numbers.
     """
+
+    def _note(reason: str, **extra) -> None:
+        if failures is not None:
+            failures.append(dict(
+                phase=phase, backend=backend,
+                timeout_s=round(timeout_s, 1), reason=reason, **extra,
+            ))
     cmd = [
         sys.executable, os.path.abspath(__file__), "--phase", phase,
         "--backend", backend,
@@ -696,6 +772,7 @@ def _run_phase(
                 f"bench: phase {phase!r} timed out after {timeout_s:.0f}s "
                 f"(attempt {attempt + 1})\n"
             )
+            _note("timeout", attempt=attempt + 1)
             continue
         if proc.returncode == 0 and proc.stdout.strip():
             try:
@@ -704,11 +781,16 @@ def _run_phase(
                 sys.stderr.write(
                     f"bench: phase {phase!r} bad JSON ({err})\n"
                 )
+                _note("bad_json", attempt=attempt + 1, error=str(err))
         else:
             sys.stderr.write(
                 f"bench: phase {phase!r} rc={proc.returncode} "
                 f"(attempt {attempt + 1}); stderr tail: "
                 f"{proc.stderr[-500:]}\n"
+            )
+            _note(
+                "rc", attempt=attempt + 1, rc=proc.returncode,
+                stderr_tail=proc.stderr[-300:],
             )
     return None
 
@@ -798,7 +880,14 @@ def main() -> None:
     base_timeout = float(os.environ.get("BENCH_PHASE_TIMEOUT_S", "720"))
     cpu_reserve = 240.0 if backend != "cpu" else 0.0
     main_timeout = min(base_timeout, max(60.0, _remaining_s(cpu_reserve)))
-    summary = _run_phase("run", backend, timeout_s=main_timeout, retries=0)
+    # Per-attempt sub-deadline bookkeeping: every abandoned accelerator
+    # attempt is recorded and surfaced on whatever summary ships, so a
+    # degraded run is self-describing (no more silent CPU numbers).
+    accel_failures: "list[dict]" = []
+    summary = _run_phase(
+        "run", backend, timeout_s=main_timeout, retries=0,
+        failures=accel_failures if backend != "cpu" else None,
+    )
     if summary is None and backend != "cpu":
         # Escalate only when the budget still holds a 2x attempt PLUS the
         # CPU-fallback reserve; otherwise go straight to the fallback.
@@ -809,7 +898,8 @@ def main() -> None:
                 f"({retry_timeout:.0f}s)\n"
             )
             summary = _run_phase(
-                "run", backend, timeout_s=retry_timeout, retries=0
+                "run", backend, timeout_s=retry_timeout, retries=0,
+                failures=accel_failures,
             )
     if summary is not None:
         summary["provenance"] = "live"
@@ -825,6 +915,8 @@ def main() -> None:
                 "bench: live TPU unreachable; emitting banked TPU artifact "
                 "with provenance=cached\n"
             )
+            summary["accel_timeout_phase"] = "run"
+            summary["accel_attempts"] = accel_failures
             print(json.dumps(summary))
             return
         sys.stderr.write("bench: degrading main phase to CPU\n")
@@ -835,6 +927,11 @@ def main() -> None:
         )
         if summary is not None:
             summary["provenance"] = "live-cpu-degraded"
+            # The accelerator attempt(s) that forced this fallback, with
+            # their sub-deadlines and reasons: the headline below is a
+            # CPU number BECAUSE of these.
+            summary["accel_timeout_phase"] = "run"
+            summary["accel_attempts"] = accel_failures
             # No banked live-TPU bench exists to serve as the cached
             # fallback; point the record at the strongest COMMITTED TPU
             # evidence so a degraded capture is self-describing instead
@@ -871,6 +968,9 @@ def main() -> None:
             "backend": backend,
             "error": "all bench phase attempts failed or hung (TPU tunnel)",
         }
+        if accel_failures:
+            summary["accel_timeout_phase"] = "run"
+            summary["accel_attempts"] = accel_failures
 
     if "error" not in summary:
         # The fused soak is a bonus artifact — it only runs when the main

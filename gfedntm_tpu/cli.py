@@ -125,6 +125,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(coordinate-wise), or 'krum:<f>' (multi-Krum "
                         "tolerating f byzantine clients); composes with "
                         "any --aggregator (default: plain weighted mean)")
+    p.add_argument("--agg_backend", default="auto",
+                   choices=("auto", "device", "numpy"),
+                   help="server mode: aggregation data-plane backend — "
+                        "'device' stacks each round's client snapshots "
+                        "into one sharded device array and runs the "
+                        "admission gate statistics + robust mean stage "
+                        "as XLA programs; 'numpy' is the host reference "
+                        "path; 'auto' picks device exactly when an "
+                        "accelerator backend is present (README "
+                        "\"Device-resident aggregation\")")
     p.add_argument("--max_update_norm", type=float, default=None,
                    help="server mode: hard L2 cap on each admitted client "
                         "update's distance from the current global model — "
@@ -288,6 +298,7 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         aggregator=getattr(args, "aggregator", "fedavg"),
         aggregator_kwargs=aggregator_kwargs,
         robust_aggregator=getattr(args, "robust_aggregator", None),
+        aggregation_backend=getattr(args, "agg_backend", "auto"),
         max_update_norm=getattr(args, "max_update_norm", None),
         outlier_mad_k=getattr(args, "outlier_mad_k", 4.0),
         divergence_patience=getattr(args, "divergence_patience", 3),

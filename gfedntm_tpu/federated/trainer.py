@@ -41,8 +41,12 @@ from gfedntm_tpu.config import SHARE_ALL
 from gfedntm_tpu.data.datasets import BowDataset, make_run_schedule
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.models.params import build_share_mask
-from gfedntm_tpu.parallel.mesh import make_client_mesh, stack_and_pad
-from gfedntm_tpu.train.steps import grad_step
+from gfedntm_tpu.parallel.mesh import (
+    make_client_mesh,
+    shard_map_compat,
+    stack_and_pad,
+)
+from gfedntm_tpu.train.steps import donation_argnums, grad_step
 
 
 @dataclass
@@ -179,9 +183,9 @@ def build_federated_program(
 
     state_spec = P(axes)
     run = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_body,
-            mesh=mesh,
+            mesh,
             in_specs=(
                 state_spec,  # params (tree: spec broadcast to leaves)
                 state_spec,  # batch_stats
@@ -197,8 +201,15 @@ def build_federated_program(
                 P(),  # rng
             ),
             out_specs=(state_spec, state_spec, state_spec, P(None, axes)),
-            check_vma=False,
-        )
+            check=False,
+        ),
+        # Donate the carried per-client state (params + batch_stats + full
+        # Adam state, C_pad-stacked — the largest resident tree): segments
+        # flow state linearly, so XLA reuses the input HBM for the outputs
+        # instead of double-buffering. Accelerator-only (see
+        # donation_argnums); fit() protects its cached initial state with
+        # a copy when donation is live.
+        donate_argnums=donation_argnums((0, 1, 2)),
     )
     return run
 
@@ -356,7 +367,11 @@ class FederatedTrainer:
         segments) with the absolute step count and the per-client stacked
         variable trees. Used by quality-vs-wall-clock experiments to
         snapshot betas without touching the timed device program; keep it
-        cheap — its cost sits between segments.
+        cheap — its cost sits between segments. On accelerators the
+        program DONATES this state into the next segment: materialize
+        anything you keep (``np.asarray``) inside the callback — a
+        retained device reference is deleted once the next segment
+        dispatches.
         """
         t = self.template
         C, B = self.n_clients, t.batch_size
@@ -398,8 +413,9 @@ class FederatedTrainer:
         # Device-resident and cached across fits: re-uploading the
         # C_pad-broadcast params + full Adam state every fit costs real
         # wall time through the TPU tunnel (it was a visible slice of the
-        # round-4 steady-fit host overhead), and the jitted program does
-        # not donate its inputs, so the cached arrays stay valid.
+        # round-4 steady-fit host overhead). On accelerators the program
+        # now DONATES its state inputs, so the cache is protected below
+        # by feeding the first segment a device-side copy.
         # Strong references to the source trees, compared with `is` (same
         # hazard as _stage_data's cache: a bare id() key could be
         # recycled by a NEW tree after the old one is freed, silently
@@ -417,6 +433,14 @@ class FederatedTrainer:
                 ),
             ))
         params, batch_stats, opt_state = self._init_state[1]
+        if donation_argnums((0, 1, 2)):
+            # The program donates its state inputs on accelerators: hand
+            # the first segment a copy so the cached initial state
+            # survives for the next fit (a [state]-sized device copy,
+            # ~free next to the corpus staging).
+            params, batch_stats, opt_state = jax.tree.map(
+                jnp.copy, (params, batch_stats, opt_state)
+            )
 
         total_weight = float(n_samples.sum())
         rng = jax.random.PRNGKey(self.seed + 17)

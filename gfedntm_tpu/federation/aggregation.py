@@ -32,6 +32,17 @@ far (the heavy-tailed-noise sensitivity the FALD line formalizes,
 arXiv:2112.05120). The estimator composes with the server-optimizer
 aggregators: FedAvgM/FedAdam/FedYogi treat ``estimate - current_global``
 as the pseudo-gradient exactly as before, just from a robust estimate.
+
+Backend seam (PR 6, README "Device-resident aggregation"): every
+estimator accepts either the classic ``[(weight, snapshot), ...]`` list
+(the numpy reference path implemented in ``_estimate``) or a
+:class:`~gfedntm_tpu.federation.device_agg.StackedRound` — the round's
+cohort stacked into one sharded device array — in which case the mean
+stage runs as jitted XLA programs over the flattened-parameter plane
+(``device_agg.estimate``). The numpy implementations stay authoritative:
+the device path must match them (weighted mean bitwise in f32, the
+robust estimators to 1e-6), so chaos guarantees proven on the numpy
+oracle carry over.
 """
 
 from __future__ import annotations
@@ -54,6 +65,7 @@ __all__ = [
     "TrimmedMean",
     "Median",
     "Krum",
+    "krum_select",
     "make_estimator",
 ]
 
@@ -73,11 +85,23 @@ def weighted_mean(snapshots) -> dict[str, np.ndarray]:
 
 class RobustEstimator:
     """The mean stage of an aggregate step: ``(weight, flat-snapshot)``
-    pairs → one flat estimate. Stateless and deterministic."""
+    pairs → one flat estimate. Stateless and deterministic.
+
+    ``__call__`` dispatches on the cohort representation: a plain list
+    runs the numpy reference implementation (``_estimate``); a
+    ``device_agg.StackedRound`` runs the device-resident XLA programs,
+    which are parity-tested against the numpy oracle."""
 
     name = "mean"
 
     def __call__(self, snapshots) -> dict[str, np.ndarray]:
+        if not isinstance(snapshots, (list, tuple)):
+            from gfedntm_tpu.federation import device_agg
+
+            return device_agg.estimate(self, snapshots)
+        return self._estimate(snapshots)
+
+    def _estimate(self, snapshots) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
 
@@ -85,17 +109,35 @@ class WeightedMean(RobustEstimator):
     """The default (non-robust) estimator: the reference's sample-weighted
     mean, bit-for-bit (see :func:`weighted_mean`)."""
 
-    def __call__(self, snapshots):
+    def _estimate(self, snapshots):
         return weighted_mean(snapshots)
 
 
 def _stacked(snapshots) -> "tuple[list[str], dict[str, np.ndarray]]":
-    """Per-key ``[n_clients, ...]`` float32 stacks of the snapshots."""
+    """Per-key ``[n_clients, ...]`` float32 stacks of the snapshots.
+
+    The stack buffer is allocated once per key and rows are cast *into*
+    it — already-f32 snapshots copy exactly once (the stack itself), and
+    non-f32 ones cast in place instead of materializing a per-tensor
+    ``asarray`` temporary before ``np.stack`` copies it again."""
     keys = sorted(snapshots[0][1])
-    return keys, {
-        k: np.stack([np.asarray(s[k], np.float32) for _w, s in snapshots])
-        for k in keys
-    }
+    n = len(snapshots)
+    stacks: dict[str, np.ndarray] = {}
+    for k in keys:
+        first = np.asarray(snapshots[0][1][k])
+        out = np.empty((n,) + first.shape, np.float32)
+        for i, (_w, s) in enumerate(snapshots):
+            arr = np.asarray(s[k])
+            if arr.shape != first.shape:
+                # np.stack used to raise here; the in-place fill would
+                # silently BROADCAST a skewed row instead.
+                raise ValueError(
+                    f"snapshot {i} tensor {k!r} has shape {arr.shape}, "
+                    f"expected {first.shape}"
+                )
+            out[i] = arr
+        stacks[k] = out
+    return keys, stacks
 
 
 def _cast_like(est: dict[str, np.ndarray], snapshots) -> dict[str, np.ndarray]:
@@ -122,7 +164,7 @@ class TrimmedMean(RobustEstimator):
         self.frac = float(frac)
         self.name = f"trimmed_mean:{self.frac:g}"
 
-    def __call__(self, snapshots):
+    def _estimate(self, snapshots):
         n = len(snapshots)
         # frac < 0.5 guarantees 2t < n: at least one value survives the
         # trim for every cohort size.
@@ -130,7 +172,14 @@ class TrimmedMean(RobustEstimator):
         keys, stacks = _stacked(snapshots)
         est = {}
         for k in keys:
-            s = np.sort(stacks[k], axis=0)
+            if t == 0:
+                est[k] = stacks[k].mean(axis=0)
+                continue
+            # Partial selection instead of a full sort: pinning ranks
+            # t-1 and n-t puts the t smallest values below index t and
+            # the t largest at/after index n-t, which is all the trim
+            # needs — O(N) per coordinate instead of O(N log N).
+            s = np.partition(stacks[k], (t - 1, n - t), axis=0)
             est[k] = s[t:n - t].mean(axis=0)
         return _cast_like(est, snapshots)
 
@@ -142,11 +191,27 @@ class Median(RobustEstimator):
 
     name = "median"
 
-    def __call__(self, snapshots):
+    def _estimate(self, snapshots):
         keys, stacks = _stacked(snapshots)
         return _cast_like(
             {k: np.median(stacks[k], axis=0) for k in keys}, snapshots
         )
+
+
+def krum_select(d2: np.ndarray, n: int, f: int) -> np.ndarray:
+    """Multi-Krum selection from a pairwise squared-distance matrix: score
+    each client by its summed distance to its ``n - f - 2`` nearest peers,
+    keep the ``n - f`` best (stable order). Shared verbatim by the numpy
+    and device backends so neighbor selection cannot drift between them.
+    Non-finite distances (NaN updates, overflow against one) become +inf:
+    never selected, never poisoning an honest score."""
+    d2 = np.where(np.isfinite(d2), np.maximum(d2, 0.0), np.inf)
+    np.fill_diagonal(d2, np.inf)
+    k_near = max(1, n - f - 2)
+    neighbor_d2 = np.sort(d2, axis=1)[:, :k_near]
+    scores = neighbor_d2.sum(axis=1)
+    m = max(1, n - f)
+    return np.argsort(scores, kind="stable")[:m]
 
 
 class Krum(RobustEstimator):
@@ -165,7 +230,7 @@ class Krum(RobustEstimator):
         self.f = int(f)
         self.name = f"krum:{self.f}"
 
-    def __call__(self, snapshots):
+    def _estimate(self, snapshots):
         n = len(snapshots)
         if n - self.f < 2:
             # Too small a cohort to score against itself — fall back to the
@@ -181,18 +246,11 @@ class Krum(RobustEstimator):
         # Pairwise squared distances via the gram identity
         # ‖a−b‖² = ‖a‖² + ‖b‖² − 2a·b — O(n² + nD) memory, where the
         # broadcasted difference cube would be O(n²D) (gigabytes at fleet
-        # scale). Anything non-finite (a NaN update, or an overflow
-        # against one) becomes +inf so it can neither be selected nor
-        # poison an honest client's score.
+        # scale). Selection semantics (incl. the non-finite → +inf guard)
+        # live in :func:`krum_select`, shared with the device backend.
         sq = np.einsum("ij,ij->i", flat, flat)
         d2 = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
-        d2 = np.where(np.isfinite(d2), np.maximum(d2, 0.0), np.inf)
-        np.fill_diagonal(d2, np.inf)
-        k_near = max(1, n - self.f - 2)
-        neighbor_d2 = np.sort(d2, axis=1)[:, :k_near]
-        scores = neighbor_d2.sum(axis=1)
-        m = max(1, n - self.f)
-        chosen = np.argsort(scores, kind="stable")[:m]
+        chosen = krum_select(d2, n, self.f)
         return weighted_mean([snapshots[i] for i in chosen])
 
 

@@ -1,0 +1,415 @@
+"""Device-resident aggregation data plane (ROADMAP open item 2).
+
+The paper's server averages every client's full parameter set at every
+minibatch step, so aggregation sits on the critical path of every round —
+yet until this module it ran as host numpy loops over every tensor
+(``aggregation.py``: ``np.stack``/``np.sort``/``np.median`` per key, Krum
+over a host ``[N, D]`` matrix) while the accelerator idled. The
+communication-perspective FL survey (PAPERS.md, arXiv 2405.20431) names
+server-side aggregation compute a first-order scaling term once wire
+compression removes bandwidth as the bottleneck; the FedAvg-as-EM view
+(arXiv 2111.10192) justifies keeping the weighted-mean semantics intact
+while changing *where* it executes.
+
+Here the round's client snapshots are stacked into ONE device array —
+one flatten + concat per snapshot per round, not one host op per tensor —
+and the entire data plane runs as jitted XLA programs sharded over the
+flattened-parameter axis of a 1-D device mesh
+(:func:`gfedntm_tpu.parallel.mesh.make_param_mesh` +
+:func:`gfedntm_tpu.parallel.sharded.shard_param_plane`):
+
+- the :class:`~gfedntm_tpu.federation.sanitize.UpdateGate`'s finiteness
+  check and per-client L2 update norms (one fused pass over the stack);
+- the norm clip (one pass, per-row factors);
+- the robust mean stage — weighted mean / trimmed mean / coordinate
+  median / Krum via the gram identity — on the stacked plane.
+
+Only [N]-sized partials ([N, n_shards] two-level reductions, [N, N] gram
+blocks) ever cross devices or reach the host, so robust-aggregation cost
+stays flat as N grows: per-coordinate work is data-parallel over the
+plane and the host does O(N) bookkeeping, not O(N · D) arithmetic.
+
+**Parity contract** (enforced by ``tests/test_device_agg.py``): the numpy
+implementations in ``aggregation.py``/``sanitize.py`` remain the reference
+oracle. The device weighted mean reproduces the numpy expression
+*bitwise* in float32 — same per-client multiply, same left-to-right
+accumulation order (eager per-op dispatch on the sharded plane: inside
+one jitted program XLA would contract the multiply-add chain into FMAs),
+same float32 division by the round weight. Trimmed mean, median, Krum
+scores and the gate statistics (norms, median+MAD mask, clip) match to
+1e-6; all admission *decisions* are identical. Non-float32 tensors (the
+template's ``num_batches_tracked`` int scalars) keep their numpy-path
+semantics exactly: they ride the f32 plane for distances/norms (as the
+numpy ``_stacked``/Krum flatten always did) but their final estimates are
+computed by the original numpy expressions.
+
+The backend seam: ``FederatedServer(aggregation_backend="auto")`` picks
+``"device"`` when an accelerator backend is present and ``"numpy"``
+otherwise, so CPU tier-1 behavior is unchanged; tests exercise the device
+path explicitly on the 8-virtual-device CPU mesh (parity is the contract,
+the ``shard_map`` mesh path is still the code that runs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "FlatPlane",
+    "StackedRound",
+    "DeviceAggEngine",
+    "stack_round",
+    "estimate",
+]
+
+
+class FlatPlane:
+    """Key layout of the flattened float32 parameter plane.
+
+    Keys are sorted (the exact order ``aggregation._stacked`` and Krum's
+    flatten use), each tensor raveled C-order into one contiguous
+    ``[D]`` float32 vector. Non-float32 tensors are cast into the plane
+    (for norms/distances — mirroring ``sanitize.update_norm`` and the
+    numpy estimators' f32 stacks) and remembered in ``non_f32_keys`` so
+    estimate reconstruction can delegate them back to numpy semantics.
+    """
+
+    def __init__(self, template: Mapping[str, Any]):
+        self.keys: list[str] = sorted(template)
+        self.shapes: dict[str, tuple] = {}
+        self.dtypes: dict[str, np.dtype] = {}
+        self.offsets: dict[str, tuple[int, int]] = {}
+        off = 0
+        for k in self.keys:
+            arr = np.asarray(template[k])
+            self.shapes[k] = tuple(arr.shape)
+            self.dtypes[k] = arr.dtype
+            self.offsets[k] = (off, int(arr.size))
+            off += int(arr.size)
+        self.dim = off
+        self.non_f32_keys: list[str] = [
+            k for k in self.keys if self.dtypes[k] != np.float32
+        ]
+
+    def flatten(self, snap: Mapping[str, Any], out: np.ndarray | None = None
+                ) -> np.ndarray:
+        """One pass: fill a ``[D]`` f32 vector (casting in place — no
+        per-tensor cast temporaries)."""
+        if out is None:
+            out = np.empty(self.dim, np.float32)
+        for k in self.keys:
+            off, size = self.offsets[k]
+            out[off:off + size] = np.asarray(snap[k]).reshape(-1)
+        return out
+
+    def unflatten(self, vec: np.ndarray, cast: bool = True
+                  ) -> dict[str, np.ndarray]:
+        """``[>=D]`` f32 vector back to the keyed dict; ``cast`` restores
+        each tensor's template dtype (the numpy estimators' ``_cast_like``
+        semantics — float32 keys stay zero-copy views)."""
+        est: dict[str, np.ndarray] = {}
+        for k in self.keys:
+            off, size = self.offsets[k]
+            arr = vec[off:off + size].reshape(self.shapes[k])
+            if cast and arr.dtype != self.dtypes[k]:
+                arr = arr.astype(self.dtypes[k])
+            est[k] = arr
+        return est
+
+
+class StackedRound:
+    """One round's admitted cohort, stacked and device-resident.
+
+    ``mat`` is the ``[N, D_pad]`` float32 plane (rows in admission order,
+    D padded with zeros to the mesh size); ``weights`` keeps the original
+    Python-float sample weights (their f64 sum is the FedAvg denominator,
+    exactly as the numpy path computes it); ``snapshots`` keeps the
+    decoded host dicts — no copy, they exist anyway — for the non-f32
+    remainder and as the wholesale numpy fallback.
+    """
+
+    def __init__(self, engine: "DeviceAggEngine", plane: FlatPlane,
+                 weights: list[float], mat, snapshots: list):
+        self.engine = engine
+        self.plane = plane
+        self.weights = list(weights)
+        self.mat = mat
+        #: bare snapshot dicts, row-aligned with ``mat`` and ``weights``.
+        self.snapshots = list(snapshots)
+
+    @property
+    def pairs(self) -> list:
+        """``[(weight, snapshot)]`` view — the numpy estimators' input
+        shape, used for the non-f32 remainder and wholesale fallbacks."""
+        return list(zip(self.weights, self.snapshots))
+
+    def __len__(self) -> int:
+        return int(self.mat.shape[0])
+
+    def subset(self, idx) -> "StackedRound":
+        """Row subset (device gather — the plane never returns to host)."""
+        idx = np.asarray(idx, np.int32)
+        return StackedRound(
+            self.engine, self.plane,
+            [self.weights[i] for i in idx],
+            self.mat[idx],
+            [self.snapshots[i] for i in idx],
+        )
+
+
+class DeviceAggEngine:
+    """The jitted, sharded programs of the aggregation data plane.
+
+    One engine per server; programs are built once and re-specialize per
+    (N, D_pad) shape through the jit cache. All programs run under
+    ``shard_map`` over the flattened-parameter axis so each device owns a
+    ``D_pad / n_shards`` coordinate block.
+    """
+
+    def __init__(self, mesh=None, devices=None, axis_name: str = "params"):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from gfedntm_tpu.parallel.mesh import (
+            make_param_mesh,
+            shard_map_compat,
+        )
+
+        if mesh is None:
+            mesh = make_param_mesh(devices, axis_name)
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0] if mesh.axis_names else axis_name
+        self.n_shards = int(mesh.devices.size)
+        self._jnp = jnp
+        ax = self.axis
+
+        def _sm(f, in_specs, out_specs):
+            return jax.jit(shard_map_compat(
+                f, mesh, in_specs=in_specs, out_specs=out_specs,
+            ))
+
+        # ---- gate statistics: ONE fused pass over the stack ------------
+        # Per-shard [N, 1] partials; the host finishes with an O(N * 8)
+        # float64 reduction — the two-level accumulation that keeps norm
+        # parity with sanitize.update_norm's f64 accumulator at 1e-6.
+        def gate_stats(mat, gvec):
+            d = mat - gvec[None, :]
+            sq = jnp.sum(d * d, axis=1, keepdims=True)
+            bad = jnp.sum(
+                ~jnp.isfinite(mat), axis=1, keepdims=True
+            ).astype(jnp.int32)
+            return bad, sq
+
+        self._gate_stats = _sm(
+            gate_stats,
+            in_specs=(P(None, ax), P(ax)),
+            out_specs=(P(None, ax), P(None, ax)),
+        )
+
+        # ---- norm clip: gradient-clipping semantics, per-row factor ----
+        # Rows with factor 1.0 pass through VERBATIM (the numpy path does
+        # not touch them, and `gvec + (row - gvec)` would perturb them by
+        # an ulp — breaking the clip-round bitwise weighted mean).
+        def clip_rows(mat, gvec, factors):
+            clipped = gvec[None, :] + factors[:, None] * (
+                mat - gvec[None, :]
+            )
+            return jnp.where(factors[:, None] == 1.0, mat, clipped)
+
+        self._clip_rows = _sm(
+            clip_rows,
+            in_specs=(P(None, ax), P(ax), P()),
+            out_specs=P(None, ax),
+        )
+
+        # ---- coordinate median ----------------------------------------
+        def median(mat):
+            return jnp.median(mat, axis=0)
+
+        self._median = _sm(median, in_specs=(P(None, ax),), out_specs=P(ax))
+
+        # ---- Krum pairwise distances via the gram identity -------------
+        # Per-shard [N, N] gram block; the host sums 8 blocks and applies
+        # the same O(N^2) selection code the numpy Krum uses. HIGHEST
+        # matmul precision: TPUs default f32 matmuls to bf16 passes, and
+        # the gram identity cancels catastrophically for nearby clients —
+        # exactly the distances Krum ranks — so reduced precision could
+        # flip neighbor selection vs the numpy f32 oracle.
+        def gram(mat):
+            return jnp.matmul(
+                mat, mat.T, precision=jax.lax.Precision.HIGHEST
+            )[None]
+
+        self._gram = _sm(
+            gram, in_specs=(P(None, ax),), out_specs=P(ax, None, None)
+        )
+
+        # trimmed mean needs a static trim count: one jitted program per t.
+        self._trimmed: dict[int, Any] = {}
+        self._sm_builder = _sm
+
+    def _trimmed_prog(self, t: int):
+        prog = self._trimmed.get(t)
+        if prog is None:
+            jnp = self._jnp
+            from jax.sharding import PartitionSpec as P
+
+            def trimmed(mat):
+                s = jnp.sort(mat, axis=0)
+                n = mat.shape[0]
+                return jnp.mean(s[t:n - t], axis=0)
+
+            prog = self._sm_builder(
+                trimmed, in_specs=(P(None, self.axis),), out_specs=P(self.axis)
+            )
+            self._trimmed[t] = prog
+        return prog
+
+    # ---- staging -------------------------------------------------------
+    def _pad_dim(self, plane: FlatPlane) -> int:
+        from gfedntm_tpu.parallel.mesh import pad_to_multiple
+
+        return pad_to_multiple(plane.dim, self.n_shards)
+
+    def stack(self, plane: FlatPlane, snaps: list[Mapping[str, Any]]):
+        """Stack N snapshots into the sharded ``[N, D_pad]`` device plane —
+        the round's ONE host flatten + transfer."""
+        from gfedntm_tpu.parallel.sharded import shard_param_plane
+
+        d_pad = self._pad_dim(plane)
+        mat = np.zeros((len(snaps), d_pad), np.float32)
+        for i, snap in enumerate(snaps):
+            plane.flatten(snap, out=mat[i, :plane.dim])
+        return shard_param_plane(mat, self.mesh, self.axis)
+
+    def put_vector(self, plane: FlatPlane, snap: Mapping[str, Any]):
+        """Flatten + shard one reference vector (the current global)."""
+        from gfedntm_tpu.parallel.sharded import shard_param_plane
+
+        vec = np.zeros(self._pad_dim(plane), np.float32)
+        plane.flatten(snap, out=vec[:plane.dim])
+        return shard_param_plane(vec, self.mesh, self.axis)
+
+    # ---- gate data plane -----------------------------------------------
+    def gate_stats(self, mat, gvec) -> tuple[np.ndarray, np.ndarray]:
+        """Fused finiteness + update-norm pass. Returns
+        ``(nonfinite_counts [N] int, norms [N] float64)``."""
+        bad, sq = self._gate_stats(mat, gvec)
+        counts = np.asarray(bad).sum(axis=1)
+        norms = np.sqrt(np.asarray(sq, np.float64).sum(axis=1))
+        return counts, norms
+
+    def clip(self, mat, gvec, factors: np.ndarray):
+        """Apply per-row clip factors (1.0 = untouched) on device."""
+        return self._clip_rows(mat, gvec, np.asarray(factors, np.float32))
+
+    # ---- estimators ----------------------------------------------------
+    def weighted_mean_vec(self, stacked: StackedRound) -> np.ndarray:
+        """f32 plane weighted mean, bitwise-matching the numpy reference
+        chain ``sum(w * s[k] for ...) / round_weight``.
+
+        Deliberately EAGER device ops (one multiply, one add per row, on
+        the sharded plane) instead of one jitted program: inside a single
+        XLA computation the compiler contracts the multiply-add chain
+        (FMA / reassociation — ``optimization_barrier`` does not stop
+        it), which is one ulp away from numpy's round-product-then-add.
+        Per-op dispatch keeps each rounding where numpy puts it; the
+        arrays never leave the device or the sharding, and N is the
+        cohort size, so the host drives O(N) dispatches of O(D/n_shards)
+        work — still the data-plane win."""
+        jnp = self._jnp
+        mat = stacked.mat
+        # The numpy denominator is the Python-float (f64) sum, rounded to
+        # f32 once at the division — reproduce it on the host, do not
+        # re-sum the f32 weights on device.
+        total = np.float32(float(sum(stacked.weights)))
+        acc = jnp.float32(np.float32(stacked.weights[0])) * mat[0]
+        for i in range(1, mat.shape[0]):
+            acc = acc + jnp.float32(np.float32(stacked.weights[i])) * mat[i]
+        return np.asarray(acc / jnp.float32(total))
+
+    def trimmed_mean_vec(self, stacked: StackedRound, t: int) -> np.ndarray:
+        return np.asarray(self._trimmed_prog(t)(stacked.mat))
+
+    def median_vec(self, stacked: StackedRound) -> np.ndarray:
+        return np.asarray(self._median(stacked.mat))
+
+    def krum_d2(self, stacked: StackedRound) -> np.ndarray:
+        """Pairwise squared distances of the stacked rows, f32, via the
+        sharded gram identity (the same identity the numpy Krum uses)."""
+        dots = np.asarray(self._gram(stacked.mat)).sum(axis=0)
+        sq = np.diagonal(dots).copy()
+        d2 = sq[:, None] + sq[None, :] - 2.0 * dots
+        return d2.astype(np.float32, copy=False)
+
+
+def stack_round(
+    engine: DeviceAggEngine, plane: FlatPlane, pairs: list
+) -> StackedRound:
+    """Stack numpy-path ``[(weight, snapshot)]`` pairs into a device
+    round — the one-call entry point for tests and the microbench."""
+    snaps = [s for _w, s in pairs]
+    return StackedRound(
+        engine, plane, [w for w, _s in pairs],
+        engine.stack(plane, snaps), snaps,
+    )
+
+
+def _non_f32_weighted_mean(plane: FlatPlane, snapshots) -> dict:
+    """numpy weighted-mean for the non-f32 remainder keys (preserves the
+    numpy path's dtype semantics — e.g. int tensors average to float64)."""
+    from gfedntm_tpu.federation.aggregation import weighted_mean
+
+    sub = [
+        (w, {k: s[k] for k in plane.non_f32_keys}) for w, s in snapshots
+    ]
+    return weighted_mean(sub)
+
+
+def estimate(estimator, stacked: StackedRound) -> dict[str, np.ndarray]:
+    """Run ``estimator``'s mean stage on the device plane.
+
+    Dispatches on the estimator type from ``aggregation.py``; every branch
+    reproduces its numpy ``_estimate`` semantics (weighted mean bitwise in
+    f32; trimmed mean / median / Krum to 1e-6, with identical Krum
+    neighbor selection given non-degenerate scores).
+    """
+    from gfedntm_tpu.federation import aggregation as agg
+
+    plane, engine = stacked.plane, stacked.engine
+
+    def _with_remainder(est: dict) -> dict:
+        if plane.non_f32_keys:
+            est.update(_non_f32_weighted_mean(plane, stacked.pairs))
+        return est
+
+    if isinstance(estimator, agg.Krum):
+        n = len(stacked)
+        if n - estimator.f < 2:
+            # Cohort too small to score against itself — the numpy Krum
+            # degrades to the median; mirror it.
+            return estimate(agg.Median(), stacked)
+        d2 = engine.krum_d2(stacked)
+        chosen = agg.krum_select(d2, n, estimator.f)
+        return estimate(agg.WeightedMean(), stacked.subset(chosen))
+    if isinstance(estimator, agg.TrimmedMean):
+        t = int(estimator.frac * len(stacked))
+        vec = engine.trimmed_mean_vec(stacked, t)
+        return plane.unflatten(vec)
+    if isinstance(estimator, agg.Median):
+        return plane.unflatten(engine.median_vec(stacked))
+    if isinstance(estimator, agg.WeightedMean):
+        vec = engine.weighted_mean_vec(stacked)
+        est = plane.unflatten(vec, cast=False)
+        # f32 keys are bitwise the numpy chain; non-f32 keys get the numpy
+        # expression itself (weighted_mean does NOT cast back — int
+        # tensors legitimately average to float64 there).
+        for k in plane.non_f32_keys:
+            del est[k]
+        return _with_remainder(est)
+    # Unknown estimator subtype: run its numpy implementation wholesale on
+    # the retained host snapshots — correctness over residency.
+    return estimator._estimate(stacked.pairs)

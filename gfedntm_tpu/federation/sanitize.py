@@ -84,11 +84,18 @@ class Rejection:
 
 @dataclass
 class GateResult:
-    """Outcome of one round's admission pass."""
+    """Outcome of one round's admission pass.
+
+    ``stacked`` is only set by the device backend (see
+    :meth:`UpdateGate.set_engine`): the accepted cohort as a
+    ``device_agg.StackedRound`` — clip already applied on the plane — for
+    the aggregator to consume without ever round-tripping through
+    per-key host dicts."""
 
     accepted: list  # [(client_id, weight, snapshot)]
     rejected: list  # [Rejection]
     clipped: list  # [(client_id, norm, max_norm)]
+    stacked: Any = None
 
 
 class UpdateGate:
@@ -143,6 +150,14 @@ class UpdateGate:
         self._expected_keys: frozenset[str] | None = None
         self._expected_shapes: dict[str, tuple] = {}
         self._expected_dtypes: dict[str, np.dtype] = {}
+        # Device-resident backend (README "Device-resident aggregation"):
+        # when an engine is attached, finiteness/norms/clip run as one
+        # fused sharded XLA pass over the stacked cohort instead of host
+        # numpy per tensor. Decisions are identical by contract
+        # (tests/test_device_agg.py).
+        self._engine: Any = None
+        self._template: dict[str, np.ndarray] | None = None
+        self._plane: Any = None
         # Consecutive rejection streak per client (reset on acceptance):
         # the "repeated offender" signal the server feeds into probation.
         self._streak: dict[int, int] = {}
@@ -159,6 +174,15 @@ class UpdateGate:
         self._expected_dtypes = {
             k: np.asarray(v).dtype for k, v in template.items()
         }
+        self._template = {k: np.asarray(v) for k, v in template.items()}
+        self._plane = None  # re-derived lazily from the new template
+
+    def set_engine(self, engine: Any) -> None:
+        """Attach a ``device_agg.DeviceAggEngine``: subsequent rounds run
+        the data plane (finiteness, norms, clip) on device and hand the
+        aggregator a stacked cohort (``GateResult.stacked``). ``None``
+        restores the pure-numpy path."""
+        self._engine = engine
 
     def consecutive(self, client_id: int) -> int:
         """Current consecutive-rejection streak for one client."""
@@ -228,7 +252,16 @@ class UpdateGate:
         it is judged against); MAD outliers are rejected on raw norms;
         finally the hard clip bounds whoever remains. Telemetry and streak
         bookkeeping happen here so every caller gets identical accounting.
+
+        With a device engine attached (:meth:`set_engine`) the same pass
+        runs on the stacked device plane — identical decisions, and the
+        result additionally carries ``stacked`` for the device-resident
+        aggregator.
         """
+        if self._engine is not None and self._template is not None:
+            return self._admit_round_device(
+                candidates, current_global, round_idx
+            )
         rejected: list[Rejection] = []
         clipped: list[tuple[int, float, float]] = []
         sound: list[tuple[int, float, dict, float]] = []
@@ -282,6 +315,149 @@ class UpdateGate:
         self._account(accepted, rejected, clipped, round_idx)
         return GateResult(accepted=accepted, rejected=rejected,
                           clipped=clipped)
+
+    def _admit_round_device(
+        self,
+        candidates: "list[tuple[int, float, dict[str, np.ndarray]]]",
+        current_global: Mapping[str, np.ndarray],
+        round_idx: int,
+    ) -> GateResult:
+        """The admission pass on the device plane: conformance stays host
+        metadata work, then the structurally-sound candidates are stacked
+        ONCE and a single fused sharded program computes every row's
+        non-finite count and update norm; MAD screening is O(N) host
+        arithmetic over those norms; the clip is one more device pass
+        with per-row factors. Semantics mirror the numpy branch above
+        decision-for-decision (tests/test_device_agg.py pins this): a row
+        whose norm overflows the f32 plane accumulator (values ~1e19+,
+        finite in their own dtype) gets its norm recomputed with the
+        numpy f64 accumulator on the host, so even those extreme rows
+        take the oracle's screen/clip/admit path."""
+        from gfedntm_tpu.federation.device_agg import FlatPlane, StackedRound
+
+        if self._plane is None:
+            self._plane = FlatPlane(self._template)
+        plane, engine = self._plane, self._engine
+
+        # Phase-1 rejections (conformance + finiteness) are collected with
+        # their candidate index and emitted in candidate order — the exact
+        # accounting order of the numpy branch, whose single loop
+        # interleaves both checks.
+        phase1: list[tuple[int, Rejection]] = []
+        sound: list[tuple[int, float, dict]] = []
+        sound_src: list[int] = []
+        for ci, (client_id, weight, snap) in enumerate(candidates):
+            rej = self._conformance(client_id, snap)
+            if rej is not None:
+                phase1.append((ci, rej))
+                continue
+            sound.append((client_id, weight, snap))
+            sound_src.append(ci)
+
+        if not sound:
+            rejected = [rej for _ci, rej in phase1]
+            self._account([], rejected, [], round_idx)
+            return GateResult(accepted=[], rejected=rejected, clipped=[])
+
+        mat = engine.stack(plane, [s for _c, _w, s in sound])
+        gvec = engine.put_vector(plane, current_global)
+        need_norm = (
+            self.mad_k > 0 or self.max_update_norm is not None
+        ) and self.check_finite
+        if self.check_finite or need_norm:
+            counts, norms = engine.gate_stats(mat, gvec)
+        else:
+            # Gate fully disabled (pre-PR 5 semantics): the numpy branch
+            # computes nothing here — skip the device pass too.
+            counts = np.zeros(len(sound), np.int64)
+            norms = np.full(len(sound), np.nan)
+        finite_rows: list[int] = []
+        for i, (client_id, _w, snap) in enumerate(sound):
+            if self.check_finite and counts[i] > 0:
+                # The per-key host scan only runs for the (rare) flagged
+                # row, to reproduce the numpy rejection detail. A row the
+                # host finds finite in its own dtype (values that only
+                # overflowed the f32 *plane* — possible for wider-dtype
+                # templates) is NOT a numpy-path NONFINITE: let it fall
+                # through to the norm stage, where its infinite plane
+                # norm rejects it as the documented overflow outlier.
+                rej = self._nonfinite(client_id, snap)
+                if rej is not None:
+                    phase1.append((sound_src[i], rej))
+                    continue
+            finite_rows.append(i)
+        rejected = [rej for _ci, rej in sorted(phase1, key=lambda t: t[0])]
+        if need_norm:
+            for i in finite_rows:
+                # f32 plane overflow (values finite in their own dtype
+                # whose squares exceed f32 range): recompute THIS row's
+                # norm with the numpy f64 accumulator so the decision —
+                # screen, clip, or admit — is exactly the oracle's.
+                # Rare path, O(overflowed rows) host work.
+                if not np.isfinite(norms[i]):
+                    norms[i] = update_norm(sound[i][2], current_global)
+
+        threshold = (
+            self._outlier_threshold([
+                float(norms[i]) for i in finite_rows
+                if np.isfinite(norms[i])
+            ])
+            if need_norm else None
+        )
+        accepted_rows: list[int] = []
+        accepted: list[tuple[int, float, dict]] = []
+        clipped: list[tuple[int, float, float]] = []
+        factors = np.ones(len(sound), np.float32)
+        clip_rows: set[int] = set()
+        for i in finite_rows:
+            client_id, weight, snap = sound[i]
+            norm = float(norms[i]) if need_norm else float("nan")
+            if threshold is not None and norm > threshold:
+                rejected.append(Rejection(
+                    client_id, NORM_OUTLIER,
+                    f"update norm {norm:.3e} > cohort threshold "
+                    f"{threshold:.3e}",
+                    norm=norm,
+                ))
+                continue
+            if (
+                self.max_update_norm is not None
+                and np.isfinite(norm) and norm > self.max_update_norm
+            ):
+                factors[i] = self.max_update_norm / norm
+                clip_rows.add(i)
+                clipped.append((client_id, norm, self.max_update_norm))
+            accepted_rows.append(i)
+            accepted.append((client_id, weight, snap))
+
+        if clip_rows:
+            mat = engine.clip(mat, gvec, factors)
+            # Keep the host dicts consistent with the clipped plane: the
+            # stacked rows are authoritative for the aggregate, but the
+            # dicts feed the non-f32 remainder and any numpy fallback.
+            # Only the clipped rows round-trip to host.
+            for pos, i in enumerate(accepted_rows):
+                if i in clip_rows:
+                    client_id, weight, _snap = sound[i]
+                    row = np.asarray(mat[i])[:plane.dim].copy()
+                    accepted[pos] = (
+                        client_id, weight, plane.unflatten(row),
+                    )
+
+        stacked = None
+        if accepted_rows:
+            rows = (
+                mat if len(accepted_rows) == len(sound)
+                else mat[np.asarray(accepted_rows, np.int32)]
+            )
+            stacked = StackedRound(
+                engine, plane,
+                [w for _c, w, _s in accepted], rows,
+                [s for _c, _w, s in accepted],
+            )
+        self._account(accepted, rejected, clipped, round_idx)
+        return GateResult(accepted=accepted, rejected=rejected,
+                          clipped=clipped, stacked=stacked)
 
     def _account(self, accepted, rejected, clipped, round_idx: int) -> None:
         m = self.metrics

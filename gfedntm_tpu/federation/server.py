@@ -127,6 +127,7 @@ class FederatedServer:
         aggregator="fedavg",
         aggregator_kwargs: dict[str, Any] | None = None,
         robust_aggregator: str | None = None,
+        aggregation_backend: str = "auto",
         sanitize: bool = True,
         max_update_norm: float | None = None,
         outlier_mad_k: float = 4.0,
@@ -182,6 +183,22 @@ class FederatedServer:
             aggregator, robust=robust_aggregator,
             **(aggregator_kwargs or {})
         )
+        # Aggregation data-plane backend (README "Device-resident
+        # aggregation"): "device" stacks each round's snapshots into one
+        # sharded device array and runs the gate statistics + robust mean
+        # stage as jitted XLA programs; "numpy" is the host reference
+        # oracle; "auto" (default) picks device exactly when an
+        # accelerator backend is present, so CPU deployments (and tier-1)
+        # are bit-for-bit unchanged. Resolved lazily at first use
+        # (_ensure_template) so constructing a server never initializes
+        # jax's backend on its own.
+        if aggregation_backend not in ("auto", "device", "numpy"):
+            raise ValueError(
+                f"aggregation_backend must be auto|device|numpy, got "
+                f"{aggregation_backend!r}"
+            )
+        self.aggregation_backend = aggregation_backend
+        self._agg_backend_resolved: str | None = None
         # Data-plane defense (README "Robust aggregation & divergence
         # recovery"), three layers: (1) the update admission gate screens
         # every decoded reply (conformance always; finiteness + norm
@@ -383,6 +400,9 @@ class FederatedServer:
             # divergence recovery"): every rejection/clip/rollback is
             # visible here as well as in the JSONL stream.
             "data_plane": {
+                "agg_backend": (
+                    self._agg_backend_resolved or self.aggregation_backend
+                ),
                 "sanitize": self.update_gate.check_finite,
                 "outlier_mad_k": self.update_gate.mad_k,
                 "max_update_norm": self.update_gate.max_update_norm,
@@ -825,6 +845,51 @@ class FederatedServer:
             self._expected_keys = frozenset(template)
             self._expected_shapes = {k: v.shape for k, v in template.items()}
             self.update_gate.set_template(template)
+        self._resolve_agg_backend()
+
+    def _resolve_agg_backend(self) -> None:
+        """Pick the aggregation data-plane backend at server start (first
+        template use): ``device`` when an accelerator is present (or
+        forced), ``numpy`` otherwise. A device-engine construction
+        failure degrades LOUDLY to numpy — a working round loop beats a
+        resident one."""
+        if self._agg_backend_resolved is not None:
+            return
+        mode = self.aggregation_backend
+        if mode == "auto":
+            try:
+                import jax
+
+                mode = (
+                    "device"
+                    if jax.default_backend() not in ("cpu",)
+                    else "numpy"
+                )
+            except Exception:  # no usable jax backend at all
+                mode = "numpy"
+        if mode == "device":
+            try:
+                from gfedntm_tpu.federation.device_agg import DeviceAggEngine
+
+                engine = DeviceAggEngine()
+                self.update_gate.set_engine(engine)
+                self.logger.info(
+                    "aggregation backend: device (%d-way '%s' mesh)",
+                    engine.n_shards, engine.axis,
+                )
+            except Exception as err:  # noqa: BLE001 — degrade, don't die
+                self.logger.warning(
+                    "device aggregation backend unavailable (%r); "
+                    "falling back to numpy", err,
+                )
+                mode = "numpy"
+        if mode == "numpy":
+            self.update_gate.set_engine(None)
+        self._agg_backend_resolved = mode
+        if self.metrics is not None:
+            self.metrics.registry.gauge("agg_backend_device").set(
+                1.0 if mode == "device" else 0.0
+            )
 
     def _collect_snapshots(
         self, replies: list, iteration: int,
@@ -847,7 +912,13 @@ class FederatedServer:
         The FedAvg weight is the reply's ``nr_samples`` — the samples the
         client actually consumed this round (summed over all E local
         minibatches, ADVICE r5) — falling back to the client's join-time
-        corpus size for replies that don't report one."""
+        corpus size for replies that don't report one.
+
+        Returns the admitted cohort as ``[(weight, snapshot)]`` on the
+        numpy backend, or as a device-resident
+        :class:`~gfedntm_tpu.federation.device_agg.StackedRound` on the
+        device backend (same ``len``, consumed transparently by every
+        aggregator's mean stage)."""
         self._ensure_template()
         m = self.metrics
         records: dict[int, Any] = {}
@@ -921,6 +992,11 @@ class FederatedServer:
             (client_id, weight, losses[client_id])
             for client_id, weight, _snap in result.accepted
         ]
+        if result.stacked is not None:
+            # Device backend: the admitted cohort is already stacked (and
+            # clipped) on the device plane — the aggregator's mean stage
+            # consumes it directly, no per-key host dicts on the hot path.
+            return result.stacked
         return [
             (weight, snap) for _client_id, weight, snap in result.accepted
         ]

@@ -155,8 +155,14 @@ class AVITM:
         self._np_rng = np.random.default_rng(seed)
         self._rng = jax.random.PRNGKey(seed + 1)
 
+        # donate only when the fused Pallas decoder is OFF: fit()'s
+        # fallback retries the epoch program with the SAME state arrays
+        # after a fused failure, and an execution-time failure of a
+        # donating program would leave those buffers deleted — the retry
+        # the fallback exists for must always be able to run.
         self._train_epoch_fn = build_train_epoch(
-            self.module, self.tx, self.family, self._beta_weight()
+            self.module, self.tx, self.family, self._beta_weight(),
+            donate=not getattr(self.module, "fused_decoder", False),
         )
         self._eval_epoch_fn = build_eval_epoch(
             self.module, self.family, self._beta_weight()
@@ -217,7 +223,8 @@ class AVITM:
         self.fused_decoder = False
         self.module = self._build_module()
         self._train_epoch_fn = build_train_epoch(
-            self.module, self.tx, self.family, self._beta_weight()
+            self.module, self.tx, self.family, self._beta_weight(),
+            donate=not getattr(self.module, "fused_decoder", False),
         )
         self._eval_epoch_fn = build_eval_epoch(
             self.module, self.family, self._beta_weight()

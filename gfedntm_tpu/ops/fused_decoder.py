@@ -339,6 +339,84 @@ def _pad_geometry(b: int, k: int, v: int, storage_dtype: str = "float32"):
     return b_pad, k_pad, tile_v, v_pad
 
 
+def _mosaic_block_ok(block: tuple, array: tuple) -> bool:
+    """The Mosaic lowering rule the BENCH_r02 failure tripped: each of the
+    block's last two dims must be divisible by (8, 128) respectively OR
+    equal the overall array dim."""
+    sub, lane = block[-2], block[-1]
+    asub, alane = array[-2], array[-1]
+    return (sub % 8 == 0 or sub == asub) and (
+        lane % 128 == 0 or lane == alane
+    )
+
+
+def pass_block_geometry(
+    b: int, k: int, v: int, storage_dtype: str = "float32"
+) -> dict[str, tuple[tuple, tuple]]:
+    """Every (block shape, array shape) pair the three kernels bind for a
+    given problem geometry — the static contract behind the BENCH_r02
+    ``fused_largev_error``: the round-2 kernel emitted the online-softmax
+    accumulators as an ``[B, n_tiles]`` partials array with ``(B, 1)``
+    blocks, which Mosaic rejects whenever ``n_tiles > 1`` (block last dim
+    1 is neither 128-divisible nor equal to the array dim). The redesign
+    keeps m/s as full ``(B_pad, 1)`` arrays with a constant index map, so
+    every block below is either full-array or (8, 128)-aligned.
+    ``assert_mosaic_legal`` turns this table into a hard check;
+    ``tests/test_ops.py`` pins it at the failing geometry.
+
+    Block shapes are read from the SAME BlockSpec constructors the pallas
+    calls bind (``_specs``/``_x_spec``/``_grads_out_specs``), so a future
+    re-tiling cannot drift past this check; the array shapes mirror the
+    ``out_shape``/padded-operand shapes of ``_pass1_p``/``_pass2_p``/
+    ``_grads_p`` (all direct functions of ``_pad_geometry``)."""
+    b_pad, k_pad, tile_v, v_pad = _pad_geometry(b, k, v, storage_dtype)
+    theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(
+        b_pad, k_pad, tile_v
+    )
+    x_spec = _x_spec(b_pad, tile_v)
+    gbeta_spec, gtheta_spec = _grads_out_specs(b_pad, k_pad, tile_v)
+
+    def blk(spec) -> tuple:
+        return tuple(spec.block_shape)
+
+    bfix = (blk(bfix_spec), (b_pad, 1))
+    vrow = (blk(vrow_spec), (1, v_pad))
+    return {
+        "theta": (blk(theta_spec), (b_pad, k_pad)),
+        "beta": (blk(beta_spec), (k_pad, v_pad)),
+        "x": (blk(x_spec), (b_pad, v_pad)),
+        "mask": bfix,
+        "running_mean": vrow,
+        "running_var": vrow,
+        "stats.mean": vrow,
+        "stats.var": vrow,
+        "stats.m": bfix,        # outputs[2] of _stats_kernel (BENCH_r02)
+        "stats.s": bfix,
+        "loss.out": bfix,
+        "loss.rd": bfix,
+        "grads.g_beta": (blk(gbeta_spec), (k_pad, v_pad)),
+        "grads.g_theta": (blk(gtheta_spec), (b_pad, k_pad)),
+    }
+
+
+def assert_mosaic_legal(
+    b: int, k: int, v: int, storage_dtype: str = "float32"
+) -> None:
+    """Raise if any kernel block spec for this geometry violates the
+    Mosaic (8, 128)-or-full-array rule (see :func:`pass_block_geometry`).
+    Pure host arithmetic — usable in tests and tooling without a TPU."""
+    for name, (block, array) in pass_block_geometry(
+        b, k, v, storage_dtype
+    ).items():
+        if not _mosaic_block_ok(block, array):
+            raise ValueError(
+                f"fused decoder block spec {name!r} has block shape "
+                f"{block} against array shape {array}: last two dims must "
+                "be divisible by (8, 128) or equal the array dims "
+                "(Mosaic lowering rule; BENCH_r02 fused_largev_error)"
+            )
+
+
 def _specs(b_pad: int, k_pad: int, tile_v: int):
     theta_spec = pl.BlockSpec(
         (b_pad, k_pad), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
@@ -353,6 +431,24 @@ def _specs(b_pad: int, k_pad: int, tile_v: int):
         (b_pad, 1), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
     )
     return theta_spec, beta_spec, vrow_spec, bfix_spec
+
+
+def _x_spec(b_pad: int, tile_v: int):
+    """The [B_pad, TILE_V] V-tiled block of x (pass 2 + backward)."""
+    return pl.BlockSpec(
+        (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+
+
+def _grads_out_specs(b_pad: int, k_pad: int, tile_v: int):
+    """Backward outputs: per-tile g_beta block + full g_theta accumulator."""
+    gbeta_spec = pl.BlockSpec(
+        (k_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
+    )
+    gtheta_spec = pl.BlockSpec(
+        (b_pad, k_pad), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
+    )
+    return gbeta_spec, gtheta_spec
 
 
 # ---------------------------------------------------------------------------
@@ -449,9 +545,7 @@ def _pass2_p(
     n_tiles = v_pad // tile_v
     dims = jnp.array([v], jnp.int32)
     theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
-    x_spec = pl.BlockSpec(
-        (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
-    )
+    x_spec = _x_spec(b_pad, tile_v)
 
     return pl.pallas_call(
         functools.partial(
@@ -593,15 +687,8 @@ def _grads_p(
     n_tiles = v_pad // tile_v
     dims = jnp.array([v], jnp.int32)
     theta_spec, beta_spec, vrow_spec, bfix_spec = _specs(b_pad, k_pad, tile_v)
-    x_spec = pl.BlockSpec(
-        (b_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
-    )
-    gbeta_spec = pl.BlockSpec(
-        (k_pad, tile_v), lambda j, dims: (0, j), memory_space=pltpu.VMEM
-    )
-    gtheta_spec = pl.BlockSpec(
-        (b_pad, k_pad), lambda j, dims: (0, 0), memory_space=pltpu.VMEM
-    )
+    x_spec = _x_spec(b_pad, tile_v)
+    gbeta_spec, gtheta_spec = _grads_out_specs(b_pad, k_pad, tile_v)
     g_beta, g_theta = pl.pallas_call(
         functools.partial(
             _grads_kernel, training=training, eps=eps, floor=floor,
@@ -927,7 +1014,7 @@ def _vsharded_vjp_bwd(
     # the op-level gradient-parity tests (tests/test_ops.py::
     # TestVShardedFused) pin this convention — if a jax upgrade changes it,
     # they fail loudly rather than silently rescaling training.
-    g_rl = cotangents[0] * jax.lax.axis_size(model_axis)
+    g_rl = cotangents[0] * _axis_size(model_axis)
     interp = _resolve_interpret(interpret)
 
     if training and data_axis is not None:
@@ -995,6 +1082,18 @@ def _vsharded_vjp_bwd(
 
 
 _vsharded_impl.defvjp(_vsharded_vjp_fwd, _vsharded_vjp_bwd)
+
+
+def _axis_size(axis_name: str):
+    """Mapped-axis size across jax versions: ``jax.lax.axis_size`` where
+    it exists; on 0.4.x (which lacks it) ``psum(1, axis)`` — the same
+    value as a (cheap, [1]-sized) collective. Companion of
+    ``parallel.mesh.shard_map_compat``: the V-sharded backward was
+    unreachable on 0.4.x until that shim landed, which masked this."""
+    size_fn = getattr(jax.lax, "axis_size", None)
+    if size_fn is not None:
+        return size_fn(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def _resolve_interpret(interpret: bool | None) -> bool:

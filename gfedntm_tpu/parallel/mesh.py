@@ -18,6 +18,47 @@ import jax
 from jax.sharding import Mesh
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``. Every
+    shard_map call site in the repo routes through here so the SPMD
+    programs (federated trainer, V-sharded fused loss, device-resident
+    aggregation) run on both — on 0.4.x the bare ``jax.shard_map``
+    attribute lookup raises, which used to take the whole multi-device
+    test plane down with it."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as sm_experimental
+
+    return sm_experimental(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
+
+
+def make_param_mesh(
+    devices: list | None = None, axis_name: str = "params"
+) -> Mesh:
+    """1-D mesh over every available device for the flattened-parameter
+    plane of the device-resident aggregation path: client snapshots stack
+    to ``[N, D]`` and shard their D axis over this mesh, so gate statistics
+    and robust estimators run as per-shard XLA programs with only
+    [N]-sized partials crossing devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n`` (and >= m)."""
+    return max(1, -(-n // m)) * m
+
+
 def make_client_mesh(
     n_clients: int, devices: list | None = None, axis_name: str = "clients"
 ) -> tuple[Mesh, int]:

@@ -91,6 +91,25 @@ def _replicate(x, mesh: Mesh):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
+def shard_param_plane(
+    mat: np.ndarray, mesh: Mesh, axis_name: str = "params"
+):
+    """Place a ``[..., D]`` host array with its LAST axis sharded over
+    ``axis_name`` (everything else replicated) — the placement of the
+    device-resident aggregation plane (``federation/device_agg.py``),
+    where client snapshots are stacked ``[N, D]`` and every per-coordinate
+    statistic is shard-local. ``D`` must divide evenly by the mesh size
+    (pad with zeros first; see ``parallel.mesh.pad_to_multiple``)."""
+    n_shards = int(mesh.shape[axis_name])
+    if mat.shape[-1] % n_shards:
+        raise ValueError(
+            f"last axis {mat.shape[-1]} does not divide over {n_shards} "
+            f"devices; pad it first (parallel.mesh.pad_to_multiple)"
+        )
+    spec = P(*([None] * (mat.ndim - 1) + [axis_name]))
+    return jax.device_put(mat, NamedSharding(mesh, spec))
+
+
 def fit_sharded(
     model,
     train_dataset: BowDataset,
@@ -141,9 +160,13 @@ def fit_sharded(
         from gfedntm_tpu.train.steps import build_train_epoch
 
         data_axis = "data" if mesh.shape.get("data", 1) > 1 else None
+        # donate=False: this branch exists only when the fused Pallas
+        # decoder is on, and a donating program that fails at execution
+        # time would leave the model's state buffers deleted — the same
+        # fused+donation combination avitm.py forbids for its fallback.
         train_fn = build_train_epoch(
             model.module, model.tx, model.family, model._beta_weight(),
-            vshard=(mesh, data_axis, "model"),
+            vshard=(mesh, data_axis, "model"), donate=False,
         )
     V = model.input_size
 

@@ -62,6 +62,26 @@ def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
     return {k: jnp.take(v, idx, axis=0) for k, v in data.items() if v is not None}
 
 
+def donation_argnums(
+    argnums: tuple[int, ...], donate: bool = True
+) -> tuple[int, ...]:
+    """Buffer-donation argnums for the jitted training programs, gated on
+    the backend: the carried state (params / batch_stats / opt_state)
+    flows linearly call-to-call, so donating it lets XLA reuse the input
+    HBM for the outputs instead of double-buffering the whole model+Adam
+    state. On CPU the gate returns ``()`` — CPU either ignores donation
+    (warning spam) or callers there legitimately re-read old state in
+    parity tests — so tier-1 semantics are untouched."""
+    if not donate:
+        return ()
+    try:
+        if jax.default_backend() in ("cpu",):
+            return ()
+    except RuntimeError:  # no backend at all
+        return ()
+    return argnums
+
+
 def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
                       mask, rngs, vshard=None):
     """Training loss via the Pallas fused decode+reconstruction kernel
@@ -117,14 +137,16 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
 
         from jax.sharding import PartitionSpec as P
 
+        from gfedntm_tpu.parallel.mesh import shard_map_compat
+
         mesh, data_axis, model_axis = vshard
-        rl, b_mean, b_var = jax.shard_map(
+        rl, b_mean, b_var = shard_map_compat(
             partial(
                 prodlda_recon_loss_vsharded,
                 model_axis=model_axis, data_axis=data_axis, training=True,
                 storage_dtype=storage,
             ),
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(data_axis, None),           # theta [B, K]
                 P(None, model_axis),          # beta [K, V]
@@ -134,7 +156,7 @@ def _fused_batch_loss(module, family, beta_weight, params, batch_stats, batch,
                 P(data_axis),                 # mask [B]
             ),
             out_specs=(P(data_axis), P(model_axis), P(model_axis)),
-            check_vma=False,
+            check=False,
         )(
             out.theta, params["beta"], batch["x_bow"],
             bn["running_mean"], bn["running_var"], m,
@@ -249,6 +271,7 @@ def build_train_epoch(
     vshard=None,
     metrics=None,
     label: str = "train_epoch",
+    donate: bool = True,
 ):
     """Returns jitted ``(params, batch_stats, opt_state, data, indices, masks,
     rng) -> (params, batch_stats, opt_state, losses[S])``.
@@ -260,6 +283,12 @@ def build_train_epoch(
     ``metrics`` (an observability MetricsLogger) wraps the returned program
     for compile capture: the first call is logged as a ``jit_compile``
     event, later dispatch latencies feed ``jit_dispatch_s/<label>``.
+
+    ``donate`` (accelerator backends only — see :func:`donation_argnums`)
+    donates the carried state buffers (params/batch_stats/opt_state) so
+    the epoch program updates the model in place in HBM; callers must
+    treat the state they passed in as consumed, which every in-repo
+    caller already does (state is reassigned from the outputs).
     """
 
     def train_epoch(params, batch_stats, opt_state, data, indices, masks, rng):
@@ -286,7 +315,13 @@ def build_train_epoch(
         )
         return params, batch_stats, opt_state, losses
 
-    return timed_jit(jax.jit(train_epoch), metrics, label)
+    return timed_jit(
+        jax.jit(
+            train_epoch,
+            donate_argnums=donation_argnums((0, 1, 2), donate),
+        ),
+        metrics, label,
+    )
 
 
 def build_train_step(
@@ -296,6 +331,7 @@ def build_train_step(
     beta_weight: float = 1.0,
     metrics=None,
     label: str = "train_step",
+    donate: bool = False,
 ):
     """Jitted ONE-minibatch step: ``(params, batch_stats, opt_state, data,
     idx[B], mask[B], rng) -> (params, batch_stats, opt_state, loss)``.
@@ -304,7 +340,9 @@ def build_train_step(
     ``federated_avitm.py:51-83``) drives this once per server poll; the
     whole-epoch ``lax.scan`` programs above stay the fast path for
     single-program training. ``metrics`` adds first-call compile capture
-    (see :func:`~gfedntm_tpu.utils.observability.timed_jit`)."""
+    (see :func:`~gfedntm_tpu.utils.observability.timed_jit`). ``donate``
+    defaults OFF here (unlike the epoch program): the stepper snapshots
+    shared parameters between steps, so in-place state is opt-in."""
 
     def train_step(params, batch_stats, opt_state, data, idx, mask, rng):
         rngs = {
@@ -317,7 +355,13 @@ def build_train_step(
             batch, mask, rngs,
         )
 
-    return timed_jit(jax.jit(train_step), metrics, label)
+    return timed_jit(
+        jax.jit(
+            train_step,
+            donate_argnums=donation_argnums((0, 1, 2), donate),
+        ),
+        metrics, label,
+    )
 
 
 def build_eval_epoch(

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Aggregation microbench: estimator wall-clock vs client count N.
+
+The device-resident aggregation path (ISSUE 6, README "Device-resident
+aggregation") exists to make robust-aggregation cost flat as the cohort
+grows: the numpy path loops per key and pays O(N·D) host arithmetic
+(plus an O(N log N · D) sort for the order statistics), while the device
+path stacks once and runs per-coordinate work data-parallel over the
+sharded plane. This script makes that claim measurable in the bench
+trajectory: for each estimator and backend it times ONE aggregate's mean
+stage at fixed parameter size D while N sweeps 4 → 32, and emits JSON
+lines (one per measurement plus one growth-summary line per estimator) —
+the acceptance check is ``device_growth < numpy_growth`` at N 4→32
+(``"sublinear_vs_numpy": true``).
+
+Timing protocol: pairs are built once per N; the device path's one-time
+stack + transfer is reported separately (``stack_ms``) from the estimate
+wall-clock (the per-round recurring cost is stack + estimate; the stack
+is one flatten+concat per client and scales trivially). The first device
+call per (estimator, N) shape is a jit compile and is excluded by a
+warmup call; each measurement is best-of ``--repeats``.
+
+Run on the test mesh (no accelerator needed):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python scripts/agg_microbench.py
+
+On a TPU host, run it bare: the engine meshes over the real chips.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_pairs(n: int, d: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    # Realistic key structure: one dominant matrix + two small vectors —
+    # the numpy path pays its per-key Python/loop overhead, the plane
+    # flattens them all into one [N, D] array.
+    d_main = d - 2 * 64
+    template = {
+        "beta": np.zeros((d_main,), np.float32),
+        "mu": np.zeros((64,), np.float32),
+        "sigma": np.zeros((64,), np.float32),
+    }
+    pairs = [
+        (
+            float(rng.integers(1, 100)),
+            {
+                k: rng.normal(size=v.shape).astype(np.float32)
+                for k, v in template.items()
+            },
+        )
+        for _ in range(n)
+    ]
+    return template, pairs
+
+
+def _best_of(fn, repeats: int) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--d", type=int, default=262_144,
+                    help="flattened parameter count (fixed across N)")
+    ap.add_argument("--clients", default="4,8,16,32",
+                    help="comma-separated cohort sizes")
+    ap.add_argument("--estimators",
+                    default="mean,trimmed_mean:0.2,median,krum:1")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--backends", default="numpy,device",
+                    help="comma subset of numpy,device")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from gfedntm_tpu.federation.aggregation import make_estimator
+    from gfedntm_tpu.federation.device_agg import (
+        DeviceAggEngine,
+        FlatPlane,
+        stack_round,
+    )
+
+    ns = [int(x) for x in args.clients.split(",") if x]
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    engine = DeviceAggEngine() if "device" in backends else None
+    if engine is not None:
+        import jax
+
+        sys.stderr.write(
+            f"agg_microbench: device backend = {jax.default_backend()} "
+            f"x{engine.n_shards}\n"
+        )
+
+    wall: dict[tuple[str, str, int], float] = {}
+    for spec in args.estimators.split(","):
+        spec = spec.strip()
+        for n in ns:
+            template, pairs = _build_pairs(n, args.d, seed=n)
+            if "numpy" in backends:
+                est = make_estimator(spec)
+                est(pairs)  # warm caches/allocators
+                ms = _best_of(lambda: est(pairs), args.repeats)
+                wall[(spec, "numpy", n)] = ms
+                print(json.dumps({
+                    "metric": "agg_estimator_wall_ms", "estimator": spec,
+                    "backend": "numpy", "n_clients": n, "d": args.d,
+                    "wall_ms": round(ms, 3),
+                }), flush=True)
+            if engine is not None:
+                est = make_estimator(spec)
+                plane = FlatPlane(template)
+                t0 = time.perf_counter()
+                sr = stack_round(engine, plane, pairs)
+                import jax
+
+                jax.block_until_ready(sr.mat)
+                stack_ms = (time.perf_counter() - t0) * 1e3
+
+                def run_dev():
+                    out = est(sr)
+                    # host materialization is part of the round cost
+                    for v in out.values():
+                        np.asarray(v)
+
+                run_dev()  # jit compile at this (n, d) shape
+                ms = _best_of(run_dev, args.repeats)
+                wall[(spec, "device", n)] = ms
+                print(json.dumps({
+                    "metric": "agg_estimator_wall_ms", "estimator": spec,
+                    "backend": "device", "n_clients": n, "d": args.d,
+                    "wall_ms": round(ms, 3),
+                    "stack_ms": round(stack_ms, 3),
+                }), flush=True)
+
+    # Growth summary: wall-clock ratio from the smallest to the largest N
+    # per (estimator, backend); the device path earns its keep when its
+    # ratio is below the numpy path's.
+    lo, hi = min(ns), max(ns)
+    for spec in [s.strip() for s in args.estimators.split(",")]:
+        row = {
+            "metric": "agg_growth", "estimator": spec,
+            "n_lo": lo, "n_hi": hi, "d": args.d,
+        }
+        for backend in backends:
+            a, b = wall.get((spec, backend, lo)), wall.get(
+                (spec, backend, hi)
+            )
+            if a and b:
+                row[f"{backend}_growth"] = round(b / a, 3)
+        if "numpy_growth" in row and "device_growth" in row:
+            row["sublinear_vs_numpy"] = (
+                row["device_growth"] < row["numpy_growth"]
+            )
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

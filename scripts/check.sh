@@ -34,7 +34,7 @@ echo "== observability/data-plane test modules collect =="
 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     tests/test_trace_plane.py tests/test_ops_endpoint.py \
-    tests/test_data_plane.py >/dev/null || exit 1
+    tests/test_data_plane.py tests/test_device_agg.py >/dev/null || exit 1
 
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo "== tests skipped (SKIP_TESTS=1) =="

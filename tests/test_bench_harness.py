@@ -93,9 +93,16 @@ class TestMainOrchestration:
                   artifact_dir=None, budget_s=3600.0):
         calls = []
 
-        def fake_run_phase(phase, bk, timeout_s, retries=1):
+        def fake_run_phase(phase, bk, timeout_s, retries=1, failures=None):
             calls.append((phase, bk, timeout_s))
-            return phase_results.pop(0) if phase_results else None
+            result = phase_results.pop(0) if phase_results else None
+            if result is None and failures is not None:
+                failures.append(dict(
+                    phase=phase, backend=bk,
+                    timeout_s=round(timeout_s, 1), reason="timeout",
+                    attempt=1,
+                ))
+            return result
 
         monkeypatch.setattr(bench_mod, "_probe_backend", lambda: backend)
         monkeypatch.setattr(bench_mod, "_run_phase", fake_run_phase)
@@ -198,3 +205,23 @@ class TestMainOrchestration:
         ev = result.get("strongest_committed_tpu_evidence")
         assert ev is not None and ev["backend"] == "tpu"
         assert ev["docs_per_s"] > 0
+
+    def test_degraded_record_names_abandoned_accel_attempts(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """ISSUE 6 satellite: a CPU-degraded headline must record WHY —
+        the abandoned accelerator attempts with their sub-deadlines and
+        reasons (accel_timeout_phase / accel_attempts), so r03-r05-style
+        silent CPU numbers cannot recur."""
+        cpu_summary = {"metric": "m", "value": 1.0, "backend": "cpu"}
+        result, _ = self._run_main(
+            monkeypatch, capsys, [None, None, cpu_summary, None],
+            artifact_dir=tmp_path / "missing",
+        )
+        assert result["backend"] == "cpu"
+        assert result["provenance"] == "live-cpu-degraded"
+        assert result["accel_timeout_phase"] == "run"
+        attempts = result["accel_attempts"]
+        assert attempts and all(a["phase"] == "run" for a in attempts)
+        assert all(a["reason"] == "timeout" for a in attempts)
+        assert all(a["timeout_s"] > 0 for a in attempts)

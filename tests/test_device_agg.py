@@ -1,0 +1,637 @@
+"""Device-resident aggregation data plane (tier-1, ISSUE 6).
+
+Parity is the contract (README "Device-resident aggregation"): the numpy
+implementations in ``aggregation.py``/``sanitize.py`` are the oracle, and
+the device backend — stacked snapshots, ``shard_map``-sharded gate
+statistics and robust estimators — must reproduce them: weighted mean
+bitwise in float32, trimmed mean / median / Krum to 1e-6, and identical
+UpdateGate admission decisions. The suite runs on the 8-virtual-device
+CPU mesh (conftest), so the real mesh path is the code under test even
+without an accelerator.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser
+from gfedntm_tpu.federation import codec
+from gfedntm_tpu.federation.aggregation import (
+    Krum,
+    Median,
+    TrimmedMean,
+    WeightedMean,
+    krum_select,
+    make_aggregator,
+    weighted_mean,
+)
+from gfedntm_tpu.federation.device_agg import (
+    DeviceAggEngine,
+    FlatPlane,
+    StackedRound,
+    stack_round,
+)
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import DROPPED, SUSPECT
+from gfedntm_tpu.federation.sanitize import UpdateGate, update_norm
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+TEMPLATE = {
+    "a": np.zeros((6, 9), np.float32),
+    "b": np.zeros((17,), np.float32),
+    "n": np.zeros((), np.int32),  # num_batches_tracked-style int scalar
+}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DeviceAggEngine()
+
+
+@pytest.fixture(scope="module")
+def plane():
+    return FlatPlane(TEMPLATE)
+
+
+def _snap(rng, scale=1.0, around=None):
+    base = around or {k: np.zeros_like(v) for k, v in TEMPLATE.items()}
+    return {
+        "a": (base["a"] + scale * rng.normal(size=(6, 9))).astype(np.float32),
+        "b": (base["b"] + scale * rng.normal(size=(17,))).astype(np.float32),
+        "n": np.int32(rng.integers(0, 7)),
+    }
+
+
+def _pairs(n=5, seed=0, weights=None):
+    rng = np.random.default_rng(seed)
+    weights = weights or [3.0, 1.0, 2.5, 4.0, 1.5, 2.0, 0.5, 6.0][:n]
+    return [(float(w), _snap(rng)) for w in weights]
+
+
+def _assert_estimates_equal(dev, ref, *, bitwise_f32=False):
+    assert set(dev) == set(ref)
+    for k in ref:
+        r, d = np.asarray(ref[k]), np.asarray(dev[k])
+        assert r.dtype == d.dtype, (k, r.dtype, d.dtype)
+        assert r.shape == d.shape
+        if bitwise_f32 and r.dtype == np.float32:
+            assert np.array_equal(
+                r.view(np.uint32), d.view(np.uint32)
+            ), (k, float(np.max(np.abs(r - d))))
+        else:
+            np.testing.assert_allclose(
+                d.astype(np.float64), r.astype(np.float64),
+                rtol=2e-6, atol=2e-6, err_msg=k,
+            )
+
+
+# ---- flat plane --------------------------------------------------------------
+
+class TestFlatPlane:
+    def test_layout_and_roundtrip(self, plane):
+        assert plane.keys == sorted(TEMPLATE)  # the _stacked/Krum order
+        assert plane.dim == 6 * 9 + 17 + 1
+        assert plane.non_f32_keys == ["n"]
+        snap = _snap(np.random.default_rng(3))
+        vec = plane.flatten(snap)
+        back = plane.unflatten(vec)
+        for k in TEMPLATE:
+            assert np.asarray(back[k]).dtype == np.asarray(snap[k]).dtype
+            np.testing.assert_array_equal(
+                np.asarray(back[k], np.float64),
+                np.asarray(snap[k], np.float64),
+            )
+
+    def test_stack_pads_to_mesh_multiple(self, engine, plane):
+        mat = engine.stack(plane, [s for _w, s in _pairs(3)])
+        assert mat.shape[0] == 3
+        assert mat.shape[1] % engine.n_shards == 0
+        assert mat.shape[1] >= plane.dim
+
+
+# ---- estimator parity --------------------------------------------------------
+
+class TestEstimatorParity:
+    def _stacked(self, engine, plane, pairs):
+        return stack_round(engine, plane, pairs)
+
+    def test_weighted_mean_bitwise_f32(self, engine, plane):
+        pairs = _pairs(5)
+        sr = self._stacked(engine, plane, pairs)
+        _assert_estimates_equal(
+            WeightedMean()(sr), weighted_mean(pairs), bitwise_f32=True,
+        )
+
+    def test_weighted_mean_weights_matter_and_int_semantics(
+        self, engine, plane
+    ):
+        # Distinct, uneven weights: the device path must use them in the
+        # same order and rounding as the numpy chain (bitwise), and the
+        # int32 key must keep weighted_mean's numpy dtype semantics
+        # (int tensors average to float64 — no cast back).
+        pairs = _pairs(6, seed=9, weights=[10.0, 0.25, 7.5, 1.0, 3.0, 0.5])
+        sr = self._stacked(engine, plane, pairs)
+        dev, ref = WeightedMean()(sr), weighted_mean(pairs)
+        _assert_estimates_equal(dev, ref, bitwise_f32=True)
+        assert np.asarray(ref["n"]).dtype == np.float64
+        assert np.asarray(dev["n"]).dtype == np.float64
+
+    @pytest.mark.parametrize("n,frac", [(4, 0.25), (5, 0.2), (8, 0.3)])
+    def test_trimmed_mean_parity(self, engine, plane, n, frac):
+        pairs = _pairs(n, seed=n)
+        sr = self._stacked(engine, plane, pairs)
+        est = TrimmedMean(frac)
+        _assert_estimates_equal(est(sr), est(pairs))
+
+    @pytest.mark.parametrize("n", [3, 4, 5, 8])
+    def test_median_parity(self, engine, plane, n):
+        pairs = _pairs(n, seed=10 + n)
+        sr = self._stacked(engine, plane, pairs)
+        _assert_estimates_equal(Median()(sr), Median()(pairs))
+
+    def test_median_even_cohort_averages_middles(self, engine, plane):
+        pairs = _pairs(4, seed=2)
+        sr = self._stacked(engine, plane, pairs)
+        _assert_estimates_equal(Median()(sr), Median()(pairs))
+
+    def test_krum_parity_and_neighbor_selection(self, engine, plane):
+        rng = np.random.default_rng(7)
+        honest = [(2.0, _snap(rng, scale=0.1)) for _ in range(4)]
+        attacker = (9.0, _snap(rng, scale=50.0))
+        pairs = honest + [attacker]
+        sr = self._stacked(engine, plane, pairs)
+        est = Krum(1)
+        _assert_estimates_equal(est(sr), est(pairs))
+        # Selection parity, directly: the device gram-identity distances
+        # must pick the same neighbors the numpy flat distances pick.
+        flat = np.stack([
+            np.concatenate([
+                np.asarray(s[k], np.float32).ravel() for k in sorted(s)
+            ]) for _w, s in pairs
+        ])
+        sq = np.einsum("ij,ij->i", flat, flat)
+        d2_np = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)
+        chosen_np = krum_select(d2_np, len(pairs), 1)
+        chosen_dev = krum_select(engine.krum_d2(sr), len(pairs), 1)
+        np.testing.assert_array_equal(chosen_np, chosen_dev)
+        assert len(pairs) - 1 not in chosen_np  # attacker never selected
+
+    def test_krum_never_selects_nonfinite_row(self, engine, plane):
+        rng = np.random.default_rng(8)
+        pairs = [(1.0, _snap(rng, scale=0.1)) for _ in range(4)]
+        bad = _snap(rng, scale=0.1)
+        bad["a"] = bad["a"].copy()
+        bad["a"][0, 0] = np.nan
+        pairs.append((5.0, bad))
+        sr = self._stacked(engine, plane, pairs)
+        est = Krum(1)
+        _assert_estimates_equal(est(sr), est(pairs))
+        chosen = krum_select(engine.krum_d2(sr), len(pairs), 1)
+        assert len(pairs) - 1 not in chosen
+
+    def test_nonfinite_rows_in_coordinate_estimators(self, engine, plane):
+        # With the gate off, NaN rows can reach the estimators; numpy
+        # sorts NaN last (so the trim may drop it) — the device sort
+        # must agree coordinate for coordinate.
+        rng = np.random.default_rng(11)
+        pairs = [(1.0, _snap(rng)) for _ in range(4)]
+        bad = _snap(rng)
+        bad["a"] = bad["a"].copy()
+        bad["a"][2, 3] = np.inf
+        pairs.append((1.0, bad))
+        sr = self._stacked(engine, plane, pairs)
+        est = TrimmedMean(0.2)
+        _assert_estimates_equal(est(sr), est(pairs))
+
+    def test_krum_tiny_cohort_falls_back_to_median(self, engine, plane):
+        pairs = _pairs(2, seed=1)
+        sr = self._stacked(engine, plane, pairs)
+        _assert_estimates_equal(Krum(1)(sr), Krum(1)(pairs))
+        _assert_estimates_equal(Krum(1)(sr), Median()(pairs))
+
+    def test_subset_gathers_rows(self, engine, plane):
+        pairs = _pairs(5, seed=12)
+        sr = self._stacked(engine, plane, pairs)
+        sub = sr.subset([0, 2, 4])
+        assert len(sub) == 3
+        assert sub.weights == [pairs[0][0], pairs[2][0], pairs[4][0]]
+        _assert_estimates_equal(
+            WeightedMean()(sub),
+            weighted_mean([pairs[0], pairs[2], pairs[4]]),
+            bitwise_f32=True,
+        )
+
+    def test_aggregators_compose_with_stacked_rounds(self, engine, plane):
+        pairs = _pairs(5, seed=13)
+        sr = self._stacked(engine, plane, pairs)
+        rng = np.random.default_rng(14)
+        current = _snap(rng)
+        for spec, robust in [
+            ("fedavg", None), ("fedavgm", None),
+            ("fedadam", "median"), ("fedyogi", "trimmed_mean:0.2"),
+            ("fedavg", "krum:1"),
+        ]:
+            a_np = make_aggregator(spec, robust=robust).aggregate(
+                pairs, current_global=current
+            )
+            a_dev = make_aggregator(spec, robust=robust).aggregate(
+                sr, current_global=current
+            )
+            _assert_estimates_equal(
+                a_dev, a_np, bitwise_f32=(spec, robust) == ("fedavg", None),
+            )
+
+
+# ---- gate statistic parity ---------------------------------------------------
+
+def _gate(device_engine=None, **kw):
+    base = dict(mad_k=4.0, min_cohort=3)
+    base.update(kw)
+    g = UpdateGate(**base)
+    g.set_template(TEMPLATE)
+    if device_engine is not None:
+        g.set_engine(device_engine)
+    return g
+
+
+def _decisions(result):
+    return (
+        [c for c, _w, _s in result.accepted],
+        [(r.client_id, r.reason) for r in result.rejected],
+        [c for c, _n, _m in result.clipped],
+    )
+
+
+class TestGateParity:
+    def _cohort(self, seed=21):
+        rng = np.random.default_rng(seed)
+        glob = _snap(rng)
+        cands = []
+        for cid in range(5):  # tight cohort around the global
+            cands.append(
+                (cid, 10.0 + cid, {
+                    "a": (glob["a"] + 0.01 * rng.normal(size=(6, 9))
+                          ).astype(np.float32),
+                    "b": (glob["b"] + 0.01 * rng.normal(size=(17,))
+                          ).astype(np.float32),
+                    "n": np.int32(2),
+                })
+            )
+        return glob, cands
+
+    def _both(self, engine, cands, glob, round_idx=0, **kw):
+        r_np = _gate(**kw).admit_round(
+            [(c, w, dict(s)) for c, w, s in cands], glob, round_idx
+        )
+        r_dev = _gate(device_engine=engine, **kw).admit_round(
+            [(c, w, dict(s)) for c, w, s in cands], glob, round_idx
+        )
+        return r_np, r_dev
+
+    def test_norm_parity(self, engine, plane):
+        glob, cands = self._cohort()
+        mat = engine.stack(plane, [s for _c, _w, s in cands])
+        gvec = engine.put_vector(plane, glob)
+        counts, norms = engine.gate_stats(mat, gvec)
+        assert not counts.any()
+        for i, (_c, _w, s) in enumerate(cands):
+            ref = update_norm(s, glob)
+            assert abs(norms[i] - ref) <= 1e-6 * max(ref, 1.0)
+
+    def test_clean_cohort_all_admitted(self, engine):
+        glob, cands = self._cohort()
+        r_np, r_dev = self._both(engine, cands, glob)
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert len(r_dev.accepted) == 5
+        assert r_dev.stacked is not None and len(r_dev.stacked) == 5
+        assert r_np.stacked is None  # numpy path never stacks
+
+    def test_mad_outlier_mask_parity(self, engine):
+        glob, cands = self._cohort()
+        rng = np.random.default_rng(31)
+        # One far outlier + one mild straggler: both backends must draw
+        # the SAME median+MAD threshold and reject the same set.
+        cands.append((90, 1.0, {
+            "a": (glob["a"] + 5.0 * rng.normal(size=(6, 9))
+                  ).astype(np.float32),
+            "b": glob["b"].copy(), "n": np.int32(2),
+        }))
+        cands.append((91, 1.0, {
+            "a": (glob["a"] + 0.05 * rng.normal(size=(6, 9))
+                  ).astype(np.float32),
+            "b": glob["b"].copy(), "n": np.int32(2),
+        }))
+        r_np, r_dev = self._both(engine, cands, glob)
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert (90, "norm_outlier") in _decisions(r_dev)[1]
+        # rejection norms agree to 1e-6 relative
+        norms_np = {r.client_id: r.norm for r in r_np.rejected}
+        norms_dev = {r.client_id: r.norm for r in r_dev.rejected}
+        for cid, n_ref in norms_np.items():
+            assert abs(norms_dev[cid] - n_ref) <= 1e-6 * max(n_ref, 1.0)
+
+    def test_nonfinite_and_conformance_parity(self, engine):
+        glob, cands = self._cohort()
+        nan_snap = {k: np.asarray(v).copy() for k, v in cands[1][2].items()}
+        nan_snap["b"][3] = np.nan
+        cands[1] = (cands[1][0], cands[1][1], nan_snap)
+        skew = {k: np.asarray(v) for k, v in cands[2][2].items()}
+        skew["a"] = skew["a"][:4]
+        cands[2] = (cands[2][0], cands[2][1], skew)
+        r_np, r_dev = self._both(engine, cands, glob)
+        assert _decisions(r_np) == _decisions(r_dev)
+        reasons = dict(_decisions(r_dev)[1])
+        assert reasons[1] == "nonfinite" and reasons[2] == "shape_skew"
+        # the numpy-style detail (which tensor, how many values) survives
+        detail = {r.client_id: r.detail for r in r_dev.rejected}[1]
+        assert "b" in detail and "non-finite" in detail
+
+    def test_clip_parity(self, engine):
+        glob, cands = self._cohort()
+        norms = [update_norm(s, glob) for _c, _w, s in cands]
+        cap = float(np.median(norms) * 0.8)  # forces clips, no rejections
+        r_np, r_dev = self._both(
+            engine, cands, glob, max_update_norm=cap, mad_k=0.0,
+        )
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert r_np.clipped  # the cap actually bit
+        # clipped snapshots match the numpy f64 clip to float tolerance,
+        # on the host dicts AND through the stacked estimator
+        for (c1, _w1, s1), (c2, _w2, s2) in zip(
+            r_np.accepted, r_dev.accepted
+        ):
+            assert c1 == c2
+            for k in s1:
+                np.testing.assert_allclose(
+                    np.asarray(s2[k], np.float64),
+                    np.asarray(s1[k], np.float64),
+                    rtol=1e-5, atol=1e-6,
+                )
+        a_np = weighted_mean([(w, s) for _c, w, s in r_np.accepted])
+        a_dev = WeightedMean()(r_dev.stacked)
+        _assert_estimates_equal(a_dev, a_np)
+
+    def test_f32_norm_overflow_row_matches_oracle(self, engine):
+        # Values finite in f32 whose squared sum overflows the f32 plane
+        # accumulator (~1e20 coordinates): the device gate recomputes the
+        # f64 norm on the host, so the decision AND the recorded norm are
+        # the oracle's — rejected via the cohort screen when it is on,
+        # CLIPPED AND ADMITTED (not rejected) when only the hard cap is.
+        glob, cands = self._cohort()
+        big = {
+            "a": np.full((6, 9), 1e20, np.float32),
+            "b": glob["b"].copy(), "n": np.int32(2),
+        }
+        cands.append((77, 1.0, big))
+        r_np, r_dev = self._both(engine, cands, glob)
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert (77, "norm_outlier") in _decisions(r_dev)[1]
+        n_np = {r.client_id: r.norm for r in r_np.rejected}[77]
+        n_dev = {r.client_id: r.norm for r in r_dev.rejected}[77]
+        assert np.isfinite(n_dev) and abs(n_dev - n_np) <= 1e-6 * n_np
+        r_np2, r_dev2 = self._both(
+            engine, cands, glob, mad_k=0.0, max_update_norm=1.0,
+        )
+        assert _decisions(r_np2) == _decisions(r_dev2)
+        assert 77 in [c for c, _n, _m in r_dev2.clipped]
+        assert not r_dev2.rejected
+
+    def test_clip_leaves_nonclipped_rows_bitwise(self, engine, plane):
+        glob, cands = self._cohort()
+        norms = [update_norm(s, glob) for _c, _w, s in cands]
+        # midway between the two largest norms: only the max-norm row
+        # clips, robustly to the f32-plane norm's ~1e-7 relative noise
+        cap = float((sorted(norms)[-2] + sorted(norms)[-1]) / 2.0)
+        g = _gate(device_engine=engine, max_update_norm=cap, mad_k=0.0)
+        r = g.admit_round([(c, w, dict(s)) for c, w, s in cands], glob, 0)
+        clipped_ids = {c for c, _n, _m in r.clipped}
+        assert len(clipped_ids) == 1
+        rows = np.asarray(r.stacked.mat)[:, :plane.dim]
+        for i, (cid, _w, snap) in enumerate(r.accepted):
+            if cid in clipped_ids:
+                continue
+            ref = plane.flatten({k: np.asarray(v) for k, v in snap.items()})
+            assert np.array_equal(
+                rows[i].view(np.uint32), ref.view(np.uint32)
+            ), cid
+
+    def test_check_finite_off_parity(self, engine):
+        glob, cands = self._cohort()
+        nan_snap = {k: np.asarray(v).copy() for k, v in cands[0][2].items()}
+        nan_snap["a"][0, 0] = np.nan
+        cands[0] = (cands[0][0], cands[0][1], nan_snap)
+        r_np, r_dev = self._both(
+            engine, cands, glob, check_finite=False, max_update_norm=1e-3,
+        )
+        # pre-PR5 semantics: NaN passes, and with check_finite off the
+        # norm stage (screen + clip) is disabled on both backends
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert len(r_dev.accepted) == 5 and not r_dev.clipped
+
+    def test_mad_zero_disables_screen_parity(self, engine):
+        glob, cands = self._cohort()
+        rng = np.random.default_rng(5)
+        cands.append((99, 1.0, {
+            "a": (glob["a"] + 100.0 * rng.normal(size=(6, 9))
+                  ).astype(np.float32),
+            "b": glob["b"].copy(), "n": np.int32(2),
+        }))
+        r_np, r_dev = self._both(engine, cands, glob, mad_k=0.0)
+        assert _decisions(r_np) == _decisions(r_dev)
+        assert len(r_dev.accepted) == 6  # outlier admitted: screen off
+
+    def test_streak_accounting_parity(self, engine):
+        glob, cands = self._cohort()
+        nan_snap = {k: np.asarray(v).copy() for k, v in cands[0][2].items()}
+        nan_snap["a"][0, 0] = np.nan
+        bad = (cands[0][0], cands[0][1], nan_snap)
+        g_np, g_dev = _gate(), _gate(device_engine=engine)
+        for r in range(2):
+            g_np.admit_round([bad] + cands[1:], glob, r)
+            g_dev.admit_round([bad] + cands[1:], glob, r)
+            assert g_np.consecutive(0) == g_dev.consecutive(0) == r + 1
+        g_np.admit_round(cands, glob, 2)
+        g_dev.admit_round(cands, glob, 2)
+        assert g_np.consecutive(0) == g_dev.consecutive(0) == 0
+        assert g_np.total_rejections == g_dev.total_rejections
+
+
+# ---- server backend seam -----------------------------------------------------
+
+class TestServerBackendSeam:
+    def _server(self, **kw):
+        base = dict(min_clients=1, family="avitm",
+                    model_kwargs=MODEL_KWARGS,
+                    metrics=MetricsLogger(validate=True))
+        base.update(kw)
+        server = FederatedServer(**base)
+        server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+        return server
+
+    def _reply(self, client_id, snap, loss=1.0):
+        return pb.StepReply(
+            client_id=client_id, shared=codec.flatdict_to_bundle(snap),
+            loss=loss, nr_samples=4.0,
+        )
+
+    def test_invalid_backend_rejected(self):
+        with pytest.raises(ValueError):
+            self._server(aggregation_backend="gpu")
+
+    def test_auto_resolves_numpy_on_cpu(self):
+        server = self._server(aggregation_backend="auto")
+        server._ensure_template()
+        assert server._agg_backend_resolved == "numpy"
+        assert server.update_gate._engine is None
+
+    def test_forced_device_attaches_engine(self):
+        server = self._server(aggregation_backend="device")
+        server._ensure_template()
+        assert server._agg_backend_resolved == "device"
+        assert server.update_gate._engine is not None
+        assert server.metrics.registry.gauge("agg_backend_device").value == 1.0
+
+    def test_collect_snapshots_returns_stacked_round(self):
+        from gfedntm_tpu.federation.registry import ClientRecord
+
+        server = self._server(aggregation_backend="device")
+        server.federation.connect_vocab(1, ("a",), 4.0)
+        server.federation.connect_ready(1, "localhost:1")
+        rec = server.federation.get_clients()[0]
+        rec2 = ClientRecord(2, nr_samples=4.0)
+        tmpl = server._shared_template()
+        out = server._collect_snapshots(
+            [(rec, self._reply(1, tmpl)), (rec2, self._reply(2, tmpl))],
+            iteration=0,
+        )
+        assert isinstance(out, StackedRound) and len(out) == 2
+        avg = server.aggregator.aggregate(
+            out, current_global=server._current_global()
+        )
+        ref = weighted_mean([(4.0, tmpl), (4.0, tmpl)])
+        _assert_estimates_equal(avg, ref, bitwise_f32=True)
+
+    def test_device_poisoned_admission_matches_numpy(self):
+        """The TestServerAdmission NaN→probation→drop ladder, on the
+        device backend: identical per-round decisions and counters."""
+        from gfedntm_tpu.federation.registry import ClientRecord
+
+        server = self._server(
+            aggregation_backend="device", probation_rounds=2,
+        )
+        server.federation.connect_vocab(1, ("a",), 4.0)
+        server.federation.connect_ready(1, "localhost:1")
+        rec = server.federation.get_clients()[0]
+        tmpl = server._shared_template()
+        poisoned = {
+            k: np.full_like(v, np.nan) if v.dtype.kind == "f" else v
+            for k, v in tmpl.items()
+        }
+        good = ClientRecord(2, nr_samples=4.0)
+        for it, (status_after, streak) in enumerate(
+            [("active", 1), (SUSPECT, 2), (DROPPED, 3)]
+        ):
+            out = server._collect_snapshots(
+                [(rec, self._reply(1, poisoned)),
+                 (good, self._reply(2, tmpl))], iteration=it,
+            )
+            assert len(out) == 1
+            assert rec.status == status_after
+        assert server.metrics.registry.counter(
+            "updates_rejected"
+        ).value == 3
+
+
+# ---- CLI ---------------------------------------------------------------------
+
+def test_parser_agg_backend_flag():
+    p = build_parser()
+    assert p.parse_args([]).agg_backend == "auto"
+    assert p.parse_args(
+        ["--agg_backend", "device"]
+    ).agg_backend == "device"
+    with pytest.raises(SystemExit):
+        p.parse_args(["--agg_backend", "gpu"])
+
+
+# ---- e2e federations: device backend vs numpy backend ------------------------
+
+def _import_federation_helpers():
+    # Shared chaos harness from the PR 5 suite (same directory, imported
+    # under pytest's prepend import mode).
+    from test_data_plane import _corpora, _run_federation
+
+    return _corpora, _run_federation
+
+
+def test_e2e_device_backend_matches_numpy_betas(tmp_path):
+    """ISSUE 6 acceptance: a 4-client federation on the device backend
+    produces the same betas as the numpy backend — FedAvg's weighted mean
+    is bitwise on the plane, so the runs should track each other to float
+    noise from the clients' own training."""
+    _corpora, _run_federation = _import_federation_helpers()
+    corpora = _corpora(4, docs=16, seed=7)
+    kwargs = dict(MODEL_KWARGS, num_epochs=1)
+    server_np, _ = _run_federation(
+        tmp_path, corpora, "e2e-numpy",
+        model_kwargs=kwargs, aggregation_backend="numpy",
+    )
+    server_dev, _ = _run_federation(
+        tmp_path, corpora, "e2e-device",
+        model_kwargs=kwargs, aggregation_backend="device",
+    )
+    assert server_np.global_betas is not None
+    assert server_dev.global_betas is not None
+    assert np.isfinite(server_dev.global_betas).all()
+    np.testing.assert_allclose(
+        server_dev.global_betas, server_np.global_betas,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.chaos
+def test_poisoned_client_chaos_on_device_backend(tmp_path):
+    """The PR 5 poisoned-client chaos scenario re-run with
+    backend="device": client 4 emits 100x-scaled updates, the device
+    gate rejects them (norm_outlier), the poisoned client lands in
+    probation with reason="poisoned", and the final model matches the
+    honest 3-client baseline run on the NUMPY backend — the chaos
+    guarantee carries across the backend seam."""
+    _corpora, _run_federation = _import_federation_helpers()
+    corpora = _corpora(4, docs=24, seed=5)
+    baseline_server, _ = _run_federation(
+        tmp_path, corpora[:3], "dev-base",
+        robust_aggregator="trimmed_mean:0.25", outlier_mad_k=6.0,
+        aggregation_backend="numpy",
+    )
+    base_betas = baseline_server.global_betas
+    assert base_betas is not None and np.isfinite(base_betas).all()
+
+    metrics = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, corpora, "dev-poison", metrics=metrics,
+        poisoned_peer="client4", payload="scale:100",
+        robust_aggregator="trimmed_mean:0.25", outlier_mad_k=6.0,
+        aggregation_backend="device",
+    )
+    assert server._agg_backend_resolved == "device"
+    assert server.global_betas is not None
+    np.testing.assert_allclose(
+        server.global_betas, base_betas, rtol=1e-4, atol=1e-5,
+    )
+    rejections = metrics.events("update_rejected")
+    assert rejections and all(
+        e["client"] == 4 and e["reason"] == "norm_outlier"
+        for e in rejections
+    )
+    rec = {r.client_id: r for r in server.federation.get_clients()}[4]
+    assert rec.status in (SUSPECT, DROPPED)
+    assert rec.suspect_reason == "poisoned"
+    for c in clients[:3]:
+        assert c.stepper.finished

@@ -505,14 +505,16 @@ class TestVShardedFused:
             (np.random.default_rng(seed).random(b) > 0.2), jnp.float32
         )
 
+        from gfedntm_tpu.parallel.mesh import shard_map_compat
+
         sharded = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 partial(
                     prodlda_recon_loss_vsharded,
                     model_axis=model_axis, data_axis=data_axis,
                     training=True, interpret=True,
                 ),
-                mesh=mesh,
+                mesh,
                 in_specs=(
                     P(data_axis, None), P(None, model_axis),
                     P(data_axis, model_axis), P(model_axis), P(model_axis),
@@ -521,7 +523,7 @@ class TestVShardedFused:
                 out_specs=(
                     P(data_axis), P(model_axis), P(model_axis)
                 ),
-                check_vma=False,
+                check=False,
             )
         )
         return sharded(theta, beta, x, rm, rv, mask), (theta, beta, x, rm, rv, mask)
@@ -563,19 +565,21 @@ class TestVShardedFused:
         theta, beta, x, rm, rv = make_inputs(b, k, v)
         mask = jnp.asarray([1.0] * 10 + [0.0] * 2, jnp.float32)
 
-        inner = jax.shard_map(
+        from gfedntm_tpu.parallel.mesh import shard_map_compat
+
+        inner = shard_map_compat(
             partial(
                 prodlda_recon_loss_vsharded,
                 model_axis="model", data_axis=data_axis,
                 training=True, interpret=True,
             ),
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(data_axis, None), P(None, "model"),
                 P(data_axis, "model"), P("model"), P("model"), P(data_axis),
             ),
             out_specs=(P(data_axis), P("model"), P("model")),
-            check_vma=False,
+            check=False,
         )
 
         def loss_sharded(th, bt):
@@ -749,19 +753,21 @@ class TestVShardedBf16Storage:
         mask = jnp.ones((b,), jnp.float32)
         q = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
 
-        inner = jax.shard_map(
+        from gfedntm_tpu.parallel.mesh import shard_map_compat
+
+        inner = shard_map_compat(
             partial(
                 prodlda_recon_loss_vsharded,
                 model_axis="model", data_axis=None,
                 training=True, interpret=True, storage_dtype="bfloat16",
             ),
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(None, None), P(None, "model"), P(None, "model"),
                 P("model"), P("model"), P(None),
             ),
             out_specs=(P(None), P("model"), P("model")),
-            check_vma=False,
+            check=False,
         )
 
         def loss_sharded(th, bt):
@@ -783,3 +789,85 @@ class TestVShardedBf16Storage:
         assert abs(float(lf) - float(lr)) / abs(float(lr)) < 1e-4
         for a, c in zip(gf, gr):
             np.testing.assert_allclose(a, c, rtol=2e-4, atol=2e-4)
+
+
+class TestLargeVBlockSpecRegression:
+    """BENCH_r02 ``fused_largev_error`` regression (ISSUE 6 satellite): the
+    round-2 kernel emitted the online-softmax accumulators as a
+    ``[B, n_tiles]`` partials array with ``(B, 1)`` blocks, which Mosaic
+    rejects whenever ``n_tiles > 1`` ("block shape (64, 1), array shape
+    (64, 8)" at B=64, 8 V-tiles). The redesigned kernels keep m/s as full
+    ``(B_pad, 1)`` arrays; these tests pin (a) the static Mosaic legality
+    of every block spec at the failing geometry and (b) interpret-mode
+    parity through the exact multi-tile grid that produced the error."""
+
+    R02_B, R02_K = 64, 50  # the bench soak's failing batch/topic geometry
+
+    def test_blockspecs_mosaic_legal_at_r02_geometry(self, monkeypatch):
+        from gfedntm_tpu.ops.fused_decoder import (
+            assert_mosaic_legal,
+            pass_block_geometry,
+            resolve_tile_v,
+        )
+
+        monkeypatch.delenv("GFEDNTM_FUSED_TILE_V", raising=False)
+        # The literal r02 failing config (V=16384, B=64: 8 tiles of 2048
+        # under the round-2 cap) plus the full soak sweep grid.
+        for v in (16384, 50_000, 100_000):
+            for b in (self.R02_B, 256):
+                for storage in ("float32", "bfloat16"):
+                    assert_mosaic_legal(b, self.R02_K, v, storage)
+        # The specific shape from the recorded error: 8 V-tiles at B=64.
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "2048")
+        assert resolve_tile_v(16384, self.R02_B, self.R02_K) == 2048
+        geom = pass_block_geometry(self.R02_B, self.R02_K, 16384)
+        assert_mosaic_legal(self.R02_B, self.R02_K, 16384)
+        # The r02 failure was outputs[2] of _stats_kernel (the softmax
+        # max accumulator): it must be a full-array block, never a
+        # 1-lane slice of an [B, n_tiles] partials array.
+        block, array = geom["stats.m"]
+        assert block == array == (64, 1)
+
+    def test_stats_outputs_are_full_array_accumulators(self):
+        from gfedntm_tpu.ops.fused_decoder import pass_block_geometry
+
+        for name in ("stats.m", "stats.s", "loss.out", "loss.rd"):
+            block, array = pass_block_geometry(
+                self.R02_B, self.R02_K, 100_000
+            )[name]
+            assert block == array, (name, block, array)
+
+    def test_interpret_parity_at_r02_multi_tile_grid(self, monkeypatch):
+        # n_tiles=8 at B=64 — the exact grid class of the recorded error,
+        # shrunk via the tile override so interpret mode stays fast while
+        # the multi-tile accumulator path (the code the bug lived in) is
+        # the one that runs.
+        monkeypatch.setenv("GFEDNTM_FUSED_TILE_V", "128")
+        v = 8 * 128
+        theta, beta, x, rm, rv = make_inputs(self.R02_B, self.R02_K, v)
+
+        def loss_fused(th, bt):
+            rl, _, _ = prodlda_recon_loss(
+                th, bt, x, rm, rv, None, True, 1e-5, 1e-10, True
+            )
+            return jnp.sum(rl)
+
+        def loss_ref(th, bt):
+            rl, _, _ = prodlda_recon_loss_reference(
+                th, bt, x, rm, rv, None, True
+            )
+            return jnp.sum(rl)
+
+        lf, gf = jax.value_and_grad(loss_fused, argnums=(0, 1))(theta, beta)
+        lr, gr = jax.value_and_grad(loss_ref, argnums=(0, 1))(theta, beta)
+        assert abs(float(lf) - float(lr)) / abs(float(lr)) < 1e-4
+        # Grad tolerance is loose-ish: fused-vs-unfused f32 differences at
+        # B=64 x V=1024 are summation-order noise (see bench._fused_case's
+        # f64-oracle criterion); a broken multi-tile accumulator is off by
+        # orders of magnitude, not 1e-3 relative.
+        for a, b_ in zip(gf, gr):
+            scale = float(np.max(np.abs(np.asarray(b_))))
+            np.testing.assert_allclose(
+                np.asarray(a) / scale, np.asarray(b_) / scale,
+                atol=2e-5,
+            )
