@@ -903,6 +903,12 @@ def main() -> None:
             )
     if summary is not None:
         summary["provenance"] = "live"
+        if accel_failures:
+            # The escalated retry succeeded, but the abandoned first
+            # attempt is still part of the round's story (each record
+            # carries its phase/deadline/reason) — a live summary after
+            # a timeout must not erase the timeout.
+            summary["accel_attempts"] = accel_failures
         if summary.get("backend") == "tpu":
             _persist_tpu_artifact(summary)
     if summary is None and backend != "cpu":

@@ -6,13 +6,18 @@ reference cannot express: ``--id`` omitted runs the WHOLE federation as one
 SPMD program on the local device mesh (``simulate``), where the gRPC
 hub-and-spoke collapses into ``lax.psum`` over ICI.
 
-Two more entry points read telemetry instead of producing it:
+Three more entry points read telemetry instead of producing it:
 ``python -m gfedntm_tpu.cli summarize <metrics.jsonl>`` renders a run
 report (phase breakdown, p50/p95/p99 step time, bytes moved per round,
 slowest client) from the JSONL stream every role writes to its save dir,
-and ``python -m gfedntm_tpu.cli trace <server.jsonl> <client*.jsonl> -o
+``python -m gfedntm_tpu.cli trace <server.jsonl> <client*.jsonl> -o
 trace.json`` merges the per-node streams into one clock-aligned Chrome
-trace-event file (README "Distributed tracing & ops endpoint").
+trace-event file (README "Distributed tracing & ops endpoint"), and
+``python -m gfedntm_tpu.cli report <metrics.jsonl>`` renders the
+model-health report — coherence/diversity/drift trajectory, per-client
+contribution table, data-plane rejections — with an
+``--assert-monotone-coherence`` CI gate (README "Model-quality
+observability").
 
 Data paths mirror ``main.py:138-152``: synthetic ``.npz`` archives (node
 ``id-1`` of a multi-node archive) or real ``.parquet`` filtered by ``--fos``.
@@ -47,7 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
             "report from a run's JSONL stream (see README 'Telemetry'); "
             "'trace <metrics.jsonl>...' merges per-node streams into one "
             "Chrome trace-event file (README 'Distributed tracing & ops "
-            "endpoint')."
+            "endpoint'); 'report <metrics.jsonl>' renders the model-"
+            "quality report — coherence/drift trajectory, per-client "
+            "contributions (README 'Model-quality observability')."
         ),
     )
     p.add_argument("--id", type=int, default=None,
@@ -172,6 +179,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="half-open round window for --profile_dir, "
                         "'start:stop' or a single round (default '1:2' — "
                         "skips the compile-dominated round 0)")
+    # Model-quality observability plane (README "Model-quality
+    # observability"): live topic coherence / drift / per-client
+    # contribution telemetry over the global model.
+    p.add_argument("--quality_every", type=int, default=0,
+                   help="server mode: compute topic quality (NPMI "
+                        "coherence vs --quality_ref, diversity, "
+                        "round-over-round drift) every K averaged rounds "
+                        "and run per-client contribution analytics "
+                        "(default 0 = the plane is off and the round "
+                        "loop is untouched)")
+    p.add_argument("--quality_ref", type=str, default=None,
+                   help="server mode: server-held reference corpus for "
+                        "NPMI co-occurrence (.npz synthetic archive, "
+                        ".parquet, or plain text with one document per "
+                        "line); without it coherence and the quality "
+                        "guard are disabled, diversity/drift still run")
+    p.add_argument("--quality_topn", type=int, default=10,
+                   help="top words per topic for coherence/diversity/"
+                        "drift (default 10)")
+    p.add_argument("--quality_guard", action="store_true",
+                   help="server mode: route a sustained relative topic-"
+                        "coherence drop (vs its healthy-round EWMA) "
+                        "through the divergence-rollback path, reason "
+                        "'coherence_collapse' (needs --quality_every > 0 "
+                        "and --quality_ref)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -305,6 +337,10 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         wire_codec=getattr(args, "wire_codec", None) or "none",
         ops_port=getattr(args, "ops_port", None),
         profiler=profiler,
+        quality_every=getattr(args, "quality_every", 0),
+        quality_ref=getattr(args, "quality_ref", None),
+        quality_topn=getattr(args, "quality_topn", 10),
+        quality_guard=getattr(args, "quality_guard", False),
     )
     if getattr(args, "resume", False):
         from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
@@ -528,6 +564,62 @@ def run_summarize(argv: list[str]) -> int:
     return 0
 
 
+# ---- model-health report (`report` subcommand) ------------------------------
+
+def run_report(argv: list[str]) -> int:
+    """``report <metrics.jsonl>``: render a round-by-round model-health
+    report from the telemetry stream — coherence/diversity/drift
+    trajectory, per-client contribution table, admission-gate rejections,
+    rollbacks (README "Model-quality observability"). With
+    ``--assert-monotone-coherence <tol>`` the command exits non-zero when
+    NPMI ever falls more than ``tol`` below its running peak, so CI and
+    the scenario harness can gate on model quality."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu report",
+        description="Render a model-quality report from a run's "
+                    "metrics.jsonl (requires the run to have used "
+                    "--quality_every > 0).",
+    )
+    p.add_argument("path", help="path to a run's metrics.jsonl")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the aggregated quality dict as JSON")
+    p.add_argument("--assert-monotone-coherence", dest="monotone_tol",
+                   type=float, default=None, metavar="TOL",
+                   help="fail (exit 1) if NPMI coherence ever drops more "
+                        "than TOL below its running maximum")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.utils.observability import (
+        check_monotone_coherence,
+        format_quality_report,
+        read_metrics,
+        summarize_model_quality,
+    )
+
+    try:
+        records = read_metrics(args.path)
+    except FileNotFoundError:
+        raise SystemExit(f"no such metrics file: {args.path}")
+    summary = summarize_model_quality(records)
+    if args.json_out:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
+        )
+        with open(args.json_out, "w") as fh:
+            json.dump(summary, fh, indent=1, default=float)
+    print(format_quality_report(summary))
+    if args.monotone_tol is not None:
+        violations = check_monotone_coherence(summary, args.monotone_tol)
+        if violations:
+            for v in violations:
+                print(f"coherence check FAILED: {v}", file=sys.stderr)
+            return 1
+        print(
+            f"coherence check passed (tolerance {args.monotone_tol:g})"
+        )
+    return 0
+
+
 # ---- cross-node trace merge (`trace` subcommand) ----------------------------
 
 def _node_name_for(path: str, records: list[dict[str, Any]]) -> str:
@@ -607,6 +699,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_summarize(argv[1:])
     if argv and argv[0] == "trace":
         return run_trace(argv[1:])
+    if argv and argv[0] == "report":
+        return run_report(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -67,6 +67,8 @@ __all__ = [
     "Krum",
     "krum_select",
     "make_estimator",
+    "contribution_stats",
+    "contribution_from_gram",
 ]
 
 def weighted_mean(snapshots) -> dict[str, np.ndarray]:
@@ -282,6 +284,60 @@ def make_estimator(
     if cls is Krum:
         return cls(int(arg))
     raise ValueError(f"estimator {name!r} takes no {arg!r} argument")
+
+
+# ---- per-client contribution analytics (model-quality plane) ----------------
+
+def contribution_from_gram(
+    dots: np.ndarray,
+) -> "tuple[np.ndarray, np.ndarray, float, float]":
+    """Finish contribution analytics from an ``[N+1, N+1]`` gram matrix of
+    the update rows ``(u_1, ..., u_N, u_agg)`` where ``u_i = snapshot_i -
+    current_global`` and ``u_agg = aggregate - current_global``.
+
+    Returns ``(cos_to_agg [N], update_norms [N], pair_mean, pair_min)``:
+    each admitted client's cosine alignment with the accepted aggregate
+    update, its raw update norm, and the mean/min off-diagonal pairwise
+    client cosine — the cohort-dispersion (non-IID) signal. Shared by the
+    numpy oracle and the device backend so the finishing arithmetic
+    cannot drift between them (only the gram's producer differs)."""
+    dots = np.asarray(dots, np.float64)
+    norms = np.sqrt(np.clip(np.diagonal(dots), 0.0, None))
+    denom = np.maximum(np.outer(norms, norms), 1e-30)
+    cos = dots / denom
+    n = dots.shape[0] - 1
+    cos_to_agg = cos[:n, n].copy()
+    if n >= 2:
+        iu = np.triu_indices(n, 1)
+        off = cos[:n, :n][iu]
+        pair_mean, pair_min = float(off.mean()), float(off.min())
+    else:
+        pair_mean = pair_min = float("nan")
+    return cos_to_agg, norms[:n].copy(), pair_mean, pair_min
+
+
+def contribution_stats(
+    snapshots: "list[dict[str, np.ndarray]]",
+    current_global: Mapping[str, np.ndarray],
+    average: Mapping[str, np.ndarray],
+) -> "tuple[np.ndarray, np.ndarray, float, float]":
+    """Numpy reference for per-client contribution analytics (see
+    :func:`contribution_from_gram`): flatten each admitted snapshot over
+    the sorted shared keys (the same layout the estimators and the device
+    plane use), subtract the current global, and take the gram of the
+    update rows plus the aggregate update in float64. The device backend
+    (``device_agg.DeviceAggEngine.contribution_stats``) reuses the
+    already-stacked round plane and must match this to 1e-6 cosine."""
+    keys = sorted(snapshots[0])
+
+    def flat(d: Mapping[str, np.ndarray]) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(d[k], np.float64).ravel() for k in keys]
+        )
+
+    g = flat(current_global)
+    rows = np.stack([flat(s) for s in snapshots] + [flat(average)]) - g
+    return contribution_from_gram(rows @ rows.T)
 
 
 # ---- aggregators -------------------------------------------------------------

@@ -127,17 +127,22 @@ class StackedRound:
     Python-float sample weights (their f64 sum is the FedAvg denominator,
     exactly as the numpy path computes it); ``snapshots`` keeps the
     decoded host dicts — no copy, they exist anyway — for the non-f32
-    remainder and as the wholesale numpy fallback.
+    remainder and as the wholesale numpy fallback. ``gvec`` is the
+    sharded current-global vector the admission gate already staged — the
+    contribution analytics reuse it so they never re-gather the global.
     """
 
     def __init__(self, engine: "DeviceAggEngine", plane: FlatPlane,
-                 weights: list[float], mat, snapshots: list):
+                 weights: list[float], mat, snapshots: list, gvec=None):
         self.engine = engine
         self.plane = plane
         self.weights = list(weights)
         self.mat = mat
         #: bare snapshot dicts, row-aligned with ``mat`` and ``weights``.
         self.snapshots = list(snapshots)
+        #: sharded current-global reference vector (may be None for
+        #: hand-built rounds; the contribution path stages one on demand).
+        self.gvec = gvec
 
     @property
     def pairs(self) -> list:
@@ -156,6 +161,7 @@ class StackedRound:
             [self.weights[i] for i in idx],
             self.mat[idx],
             [self.snapshots[i] for i in idx],
+            gvec=self.gvec,
         )
 
 
@@ -245,6 +251,28 @@ class DeviceAggEngine:
 
         self._gram = _sm(
             gram, in_specs=(P(None, ax),), out_specs=P(ax, None, None)
+        )
+
+        # ---- contribution gram: updates + aggregate, ONE matmul --------
+        # The model-quality plane's per-client analytics (cosine to the
+        # accepted aggregate, pairwise client similarity) all finish from
+        # the gram of the update rows (mat - gvec) with the aggregate
+        # update appended as one extra row — the same per-shard [N+1, N+1]
+        # block pattern as Krum, so contribution analytics cost one more
+        # sharded matmul on the plane the round already stacked. HIGHEST
+        # precision for the same reason as Krum: nearby updates cancel.
+        def contrib_gram(mat, gvec, avec):
+            u = mat - gvec[None, :]
+            a = (avec - gvec)[None, :]
+            rows = jnp.concatenate([u, a], axis=0)
+            return jnp.matmul(
+                rows, rows.T, precision=jax.lax.Precision.HIGHEST
+            )[None]
+
+        self._contrib_gram = _sm(
+            contrib_gram,
+            in_specs=(P(None, ax), P(ax), P(ax)),
+            out_specs=P(ax, None, None),
         )
 
         # trimmed mean needs a static trim count: one jitted program per t.
@@ -345,16 +373,47 @@ class DeviceAggEngine:
         d2 = sq[:, None] + sq[None, :] - 2.0 * dots
         return d2.astype(np.float32, copy=False)
 
+    def contribution_stats(
+        self, stacked: StackedRound, avg: Mapping[str, Any]
+    ) -> "tuple[np.ndarray, np.ndarray, float, float]":
+        """Per-client contribution analytics on the stacked round plane
+        (README "Model-quality observability"): one sharded gram over the
+        update rows plus the flattened aggregate — no host gather of the
+        client snapshots — finished by the same
+        :func:`~gfedntm_tpu.federation.aggregation.contribution_from_gram`
+        arithmetic as the numpy oracle (parity to 1e-6 cosine)."""
+        from gfedntm_tpu.federation.aggregation import contribution_from_gram
+
+        gvec = stacked.gvec
+        if gvec is None:
+            raise ValueError(
+                "StackedRound carries no current-global reference vector "
+                "(gvec); contribution analytics need the admission gate's "
+                "staged reference"
+            )
+        avg_vec = self.put_vector(stacked.plane, avg)
+        dots = np.asarray(
+            self._contrib_gram(stacked.mat, gvec, avg_vec), np.float64
+        ).sum(axis=0)
+        return contribution_from_gram(dots)
+
 
 def stack_round(
-    engine: DeviceAggEngine, plane: FlatPlane, pairs: list
+    engine: DeviceAggEngine, plane: FlatPlane, pairs: list,
+    current_global: "Mapping[str, Any] | None" = None,
 ) -> StackedRound:
     """Stack numpy-path ``[(weight, snapshot)]`` pairs into a device
-    round — the one-call entry point for tests and the microbench."""
+    round — the one-call entry point for tests and the microbench.
+    ``current_global`` additionally stages the reference vector the
+    contribution analytics run against (:attr:`StackedRound.gvec`)."""
     snaps = [s for _w, s in pairs]
     return StackedRound(
         engine, plane, [w for w, _s in pairs],
         engine.stack(plane, snaps), snaps,
+        gvec=(
+            engine.put_vector(plane, current_global)
+            if current_global is not None else None
+        ),
     )
 
 

@@ -454,6 +454,7 @@ class UpdateGate:
                 engine, plane,
                 [w for _c, w, _s in accepted], rows,
                 [s for _c, _w, s in accepted],
+                gvec=gvec,
             )
         self._account(accepted, rejected, clipped, round_idx)
         return GateResult(accepted=accepted, rejected=rejected,

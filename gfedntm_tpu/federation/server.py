@@ -59,6 +59,7 @@ from gfedntm_tpu.federation.compression import (
     make_codec,
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.eval.monitor import COHERENCE_COLLAPSE, ContributionTracker
 from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate
@@ -139,6 +140,12 @@ class FederatedServer:
         ops_host: str = "127.0.0.1",
         profiler: RoundProfiler | None = None,
         straggler_z: float = 2.0,
+        quality_every: int = 0,
+        quality_ref: str | None = None,
+        quality_topn: int = 10,
+        quality_guard: bool = False,
+        quality_history: int = 64,
+        quality_monitor_kwargs: dict[str, Any] | None = None,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -260,6 +267,33 @@ class FederatedServer:
         self.straggler = StragglerDetector(
             registry=metrics.registry if metrics is not None else None,
             z_threshold=straggler_z,
+        )
+
+        # Model-quality observability plane (README "Model-quality
+        # observability"): with quality_every > 0, every quality round
+        # extracts topic words from the global beta, computes NPMI
+        # coherence against the server-held --quality_ref corpus plus
+        # diversity and round-over-round drift, and the per-round
+        # contribution analytics (cosine to the accepted aggregate,
+        # pairwise cohort similarity) run on every averaged round. The
+        # default (0) keeps the round loop bit-identical: no monitor is
+        # ever constructed, no extra device pass runs, no events appear.
+        if quality_every < 0:
+            raise ValueError(
+                f"quality_every must be >= 0, got {quality_every}"
+            )
+        self.quality_every = int(quality_every)
+        self.quality_ref = quality_ref
+        self.quality_topn = int(quality_topn)
+        self.quality_guard = bool(quality_guard)
+        self.quality_history = int(quality_history)
+        # Extra TopicQualityMonitor knobs (guard_drop/guard_patience/
+        # churn_cos/...) for operators and the scenario harness; the CLI
+        # exposes only the common surface.
+        self.quality_monitor_kwargs = dict(quality_monitor_kwargs or {})
+        self._quality_mon = None
+        self.contributions = ContributionTracker(
+            registry=metrics.registry if metrics is not None else None
         )
 
         self.federation = Federation(min_clients=min_clients)
@@ -418,7 +452,24 @@ class FederatedServer:
                     else None
                 ),
             },
+            # Model-quality plane (README "Model-quality observability"):
+            # coherence/diversity/drift ring buffer + per-client
+            # contribution EWMAs; None when the plane is off.
+            "model_quality": self._model_quality_status(),
         }
+
+    def _model_quality_status(self) -> dict[str, Any] | None:
+        if self.quality_every <= 0:
+            return None
+        out: dict[str, Any] = {
+            "every": self.quality_every,
+            "guard": self.quality_guard,
+            "reference": self.quality_ref,
+        }
+        if self._quality_mon is not None:
+            out.update(self._quality_mon.status())
+        out["contributions"] = self.contributions.status()
+        return out
 
     def wait_done(self, timeout: float | None = None) -> bool:
         return self.training_done.wait(timeout)
@@ -680,6 +731,7 @@ class FederatedServer:
         # Its straggler history is a different process's too.
         self._push_acked.discard(request.client_id)
         self.straggler.forget(request.client_id)
+        self.contributions.forget(request.client_id)
         # Re-check after registering: if the training loop began shutting
         # down concurrently, this client may have missed the stop-broadcast
         # snapshot — tell it to finalize on its own. (If it made the
@@ -752,9 +804,11 @@ class FederatedServer:
             # A rejoin is a fresh process that must re-jit, so its first
             # poll is compile-dominated again; its frozen EWMA must also
             # leave the straggler population or it skews every later
-            # round's mean/std.
+            # round's mean/std. Contribution EWMAs (and their gauges)
+            # leave with it — per-client series must not outlive churn.
             self._poll_warmed.discard(rec.client_id)
             self.straggler.forget(rec.client_id)
+            self.contributions.forget(rec.client_id)
             if reg is not None:
                 reg.counter("client_drops").inc()
         else:
@@ -1097,7 +1151,13 @@ class FederatedServer:
         if not self.wire_codec.identity:
             self._uplink_dec.reset()
             self._downlink_enc.reset()
-        quarantined = self.guardian.dominant_contributors()
+        # A coherence-collapse verdict can arrive with the loss/norm
+        # guardian disabled (divergence_patience=0) — there is then no
+        # streak-weight attribution, so nobody is quarantined.
+        quarantined = (
+            self.guardian.dominant_contributors()
+            if self.guardian is not None else []
+        )
         for client_id in quarantined:
             rec = next(
                 (c for c in self.federation.get_clients()
@@ -1116,7 +1176,8 @@ class FederatedServer:
                     "client_quarantined", client=client_id,
                     round=iteration, reason=verdict,
                 )
-        self.guardian.note_rollback()
+        if self.guardian is not None:
+            self.guardian.note_rollback()
         self.logger.warning(
             "round %d: DIVERGENCE (%s) — rolled back to %s, quarantined "
             "%s", iteration, verdict,
@@ -1131,6 +1192,144 @@ class FederatedServer:
                 event["restored_round"] = int(restored_round)
             m.log("divergence_rollback", **event)
         return restored
+
+    # ---- model-quality plane (README "Model-quality observability") --------
+    def _ensure_quality_monitor(self):
+        """Lazily construct the TopicQualityMonitor on the first averaged
+        round the plane is enabled for — the global vocabulary (needed for
+        id2token) only exists after consensus, and loading the reference
+        corpus before the federation even forms would front-load a failure
+        the operator cannot see yet."""
+        if self.quality_every <= 0:
+            return None
+        if self._quality_mon is None:
+            from gfedntm_tpu.eval.monitor import (
+                TopicQualityMonitor,
+                load_reference_corpus,
+            )
+
+            ref = (
+                load_reference_corpus(self.quality_ref)
+                if self.quality_ref else None
+            )
+            if ref is None:
+                self.logger.warning(
+                    "quality monitoring is on without --quality_ref: NPMI "
+                    "coherence (and the coherence guard) are disabled; "
+                    "diversity and drift still run"
+                )
+            self._quality_mon = TopicQualityMonitor(
+                every=self.quality_every,
+                id2token=self.global_vocab.id2token,
+                ref_tokens=ref,
+                topn=self.quality_topn,
+                history=self.quality_history,
+                metrics=self.metrics,
+                logger=self.logger,
+                **self.quality_monitor_kwargs,
+            )
+        return self._quality_mon
+
+    def _observe_contributions(self, iteration: int, snapshots,
+                               average: dict[str, np.ndarray]) -> None:
+        """Per-client contribution analytics over the admitted cohort:
+        cosine of each update to the accepted aggregate update plus the
+        pairwise cohort-similarity summary. ``average`` must be the
+        aggregate the cohort actually produced — NOT a rollback
+        re-broadcast (cosine to a restored checkpoint's delta would make
+        every honest client look adversarial). On the device backend the
+        stats reuse the round's stacked ``[N, D]`` plane (one extra
+        sharded matmul); the numpy path is the oracle
+        (``aggregation.contribution_stats``)."""
+        if len(snapshots) == 0:
+            return
+        client_ids = [c for c, _w, _l in self._round_accepted]
+        if isinstance(snapshots, list):
+            from gfedntm_tpu.federation.aggregation import contribution_stats
+
+            cos, norms, pair_mean, pair_min = contribution_stats(
+                [s for _w, s in snapshots], self._current_global(), average,
+            )
+        else:  # device backend: a StackedRound
+            cos, norms, pair_mean, pair_min = (
+                snapshots.engine.contribution_stats(snapshots, average)
+            )
+        self.contributions.observe_round(
+            iteration, client_ids, cos, norms, pair_mean, pair_min,
+        )
+
+    def _quality_step(
+        self, iteration: int, snapshots, average: dict[str, np.ndarray],
+        accepted_average: "dict[str, np.ndarray] | None" = None,
+    ) -> dict[str, np.ndarray]:
+        """One round's model-quality pass, run AFTER the aggregate is
+        computed and BEFORE it is broadcast: contribution analytics every
+        averaged round, topic coherence/diversity/drift on the
+        ``quality_every`` cadence, and — with ``quality_guard`` — the
+        coherence-collapse verdict routed through the same rollback path
+        as a loss divergence (the returned average is then the restored
+        state). ``accepted_average`` is the aggregate the cohort itself
+        produced: when a loss-guardian rollback already swapped
+        ``average`` for a restored checkpoint this round, contributions
+        are still measured against what the clients converged on, while
+        the quality monitor observes the broadcast (restored) state.
+        Entirely inert when ``quality_every`` is 0. Observation failures
+        are contained: telemetry must never kill the round loop (same
+        stance as checkpointing)."""
+        if self.quality_every <= 0:
+            return average
+        m = self.metrics
+        try:
+            self._observe_contributions(
+                iteration, snapshots,
+                accepted_average if accepted_average is not None
+                else average,
+            )
+        except Exception:
+            self.logger.exception(
+                "round %d: contribution analytics failed", iteration
+            )
+            if m is not None:
+                m.registry.counter("quality_errors").inc()
+        monitor = None
+        try:
+            monitor = self._ensure_quality_monitor()
+        except Exception:
+            # An unreadable reference corpus must be loud but not fatal:
+            # disable the monitor (leave contributions running) instead
+            # of failing every round's average.
+            self.logger.exception(
+                "quality monitor construction failed; disabling the "
+                "topic-quality plane (contribution analytics stay on)"
+            )
+            self.quality_ref = None
+            if m is not None:
+                m.registry.counter("quality_errors").inc()
+        if monitor is None or not monitor.should_run(iteration):
+            return average
+        try:
+            monitor.observe(iteration, average)
+        except Exception:
+            self.logger.exception(
+                "round %d: quality observation failed", iteration
+            )
+            if m is not None:
+                m.registry.counter("quality_errors").inc()
+            return average
+        if self.quality_guard and monitor.collapsed:
+            restored = self._divergence_rollback(
+                iteration, COHERENCE_COLLAPSE
+            )
+            if restored is not None:
+                # Only a rollback that actually restored state re-anchors
+                # the monitor. With nothing to restore (no checkpoint),
+                # the collapsed streak stays open and the verdict keeps
+                # firing — loud every quality round, like the loss
+                # guardian's no-checkpoint path — instead of re-seeding
+                # the EWMA at the collapsed coherence and going quiet.
+                monitor.note_rollback()
+                return restored
+        return average
 
     def _skip_below_quorum(self, iteration: int, got: int, membership: int,
                            quorum: int, what: str) -> None:
@@ -1334,6 +1533,11 @@ class FederatedServer:
                     average = self.aggregator.aggregate(
                         snapshots, current_global=self._current_global()
                     )
+                    # The cohort's own aggregate, pinned before any
+                    # guardian rollback swaps `average`: contribution
+                    # analytics measure alignment with what the clients
+                    # accepted, never with a rollback re-broadcast.
+                    accepted_average = average
                     # Divergence backstop: the guardian judges the fresh
                     # aggregate BEFORE it becomes last_average or reaches
                     # any client; a verdict swaps in the restored
@@ -1356,6 +1560,14 @@ class FederatedServer:
                             )
                             if restored is not None:
                                 average = restored
+                    # Model-quality plane: contribution analytics +
+                    # (on cadence) coherence/diversity/drift over the
+                    # fresh aggregate, BEFORE it becomes last_average —
+                    # a coherence-collapse verdict swaps in the restored
+                    # checkpoint state exactly like a loss divergence.
+                    average = self._quality_step(
+                        iteration, snapshots, average, accepted_average
+                    )
                     self.last_average = average
                     agg = self._encode_push(average, iteration, replies)
 

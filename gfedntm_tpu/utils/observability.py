@@ -110,6 +110,10 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "profiler_started": frozenset({"dir", "round"}),
     "profiler_stopped": frozenset({"round"}),
     "straggler_detected": frozenset({"client", "round", "z"}),
+    # model-quality plane (topic coherence / diversity / drift telemetry;
+    # README "Model-quality observability")
+    "quality_computed": frozenset({"round", "npmi", "diversity"}),
+    "topic_drift": frozenset({"round", "mean_drift", "churn"}),
     # training progress
     "resume": frozenset({"step"}),
     "epoch": frozenset({"epoch"}),
@@ -319,6 +323,15 @@ class MetricRegistry:
         mint empty gauges just by being curled)."""
         with self._lock:
             return self._metrics.get(name)
+
+    def drop(self, name: str) -> bool:
+        """Remove a metric from the registry (idempotent; returns whether
+        it existed). The eviction path of per-client series: detectors
+        tracking a churning client population must drop a departed
+        client's gauges, or the registry (and every later snapshot /
+        Prometheus scrape) grows one series per client that ever lived."""
+        with self._lock:
+            return self._metrics.pop(name, None) is not None
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
@@ -550,6 +563,16 @@ DATA_PLANE_EVENTS: tuple[str, ...] = (
     "divergence_rollback",
     "client_quarantined",
     "checkpoint_invalid",
+)
+
+#: Model-quality plane events (topic coherence / drift telemetry — README
+#: "Model-quality observability"). Same reverse-lint contract as the
+#: data-plane events: lint_telemetry.py verifies each keeps an emission
+#: call site, so the quality monitor can never be silently disconnected
+#: from the stream the `report` CLI reconstructs trajectories from.
+MODEL_QUALITY_EVENTS: tuple[str, ...] = (
+    "quality_computed",
+    "topic_drift",
 )
 
 
@@ -857,6 +880,41 @@ def _hist_stats(snap: dict[str, Any]) -> dict[str, Any]:
     return out
 
 
+def collect_data_plane(records: list[dict[str, Any]]) -> dict[str, Any]:
+    """Aggregate the data-plane defense events of a stream (admission-gate
+    rejections per client by reason, norm clips, divergence rollbacks,
+    quarantines — README "Robust aggregation & divergence recovery") into
+    one dict. Shared by the ``summarize`` and ``report`` engines so both
+    CLIs show identical accounting."""
+    rejections: dict[str, dict[str, int]] = {}
+    clips: dict[str, int] = {}
+    rollbacks: list[dict[str, Any]] = []
+    quarantines: dict[str, int] = {}
+    for r in records:
+        event = r.get("event")
+        if event == "update_rejected":
+            by = rejections.setdefault(str(r.get("client")), {})
+            reason = str(r.get("reason", "?"))
+            by[reason] = by.get(reason, 0) + 1
+        elif event == "update_clipped":
+            cid = str(r.get("client"))
+            clips[cid] = clips.get(cid, 0) + 1
+        elif event == "divergence_rollback":
+            rollbacks.append({
+                "round": r.get("round"), "reason": r.get("reason"),
+                "restored_round": r.get("restored_round"),
+            })
+        elif event == "client_quarantined":
+            cid = str(r.get("client"))
+            quarantines[cid] = quarantines.get(cid, 0) + 1
+    return {
+        "rejections": rejections,
+        "clips": clips,
+        "rollbacks": rollbacks,
+        "quarantines": quarantines,
+    }
+
+
 def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
     """Aggregate a run's event stream into a report dict (see
     :func:`format_report` for the rendered form)."""
@@ -965,6 +1023,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
         "gauges": gauges,
         "compile": compile_events,
         "summary": summary_event,
+        "data_plane": collect_data_plane(records),
     }
 
 
@@ -1086,6 +1145,34 @@ def format_report(s: dict[str, Any]) -> str:
                 f"(max z {st['max_z']:.1f})"
             )
 
+    dp = s.get("data_plane") or {}
+    if any(dp.get(k) for k in
+           ("rejections", "clips", "rollbacks", "quarantines")):
+        lines.append("")
+        lines.append("data plane (admission gate / guardian):")
+        for cid in sorted(
+            set(dp.get("rejections", {})) | set(dp.get("clips", {}))
+        ):
+            by = dp.get("rejections", {}).get(cid, {})
+            reasons = ", ".join(
+                f"{r}:{n}" for r, n in sorted(by.items())
+            ) or "-"
+            lines.append(
+                f"  client {cid}: {sum(by.values())} rejected ({reasons})"
+                f", {dp.get('clips', {}).get(cid, 0)} clipped"
+            )
+        for rb in dp.get("rollbacks", ()):
+            restored = rb.get("restored_round")
+            lines.append(
+                f"  rollback at round {rb.get('round')} "
+                f"({rb.get('reason')}"
+                + (f" -> restored round {restored}"
+                   if restored is not None else "")
+                + ")"
+            )
+        for cid, n in sorted(dp.get("quarantines", {}).items()):
+            lines.append(f"  quarantined: client {cid} x{n}")
+
     enc = s["counters"].get("codec_encoded_bytes")
     dec = s["counters"].get("codec_decoded_bytes")
     if enc is not None or dec is not None:
@@ -1110,6 +1197,188 @@ def format_report(s: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ---- model-quality report (the `report` CLI subcommand's engine) ------------
+
+def summarize_model_quality(
+    records: list[dict[str, Any]]
+) -> dict[str, Any]:
+    """Aggregate a run's model-quality telemetry into a report dict: the
+    per-round coherence/diversity/drift trajectory (``quality_computed``
+    + ``topic_drift`` events keyed by round), the per-client contribution
+    EWMAs (read from the LAST ``metrics_snapshot`` carrying the
+    contribution gauges), and the data-plane accounting
+    (:func:`collect_data_plane`). Everything comes from the JSONL stream
+    alone — the report needs no live server."""
+    quality: dict[int, dict[str, Any]] = {}
+    last_gauges: dict[str, float] = {}
+    topics_last: list[list[str]] | None = None
+    for r in records:
+        event = r.get("event")
+        if event == "quality_computed":
+            row = quality.setdefault(int(r.get("round", -1)), {})
+            row.update(
+                npmi=r.get("npmi"), diversity=r.get("diversity"),
+                irbo=r.get("irbo"), n_topics=r.get("n_topics"),
+            )
+            if r.get("topics"):
+                topics_last = r["topics"]
+        elif event == "topic_drift":
+            row = quality.setdefault(int(r.get("round", -1)), {})
+            row.update(
+                mean_drift=r.get("mean_drift"),
+                max_drift=r.get("max_drift"),
+                mean_js=r.get("mean_js"), churn=r.get("churn"),
+            )
+        elif event == "metrics_snapshot":
+            for name, snap in (r.get("metrics") or {}).items():
+                if snap.get("type") == "gauge" and snap["value"] is not None:
+                    last_gauges[name] = snap["value"]
+
+    contributions: dict[str, dict[str, Any]] = {}
+    for name, value in last_gauges.items():
+        base, _, key = name.partition("/")
+        if base in ("client_contribution_cos", "client_contribution_share"):
+            cid = key.removeprefix("client")
+            field = (
+                "cos_ewma" if base == "client_contribution_cos"
+                else "share_ewma"
+            )
+            contributions.setdefault(cid, {})[field] = value
+
+    return {
+        "quality": [
+            {"round": rnd, **row} for rnd, row in sorted(quality.items())
+        ],
+        "contributions": contributions,
+        "pairwise": {
+            "cos_mean": last_gauges.get("contribution_pairwise_cos_mean"),
+            "cos_min": last_gauges.get("contribution_pairwise_cos_min"),
+        },
+        "topics": topics_last,
+        "data_plane": collect_data_plane(records),
+    }
+
+
+def check_monotone_coherence(
+    summary: dict[str, Any], tolerance: float
+) -> list[str]:
+    """CI gate: verify NPMI coherence never drops more than ``tolerance``
+    below its running maximum over the quality trajectory. Returns the
+    violations (empty = pass) — the ``report`` CLI exits non-zero on any,
+    so the scenario harness can gate on model quality, not just on step
+    time."""
+    violations: list[str] = []
+    best: float | None = None
+    best_round: int | None = None
+    for row in summary.get("quality", ()):
+        npmi = row.get("npmi")
+        if npmi is None:
+            continue
+        if best is not None and npmi < best - tolerance:
+            violations.append(
+                f"round {row['round']}: npmi {npmi:.4f} fell "
+                f"{best - npmi:.4f} below the round-{best_round} peak "
+                f"{best:.4f} (tolerance {tolerance:g})"
+            )
+        if best is None or npmi > best:
+            best, best_round = npmi, row["round"]
+    if not summary.get("quality"):
+        violations.append(
+            "no quality_computed events in the stream (was the run "
+            "launched with --quality_every > 0 and --quality_ref?)"
+        )
+    elif best is None:
+        # Quality rounds exist but NPMI was never computed (no reference
+        # corpus): a gate that checked nothing must not report green.
+        violations.append(
+            "quality rounds carry no NPMI values — coherence was never "
+            "measured (was the run launched with --quality_ref?)"
+        )
+    return violations
+
+
+def _fmt_opt(value: Any, spec: str = "{:.3f}") -> str:
+    return "-" if value is None else spec.format(value)
+
+
+def format_quality_report(s: dict[str, Any]) -> str:
+    """Render a :func:`summarize_model_quality` dict as a human-readable
+    round-by-round model-health report."""
+    lines: list[str] = []
+    quality = s.get("quality") or []
+    lines.append(
+        f"model-quality report: {len(quality)} quality rounds"
+    )
+
+    if quality:
+        lines.append("")
+        lines.append(
+            f"  {'round':>6}{'npmi':>9}{'diversity':>11}{'irbo':>8}"
+            f"{'drift':>8}{'max':>8}{'churn':>7}"
+        )
+        for row in quality:
+            lines.append(
+                f"  {row['round']:>6}"
+                f"{_fmt_opt(row.get('npmi')):>9}"
+                f"{_fmt_opt(row.get('diversity')):>11}"
+                f"{_fmt_opt(row.get('irbo')):>8}"
+                f"{_fmt_opt(row.get('mean_drift')):>8}"
+                f"{_fmt_opt(row.get('max_drift')):>8}"
+                f"{_fmt_opt(row.get('churn'), '{:d}'):>7}"
+            )
+
+    contributions = s.get("contributions") or {}
+    dp = s.get("data_plane") or {}
+    if contributions or dp.get("rejections"):
+        lines.append("")
+        lines.append("per-client contributions (EWMA):")
+        lines.append(
+            f"  {'client':<8}{'cos->agg':>10}{'share':>8}{'rejected':>10}"
+            f"{'clipped':>9}{'quarantined':>13}"
+        )
+        clients = sorted(
+            set(contributions) | set(dp.get("rejections", {}))
+            | set(dp.get("clips", {})) | set(dp.get("quarantines", {})),
+            key=str,
+        )
+        for cid in clients:
+            c = contributions.get(cid, {})
+            rejected = sum(dp.get("rejections", {}).get(cid, {}).values())
+            lines.append(
+                f"  {cid:<8}{_fmt_opt(c.get('cos_ewma')):>10}"
+                f"{_fmt_opt(c.get('share_ewma')):>8}"
+                f"{rejected:>10}{dp.get('clips', {}).get(cid, 0):>9}"
+                f"{dp.get('quarantines', {}).get(cid, 0):>13}"
+            )
+
+    pairwise = s.get("pairwise") or {}
+    if pairwise.get("cos_mean") is not None:
+        lines.append("")
+        lines.append(
+            f"cohort dispersion: pairwise cosine mean "
+            f"{pairwise['cos_mean']:.3f}, min "
+            f"{_fmt_opt(pairwise.get('cos_min'))} "
+            "(low mean = heterogeneous / non-IID update directions)"
+        )
+
+    for rb in dp.get("rollbacks", ()):
+        restored = rb.get("restored_round")
+        lines.append(
+            f"rollback at round {rb.get('round')} ({rb.get('reason')}"
+            + (f" -> restored round {restored}"
+               if restored is not None else "")
+            + ")"
+        )
+
+    if s.get("topics"):
+        lines.append("")
+        lines.append("final topics (top words):")
+        for i, words in enumerate(s["topics"]):
+            lines.append(f"  topic {i}: {' '.join(words[:10])}")
+
+    return "\n".join(lines)
+
+
 # ---- Prometheus exposition + live ops endpoint ------------------------------
 
 _PROM_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
@@ -1129,20 +1398,34 @@ def _prom_label(value: str) -> str:
 
 
 def render_prometheus(snapshot: dict[str, Any],
-                      prefix: str = "gfedntm") -> str:
+                      prefix: str = "gfedntm",
+                      max_series: int = 256) -> str:
     """Render a :meth:`MetricRegistry.snapshot` dict as Prometheus text
     exposition (version 0.0.4). Registry names like
     ``rpc_s/FederationClient.TrainStep`` split at the first ``/`` into the
     metric family (sanitized) plus a ``key`` label, so per-client and
-    per-method series stay one scrapeable family."""
+    per-method series stay one scrapeable family.
+
+    ``max_series`` caps the label cardinality per family: per-client
+    series (poll latency, contribution EWMAs) grow with client churn, and
+    an unbounded exposition would eventually dominate every scrape. A
+    family over the cap exports its first ``max_series`` keys (sorted —
+    stable across scrapes) plus one ``<prefix>_series_overflow_total``
+    counter recording how many series were withheld, so the truncation is
+    itself observable instead of silent. ``max_series=0`` disables the
+    cap."""
     families: dict[str, list[tuple[str, dict[str, Any]]]] = {}
     for name, snap in snapshot.items():
         base, _, key = name.partition("/")
         families.setdefault(_prom_name(base), []).append((key, snap))
 
+    overflow: dict[str, int] = {}
     lines: list[str] = []
     for base in sorted(families):
         series = sorted(families[base])
+        if max_series and len(series) > max_series:
+            overflow[base] = len(series) - max_series
+            series = series[:max_series]
         kind = series[0][1].get("type")
         full = f"{prefix}_{base}"
         if kind == "counter":
@@ -1172,6 +1455,13 @@ def render_prometheus(snapshot: dict[str, Any],
                 )
                 lines.append(f"{full}_sum{label} {snap['sum']}")
                 lines.append(f"{full}_count{label} {snap['count']}")
+    if overflow:
+        full = f"{prefix}_series_overflow_total"
+        lines.append(f"# TYPE {full} counter")
+        for base in sorted(overflow):
+            lines.append(
+                f'{full}{{family="{_prom_label(base)}"}} {overflow[base]}'
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -1338,14 +1628,17 @@ class StragglerDetector:
     def forget(self, client_id: Any) -> None:
         """Evict a departed client: a dropped client's frozen EWMA would
         otherwise skew the population mean/std forever (inflating std so
-        genuine new stragglers stop flagging) and haunt ``/status``. The
-        already-exported gauge keeps its last value — registries are
-        cumulative — but the client leaves the live population. A rejoin
-        re-warms from scratch, like the server's poll warm-up."""
+        genuine new stragglers stop flagging) and haunt ``/status``. Its
+        gauge is dropped from the registry too — per-client series must
+        not accumulate one ghost per client that ever churned through
+        the federation. A rejoin re-warms from scratch, like the
+        server's poll warm-up."""
         with self._lock:
             self._ewma.pop(client_id, None)
             self._rounds.pop(client_id, None)
             self._current.pop(client_id, None)
+        if self.registry is not None:
+            self.registry.drop(f"client_step_ewma_s/client{client_id}")
 
     def status(self) -> dict[str, dict[str, Any]]:
         """JSON-safe per-client view for the ops endpoint."""

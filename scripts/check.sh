@@ -27,14 +27,15 @@ if [ "$before" != "$after" ]; then
     exit 1
 fi
 
-# Observability-plane and data-plane test modules must at least collect
-# (import-time breakage surfaces in the fast loop too; the full run
-# happens in tier-1).
-echo "== observability/data-plane test modules collect =="
+# Observability-plane, data-plane, and model-quality test modules must at
+# least collect (import-time breakage surfaces in the fast loop too; the
+# full run happens in tier-1).
+echo "== observability/data-plane/quality test modules collect =="
 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     tests/test_trace_plane.py tests/test_ops_endpoint.py \
-    tests/test_data_plane.py tests/test_device_agg.py >/dev/null || exit 1
+    tests/test_data_plane.py tests/test_device_agg.py \
+    tests/test_metrics.py tests/test_quality_plane.py >/dev/null || exit 1
 
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo "== tests skipped (SKIP_TESTS=1) =="

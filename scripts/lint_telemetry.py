@@ -79,6 +79,7 @@ def main() -> int:
     from gfedntm_tpu.utils.observability import (
         DATA_PLANE_EVENTS,
         EVENT_SCHEMAS,
+        MODEL_QUALITY_EVENTS,
         TRACE_PLANE_SPANS,
     )
 
@@ -99,15 +100,17 @@ def main() -> int:
         for name, where in sorted(drift.items()):
             sys.stderr.write(f"  {name!r}: {', '.join(where)}\n")
         return 1
-    # Reverse direction for the data-plane defense events: each must keep
-    # at least one emission site AND a schema entry — a refactor that
-    # disconnects (or de-registers) the admission gate / guardian / ckpt
-    # integrity telemetry would otherwise pass silently.
-    unemitted = [e for e in DATA_PLANE_EVENTS if e not in sites]
-    unregistered = [e for e in DATA_PLANE_EVENTS if e not in EVENT_SCHEMAS]
+    # Reverse direction for the data-plane defense AND model-quality
+    # events: each must keep at least one emission site AND a schema
+    # entry — a refactor that disconnects (or de-registers) the admission
+    # gate / guardian / ckpt integrity / quality-monitor telemetry would
+    # otherwise pass silently.
+    required = DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS
+    unemitted = [e for e in required if e not in sites]
+    unregistered = [e for e in required if e not in EVENT_SCHEMAS]
     if unemitted or unregistered:
         sys.stderr.write(
-            "data-plane telemetry drift: "
+            "data-plane/model-quality telemetry drift: "
             f"events with no .log() call site: {unemitted}; "
             f"events missing from EVENT_SCHEMAS: {unregistered}\n"
         )
@@ -130,7 +133,8 @@ def main() -> int:
         f"{sum(len(w) for w in sites.values())} call sites, all "
         f"registered; {len(spans)} span names cover the trace plane's "
         f"{list(TRACE_PLANE_SPANS)}; all {len(DATA_PLANE_EVENTS)} "
-        "data-plane defense events wired"
+        f"data-plane defense + {len(MODEL_QUALITY_EVENTS)} model-quality "
+        "events wired"
     )
     return 0
 

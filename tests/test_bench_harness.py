@@ -135,6 +135,24 @@ class TestMainOrchestration:
         assert banked["backend"] == "tpu"
         assert banked["fused_largev"] == fused  # re-banked after fused phase
 
+    def test_live_after_escalated_retry_records_abandoned_attempt(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """A first accelerator attempt that times out must stay on the
+        record even when the 2x escalated retry succeeds: a live summary
+        after a timeout must not erase the timeout (the r03-r05
+        diagnosis evidence lives in accel_attempts)."""
+        summary = {"metric": "m", "value": 9.0, "backend": "tpu"}
+        result, calls = self._run_main(
+            monkeypatch, capsys, [None, dict(summary), None],
+            artifact_dir=tmp_path,
+        )
+        assert result["provenance"] == "live"
+        assert [c[1] for c in calls[:2]] == ["axon", "axon"]
+        attempts = result["accel_attempts"]
+        assert attempts and attempts[0]["reason"] == "timeout"
+        assert attempts[0]["phase"] == "run"
+
     def test_dead_tunnel_escalates_then_uses_cached(self, monkeypatch,
                                                     capsys, tmp_path):
         _write_artifact(str(tmp_path / "bench_latest.json"), value=777.0)

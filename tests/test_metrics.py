@@ -1,0 +1,127 @@
+"""Direct unit tests for ``eval/metrics.py`` (tier-1, ISSUE 7 satellite).
+
+The topic-quality metrics (NPMI coherence, topic diversity, RBO /
+inverted RBO) were until now only exercised indirectly through the
+presets/experiments suites; the model-quality observability plane builds
+its live telemetry on them, so their edge cases get direct coverage:
+single-topic betas, words absent from the reference corpus, ``topn``
+larger than the vocabulary, and the p→1 RBO limit.
+"""
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.eval.metrics import (
+    inverted_rbo,
+    npmi_coherence,
+    rbo,
+    topic_diversity,
+)
+
+
+class TestNpmiCoherence:
+    def test_perfectly_cooccurring_pair_scores_positive(self):
+        # "a" and "b" co-occur in 2 of 3 docs and never apart from the
+        # third: co = 2/3, p_a = p_b = 2/3 -> pmi = ln(3/2), npmi =
+        # pmi / -ln(2/3) > 0.
+        corpus = [["a", "b"], ["a", "b"], ["c", "d"]]
+        got = npmi_coherence([["a", "b"]], corpus, topn=2)
+        expected = np.log((2 / 3) / (4 / 9)) / (-np.log(2 / 3 + 1e-12))
+        assert got == pytest.approx(expected, rel=1e-9)
+
+    def test_never_cooccurring_pair_scores_minus_one(self):
+        corpus = [["a", "x"], ["b", "y"]]
+        assert npmi_coherence([["a", "b"]], corpus, topn=2) == -1.0
+
+    def test_words_absent_from_corpus_score_minus_one(self):
+        # A topic word the reference corpus never contains cannot be
+        # judged coherent — the pair contributes the -1 floor, it does
+        # not crash or silently drop.
+        corpus = [["a", "b"], ["a", "b"]]
+        assert npmi_coherence([["ghost", "phantom"]], corpus) == -1.0
+        mixed = npmi_coherence([["a", "ghost"]], corpus, topn=2)
+        assert mixed == -1.0
+
+    def test_topn_larger_than_topic_word_list(self):
+        corpus = [["a", "b"], ["a", "b"], ["a", "c"]]
+        # topn=50 over a 2-word topic: only the existing pair is scored.
+        assert npmi_coherence([["a", "b"]], corpus, topn=50) == (
+            npmi_coherence([["a", "b"]], corpus, topn=2)
+        )
+
+    def test_empty_corpus_and_empty_topics(self):
+        assert npmi_coherence([["a", "b"]], []) == 0.0
+        assert npmi_coherence([], [["a"]]) == 0.0
+        # single-word topic: no pairs to score
+        assert npmi_coherence([["a"]], [["a", "b"]]) == 0.0
+
+
+class TestTopicDiversity:
+    def test_all_unique_is_one(self):
+        assert topic_diversity([["a", "b"], ["c", "d"]], topn=2) == 1.0
+
+    def test_identical_topics_score_one_over_k(self):
+        topics = [["a", "b"], ["a", "b"], ["a", "b"]]
+        assert topic_diversity(topics, topn=2) == pytest.approx(1 / 3)
+
+    def test_empty_topics(self):
+        assert topic_diversity([], topn=5) == 0.0
+        assert topic_diversity([[]], topn=5) == 0.0
+
+    def test_topn_larger_than_vocab(self):
+        # topn beyond the available words just uses what exists.
+        assert topic_diversity([["a"], ["b"]], topn=25) == 1.0
+
+
+class TestRbo:
+    @pytest.mark.parametrize("p", [0.5, 0.9, 0.99, 0.999999])
+    def test_identical_lists_score_one_for_all_p(self, p):
+        lst = ["a", "b", "c", "d"]
+        assert rbo(lst, lst, p=p) == pytest.approx(1.0, abs=1e-9)
+
+    @pytest.mark.parametrize("p", [0.5, 0.9, 0.999999])
+    def test_disjoint_lists_score_zero(self, p):
+        assert rbo(["a", "b"], ["x", "y"], p=p) == pytest.approx(
+            0.0, abs=1e-9
+        )
+
+    def test_p_to_one_limit_same_set_different_order(self):
+        # As p -> 1 the extrapolated RBO of two permutations of the SAME
+        # set approaches 1: the depth-l agreement term dominates and
+        # x_l / l = 1 (Webber et al. 2010, eq. 32's limit behaviour).
+        a, b = ["a", "b", "c", "d"], ["d", "c", "b", "a"]
+        assert rbo(a, b, p=0.999999) == pytest.approx(1.0, abs=1e-3)
+        # ... while at moderate p the order disagreement at shallow
+        # depths keeps it strictly below 1.
+        assert rbo(a, b, p=0.9) < 1.0
+
+    def test_unequal_lengths_and_symmetry(self):
+        a, b = ["a", "b", "c"], ["a", "b", "c", "d", "e"]
+        assert rbo(a, b, p=0.9) == pytest.approx(rbo(b, a, p=0.9))
+        assert 0.0 < rbo(a, b, p=0.9) <= 1.0
+
+    def test_empty_list_scores_zero(self):
+        assert rbo([], ["a"], p=0.9) == 0.0
+        assert rbo(["a"], [], p=0.9) == 0.0
+
+
+class TestInvertedRbo:
+    def test_single_topic_beta_is_defined(self):
+        # A single-topic model has no topic pairs — inverted RBO is 0 by
+        # convention (no redundancy measurable), not a crash.
+        assert inverted_rbo([["a", "b", "c"]]) == 0.0
+        assert inverted_rbo([]) == 0.0
+
+    def test_identical_topics_score_zero(self):
+        topics = [["a", "b", "c"], ["a", "b", "c"]]
+        assert inverted_rbo(topics, topn=3) == pytest.approx(0.0, abs=1e-9)
+
+    def test_disjoint_topics_score_one(self):
+        topics = [["a", "b"], ["x", "y"], ["m", "n"]]
+        assert inverted_rbo(topics, topn=2) == pytest.approx(1.0, abs=1e-9)
+
+    def test_topn_larger_than_topic_lists(self):
+        topics = [["a", "b"], ["a", "c"]]
+        assert inverted_rbo(topics, topn=10) == (
+            inverted_rbo(topics, topn=2)
+        )

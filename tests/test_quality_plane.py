@@ -1,0 +1,816 @@
+"""Model-quality observability plane (tier-1, ISSUE 7).
+
+Covers the TopicQualityMonitor (coherence / diversity / drift matching /
+coherence-collapse guard), per-client contribution analytics (numpy
+oracle vs the device backend's stacked-plane gram), the per-client gauge
+cardinality guard, the `report` CLI, and two chaos e2e federations: a
+3-client run with ``quality_every=1`` whose trajectory flows through
+JSONL, gauges, ``/status`` and the rendered report; and a scripted
+topic-collapse (random-payload corrupted client, gate off) where clean
+rounds climb NPMI, corruption crashes it, and ``quality_guard`` routes a
+``coherence_collapse`` verdict through the divergence-rollback path.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser, main as cli_main
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.eval.monitor import (
+    ContributionTracker,
+    TopicQualityMonitor,
+    find_beta_key,
+    js_divergence_rows,
+    load_reference_corpus,
+    match_topics,
+    softmax_rows,
+    topics_from_beta,
+)
+from gfedntm_tpu.federation.aggregation import (
+    contribution_from_gram,
+    contribution_stats,
+)
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.resilience import FaultInjector
+from gfedntm_tpu.federation.server import FederatedServer
+from gfedntm_tpu.utils.observability import (
+    MetricRegistry,
+    MetricsLogger,
+    StragglerDetector,
+    check_monotone_coherence,
+    format_quality_report,
+    format_report,
+    render_prometheus,
+    summarize_metrics,
+    summarize_model_quality,
+)
+
+#: Three disjoint 8-word co-occurrence blocks: documents draw from one
+#: block each, so block-pure topics are NPMI-coherent against the corpus
+#: and cross-block word pairs never co-occur (NPMI -1) — a controlled
+#: coherence scale for the monitor.
+BLOCKS = [[f"b{b}w{i:02d}" for i in range(8)] for b in range(3)]
+VOCAB = [w for block in BLOCKS for w in block]
+ID2TOKEN = dict(enumerate(VOCAB))
+
+
+def _block_docs(n, seed):
+    rng = np.random.default_rng(seed)
+    return [" ".join(rng.choice(BLOCKS[i % 3], size=8)) for i in range(n)]
+
+
+def _ref_corpus(n=60, seed=0):
+    return [d.split() for d in _block_docs(n, seed)]
+
+
+def _block_beta(noise=0.0, seed=0):
+    """[3, 24] beta whose topic k concentrates on block k."""
+    rng = np.random.default_rng(seed)
+    beta = np.full((3, 24), -2.0)
+    for k in range(3):
+        beta[k, 8 * k:8 * (k + 1)] = 2.0
+    return beta + noise * rng.normal(size=beta.shape)
+
+
+def _mixed_beta(seed=0):
+    """Random beta: top words mix blocks — incoherent by construction."""
+    return np.random.default_rng(seed).normal(size=(3, 24))
+
+
+# ---- monitor units ----------------------------------------------------------
+
+class TestTopicExtraction:
+    def test_find_beta_key(self):
+        assert find_beta_key({"params/beta": 1, "params/w": 2}) == (
+            "params/beta"
+        )
+        assert find_beta_key({"x/beta": 1}) == "x/beta"
+        assert find_beta_key({"beta": 1}) == "beta"
+        with pytest.raises(KeyError):
+            find_beta_key({"params/w": 1})
+
+    def test_topics_from_beta_ranks_rows(self):
+        beta = np.array([[0.1, 3.0, 2.0], [5.0, 0.0, 1.0]])
+        topics = topics_from_beta(beta, {0: "a", 1: "b", 2: "c"}, topn=2)
+        assert topics == [["b", "c"], ["a", "c"]]
+
+    def test_topn_clamped_to_vocab(self):
+        beta = np.array([[1.0, 2.0]])
+        assert topics_from_beta(beta, {0: "a", 1: "b"}, topn=10) == [
+            ["b", "a"]
+        ]
+
+    def test_softmax_rows_is_row_stochastic(self):
+        d = softmax_rows(_mixed_beta())
+        np.testing.assert_allclose(d.sum(axis=1), 1.0, rtol=1e-12)
+
+
+class TestTopicMatching:
+    @pytest.mark.parametrize("method", ["hungarian", "greedy"])
+    def test_permutation_recovered(self, method):
+        d = softmax_rows(_block_beta())
+        perm = [2, 0, 1]
+        matches = match_topics(d[perm], d, method=method)
+        assert [(r, c) for r, c, _ in matches] == [(0, 2), (1, 0), (2, 1)]
+        assert all(cos > 0.999 for _r, _c, cos in matches)
+
+    def test_unknown_method_rejected(self):
+        d = softmax_rows(_block_beta())
+        with pytest.raises(ValueError):
+            match_topics(d, d, method="psychic")
+
+    def test_js_divergence_bounds(self):
+        p = softmax_rows(_block_beta())
+        q = softmax_rows(_mixed_beta())
+        js = js_divergence_rows(p, q)
+        assert np.all(js >= 0) and np.all(js <= 1.0 + 1e-9)
+        np.testing.assert_allclose(js_divergence_rows(p, p), 0, atol=1e-9)
+
+
+class TestTopicQualityMonitor:
+    def _monitor(self, **kw):
+        kw.setdefault("every", 1)
+        kw.setdefault("id2token", ID2TOKEN)
+        kw.setdefault("ref_tokens", _ref_corpus())
+        kw.setdefault("topn", 6)
+        return TopicQualityMonitor(**kw)
+
+    def test_coherent_beta_beats_mixed(self):
+        mon = self._monitor()
+        good = mon.observe(0, {"params/beta": _block_beta()})
+        bad = self._monitor().observe(
+            0, {"params/beta": _mixed_beta()}
+        )
+        assert good["npmi"] > 0.3 > bad["npmi"]
+        assert 0.0 < good["diversity"] <= 1.0
+
+    def test_permuted_beta_drifts_near_zero(self):
+        mon = self._monitor()
+        beta = _block_beta(noise=0.1)
+        mon.observe(0, {"params/beta": beta})
+        rec = mon.observe(1, {"params/beta": beta[[2, 0, 1]]})
+        assert rec["drift"]["mean_drift"] < 1e-6
+        assert rec["drift"]["churn"] == 0
+
+    def test_corrupted_rows_churn(self):
+        mon = self._monitor()
+        beta = _block_beta()
+        mon.observe(0, {"params/beta": beta})
+        corrupted = beta.copy()
+        corrupted[1] = _mixed_beta(seed=3)[1]  # kill one topic
+        rec = mon.observe(1, {"params/beta": corrupted})
+        assert rec["drift"]["churn"] == 1
+        assert rec["drift"]["max_drift"] > 0.3
+
+    def test_guard_streak_and_rollback_reset(self):
+        mon = self._monitor(guard_patience=2, guard_drop=0.25,
+                            guard_floor=0.05)
+        good, bad = _block_beta(), _mixed_beta()
+        for r in range(3):
+            mon.observe(r, {"params/beta": good})
+        assert not mon.collapsed
+        mon.observe(3, {"params/beta": bad})
+        assert not mon.collapsed  # patience 2: one bad round is noise
+        mon.observe(4, {"params/beta": bad})
+        assert mon.collapsed
+        mon.note_rollback()
+        assert not mon.collapsed
+        # post-rollback: baseline AND drift reference reset
+        rec = mon.observe(5, {"params/beta": good})
+        assert "drift" not in rec
+
+    def test_no_reference_disables_npmi_and_guard(self):
+        mon = self._monitor(ref_tokens=None, guard_patience=1)
+        rec = mon.observe(0, {"params/beta": _mixed_beta()})
+        assert rec["npmi"] is None
+        mon.observe(1, {"params/beta": _mixed_beta(seed=9)})
+        assert not mon.collapsed
+
+    def test_cadence_and_history_bound(self):
+        mon = self._monitor(every=3, history=4)
+        assert [r for r in range(7) if mon.should_run(r)] == [0, 3, 6]
+        for r in range(10):
+            mon.observe(r, {"params/beta": _block_beta()})
+        status = mon.status()
+        assert len(status["history"]) == 4
+        assert status["last"]["round"] == 9
+        # topics elided from history rows, present on last
+        assert "topics" not in status["history"][0]
+        assert status["last"]["topics"]
+
+    def test_events_and_gauges(self):
+        m = MetricsLogger(validate=True)
+        mon = self._monitor(metrics=m)
+        mon.observe(0, {"params/beta": _block_beta()})
+        mon.observe(1, {"params/beta": _block_beta(noise=0.05)})
+        assert len(m.events("quality_computed")) == 2
+        assert len(m.events("topic_drift")) == 1
+        assert m.registry.get("quality_npmi").value is not None
+        assert m.registry.get("quality_drift_mean").value is not None
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            self._monitor(every=0)
+        with pytest.raises(ValueError):
+            self._monitor(topn=1)
+        with pytest.raises(ValueError):
+            self._monitor(guard_drop=0.0)
+        with pytest.raises(ValueError):
+            self._monitor(history=0)
+
+
+class TestReferenceCorpus:
+    def test_text_file(self, tmp_path):
+        path = tmp_path / "ref.txt"
+        path.write_text("b0w00 b0w01\n\nb1w02 b1w03\n")
+        corpus = load_reference_corpus(str(path))
+        assert corpus == [["b0w00", "b0w01"], ["b1w02", "b1w03"]]
+
+    def test_npz_archive(self, tmp_path):
+        from gfedntm_tpu.data.synthetic import (
+            generate_synthetic_corpus,
+            save_reference_npz,
+        )
+
+        corpus = generate_synthetic_corpus(
+            n_nodes=2, n_docs=5, n_topics=2, vocab_size=30,
+            nwords=(6, 10), seed=0,
+        )
+        path = tmp_path / "ref.npz"
+        save_reference_npz(corpus, str(path))
+        loaded = load_reference_corpus(str(path))
+        assert len(loaded) == 10
+        assert all(w.startswith("wd") for w in loaded[0])
+
+    def test_empty_file_rejected(self, tmp_path):
+        path = tmp_path / "empty.txt"
+        path.write_text("\n")
+        with pytest.raises(ValueError):
+            load_reference_corpus(str(path))
+
+
+# ---- contribution analytics -------------------------------------------------
+
+class TestContributionStats:
+    def _case(self, n=4, seed=0):
+        rng = np.random.default_rng(seed)
+        tmpl = {
+            "a": np.zeros((6, 9), np.float32),
+            "b": np.zeros((17,), np.float32),
+            "n": np.zeros((), np.int32),
+        }
+
+        def draw(base=0.0):
+            return {
+                k: (
+                    (base + rng.normal(size=v.shape)).astype(np.float32)
+                    if v.dtype == np.float32
+                    else np.asarray(3, v.dtype)
+                )
+                for k, v in tmpl.items()
+            }
+
+        snaps = [draw() for _ in range(n)]
+        glob = draw()
+        avg = draw()
+        return tmpl, snaps, glob, avg
+
+    def test_aggregate_equal_to_update_scores_cos_one(self):
+        _tmpl, snaps, glob, _avg = self._case(n=1)
+        cos, norms, pm, pmin = contribution_stats([snaps[0]], glob,
+                                                  snaps[0])
+        assert cos[0] == pytest.approx(1.0, abs=1e-12)
+        assert norms[0] > 0
+        assert np.isnan(pm) and np.isnan(pmin)  # no pairs with n=1
+
+    def test_pairwise_summary_reflects_dispersion(self):
+        glob = {"a": np.zeros(4, np.float32)}
+        aligned = [
+            {"a": np.array([1, 0, 0, 0], np.float32)},
+            {"a": np.array([2, 0, 0, 0], np.float32)},
+        ]
+        opposed = [
+            {"a": np.array([1, 0, 0, 0], np.float32)},
+            {"a": np.array([-1, 0, 0, 0], np.float32)},
+        ]
+        avg = {"a": np.array([0.5, 0, 0, 0], np.float32)}
+        _c, _n, pm_aligned, _ = contribution_stats(aligned, glob, avg)
+        _c, _n, pm_opposed, _ = contribution_stats(opposed, glob, avg)
+        assert pm_aligned == pytest.approx(1.0)
+        assert pm_opposed == pytest.approx(-1.0)
+
+    def test_gram_finisher_guards_zero_norms(self):
+        dots = np.zeros((3, 3))
+        cos, norms, pm, pmin = contribution_from_gram(dots)
+        assert np.all(cos == 0) and np.all(norms == 0)
+
+    def test_device_parity(self):
+        from gfedntm_tpu.federation.device_agg import (
+            DeviceAggEngine,
+            FlatPlane,
+            stack_round,
+        )
+
+        tmpl, snaps, glob, avg = self._case(n=5, seed=3)
+        cos_n, norm_n, pm_n, pmin_n = contribution_stats(
+            snaps, glob, avg
+        )
+        engine = DeviceAggEngine()
+        plane = FlatPlane(tmpl)
+        stacked = stack_round(
+            engine, plane, [(1.0, s) for s in snaps], current_global=glob
+        )
+        cos_d, norm_d, pm_d, pmin_d = engine.contribution_stats(
+            stacked, avg
+        )
+        np.testing.assert_allclose(cos_d, cos_n, atol=1e-6)
+        np.testing.assert_allclose(norm_d, norm_n, rtol=1e-6)
+        assert pm_d == pytest.approx(pm_n, abs=1e-6)
+        assert pmin_d == pytest.approx(pmin_n, abs=1e-6)
+
+    def test_gate_stacked_round_carries_gvec(self):
+        from gfedntm_tpu.federation.device_agg import DeviceAggEngine
+        from gfedntm_tpu.federation.sanitize import UpdateGate
+
+        tmpl, snaps, glob, avg = self._case(n=4, seed=5)
+        gate = UpdateGate(mad_k=0.0)
+        gate.set_template(tmpl)
+        gate.set_engine(DeviceAggEngine())
+        result = gate.admit_round(
+            [(i + 1, 1.0, s) for i, s in enumerate(snaps)], glob, 0
+        )
+        assert result.stacked is not None
+        assert result.stacked.gvec is not None
+        cos_d, _n, _pm, _pmin = result.stacked.engine.contribution_stats(
+            result.stacked, avg
+        )
+        cos_n, _n2, _pm2, _pmin2 = contribution_stats(snaps, glob, avg)
+        np.testing.assert_allclose(cos_d, cos_n, atol=1e-6)
+
+    def test_missing_gvec_is_loud(self):
+        from gfedntm_tpu.federation.device_agg import (
+            DeviceAggEngine,
+            FlatPlane,
+            stack_round,
+        )
+
+        tmpl, snaps, _glob, avg = self._case(n=2)
+        engine = DeviceAggEngine()
+        stacked = stack_round(
+            engine, FlatPlane(tmpl), [(1.0, s) for s in snaps]
+        )
+        with pytest.raises(ValueError, match="gvec"):
+            engine.contribution_stats(stacked, avg)
+
+
+class TestContributionTracker:
+    def test_ewma_and_status(self):
+        reg = MetricRegistry()
+        tr = ContributionTracker(registry=reg, alpha=0.5)
+        tr.observe_round(0, [1, 2], np.array([1.0, 0.0]),
+                         np.array([3.0, 1.0]), 0.5, 0.2)
+        tr.observe_round(1, [1, 2], np.array([0.0, 0.0]),
+                         np.array([1.0, 1.0]), 0.8, 0.1)
+        st = tr.status()
+        assert st["clients"]["1"]["cos_ewma"] == pytest.approx(0.5)
+        assert st["clients"]["1"]["rounds"] == 2
+        assert st["pairwise_cos_mean"] == pytest.approx(0.8)
+        assert reg.get("client_contribution_cos/client1").value == (
+            pytest.approx(0.5)
+        )
+        assert reg.get("contribution_pairwise_cos_mean").value == (
+            pytest.approx(0.8)
+        )
+
+    def test_forget_drops_gauges(self):
+        reg = MetricRegistry()
+        tr = ContributionTracker(registry=reg)
+        tr.observe_round(0, [7], np.array([0.9]), np.array([1.0]),
+                         float("nan"), float("nan"))
+        assert reg.get("client_contribution_cos/client7") is not None
+        tr.forget(7)
+        assert reg.get("client_contribution_cos/client7") is None
+        assert reg.get("client_contribution_share/client7") is None
+        assert "7" not in tr.status()["clients"]
+
+    def test_zero_norm_cohort_has_zero_shares(self):
+        tr = ContributionTracker()
+        tr.observe_round(0, [1], np.array([0.0]), np.array([0.0]),
+                         float("nan"), float("nan"))
+        assert tr.status()["clients"]["1"]["share_ewma"] == 0.0
+
+
+# ---- cardinality guards -----------------------------------------------------
+
+class TestCardinalityGuards:
+    def test_registry_drop(self):
+        reg = MetricRegistry()
+        reg.gauge("g/one").set(1.0)
+        assert reg.drop("g/one") is True
+        assert reg.drop("g/one") is False
+        assert reg.get("g/one") is None
+
+    def test_straggler_forget_evicts_gauge(self):
+        reg = MetricRegistry()
+        det = StragglerDetector(registry=reg)
+        det.observe_round({1: 0.5, 2: 0.6, 3: 0.7})
+        assert reg.get("client_step_ewma_s/client2") is not None
+        det.forget(2)
+        assert reg.get("client_step_ewma_s/client2") is None
+
+    def test_render_prometheus_caps_series_with_overflow_counter(self):
+        reg = MetricRegistry()
+        for i in range(10):
+            reg.gauge(f"client_poll/client{i:02d}").set(float(i))
+        text = render_prometheus(reg.snapshot(), max_series=4)
+        assert text.count("gfedntm_client_poll{") == 4
+        assert (
+            'gfedntm_series_overflow_total{family="client_poll"} 6'
+            in text
+        )
+        # cap disabled: every series + no overflow family
+        full = render_prometheus(reg.snapshot(), max_series=0)
+        assert full.count("gfedntm_client_poll{") == 10
+        assert "series_overflow" not in full
+
+
+# ---- report engines ---------------------------------------------------------
+
+def _quality_records():
+    t = 1000.0
+    recs = [
+        {"event": "quality_computed", "time": t, "round": 0,
+         "npmi": -0.5, "diversity": 0.6, "irbo": 0.7,
+         "topics": [["a", "b"], ["c", "d"]]},
+        {"event": "quality_computed", "time": t + 1, "round": 1,
+         "npmi": -0.1, "diversity": 0.8, "irbo": 0.9},
+        {"event": "topic_drift", "time": t + 1, "round": 1,
+         "mean_drift": 0.02, "max_drift": 0.05, "mean_js": 0.01,
+         "churn": 0},
+        {"event": "quality_computed", "time": t + 2, "round": 2,
+         "npmi": -0.6, "diversity": 0.5, "irbo": 0.4},
+        {"event": "topic_drift", "time": t + 2, "round": 2,
+         "mean_drift": 0.7, "max_drift": 0.9, "mean_js": 0.5,
+         "churn": 2},
+        {"event": "update_rejected", "time": t, "client": 3, "round": 2,
+         "reason": "nonfinite", "detail": "x"},
+        {"event": "update_clipped", "time": t, "client": 2, "round": 2,
+         "norm": 9.0, "max_norm": 1.0},
+        {"event": "divergence_rollback", "time": t + 2, "round": 2,
+         "reason": "coherence_collapse", "restored_round": 1},
+        {"event": "client_quarantined", "time": t + 2, "client": 3,
+         "round": 2},
+        {"event": "metrics_snapshot", "time": t + 3, "metrics": {
+            "client_contribution_cos/client1": {
+                "type": "gauge", "value": 0.92},
+            "client_contribution_share/client1": {
+                "type": "gauge", "value": 0.4},
+            "contribution_pairwise_cos_mean": {
+                "type": "gauge", "value": 0.55},
+            "contribution_pairwise_cos_min": {
+                "type": "gauge", "value": 0.2},
+        }},
+    ]
+    return recs
+
+
+class TestQualityReport:
+    def test_summarize_model_quality(self):
+        s = summarize_model_quality(_quality_records())
+        assert [row["round"] for row in s["quality"]] == [0, 1, 2]
+        assert s["quality"][2]["churn"] == 2
+        assert s["contributions"]["1"]["cos_ewma"] == 0.92
+        assert s["pairwise"]["cos_mean"] == 0.55
+        assert s["data_plane"]["rejections"]["3"]["nonfinite"] == 1
+        assert s["data_plane"]["rollbacks"][0]["reason"] == (
+            "coherence_collapse"
+        )
+
+    def test_monotone_coherence_check(self):
+        s = summarize_model_quality(_quality_records())
+        # npmi peaks at -0.1 (round 1) then falls to -0.6: a 0.5 drop
+        assert check_monotone_coherence(s, tolerance=0.6) == []
+        violations = check_monotone_coherence(s, tolerance=0.3)
+        assert len(violations) == 1 and "round 2" in violations[0]
+        # empty stream is itself a violation
+        assert check_monotone_coherence(
+            summarize_model_quality([]), 0.1
+        )
+
+    def test_monotone_check_rejects_npmi_free_stream(self):
+        """Quality rounds without NPMI (no --quality_ref) must FAIL the
+        gate, not pass vacuously — a coherence gate that measured no
+        coherence is not green."""
+        recs = [
+            {"event": "quality_computed", "time": 1.0, "round": r,
+             "npmi": None, "diversity": 0.5, "irbo": 0.5}
+            for r in range(3)
+        ]
+        violations = check_monotone_coherence(
+            summarize_model_quality(recs), 0.1
+        )
+        assert violations and "--quality_ref" in violations[0]
+
+    def test_format_quality_report_renders(self):
+        text = format_quality_report(
+            summarize_model_quality(_quality_records())
+        )
+        assert "3 quality rounds" in text
+        assert "coherence_collapse" in text
+        assert "cohort dispersion" in text
+        assert "topic 0: a b" in text
+
+    def test_summarize_metrics_data_plane_section(self):
+        s = summarize_metrics(_quality_records())
+        assert s["data_plane"]["clips"]["2"] == 1
+        text = format_report(s)
+        assert "data plane" in text
+        assert "client 3: 1 rejected (nonfinite:1)" in text
+        assert "quarantined: client 3 x1" in text
+
+    def test_report_cli(self, tmp_path, capsys):
+        path = tmp_path / "metrics.jsonl"
+        with open(path, "w") as fh:
+            for r in _quality_records():
+                fh.write(json.dumps(r) + "\n")
+        out_json = tmp_path / "q.json"
+        assert cli_main(["report", str(path), "--json",
+                         str(out_json)]) == 0
+        assert "model-quality report" in capsys.readouterr().out
+        assert json.loads(out_json.read_text())["quality"]
+        assert cli_main(["report", str(path),
+                         "--assert-monotone-coherence", "0.6"]) == 0
+        assert cli_main(["report", str(path),
+                         "--assert-monotone-coherence", "0.3"]) == 1
+
+
+def test_parser_quality_flags():
+    args = build_parser().parse_args([
+        "--quality_every", "5", "--quality_ref", "ref.txt",
+        "--quality_topn", "8", "--quality_guard",
+    ])
+    assert args.quality_every == 5
+    assert args.quality_ref == "ref.txt"
+    assert args.quality_topn == 8
+    assert args.quality_guard is True
+    defaults = build_parser().parse_args([])
+    assert defaults.quality_every == 0
+    assert defaults.quality_guard is False
+
+
+# ---- server seam ------------------------------------------------------------
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=3,
+    seed=0, lr=2e-2,
+)
+
+
+class TestServerSeam:
+    def test_quality_off_by_default_is_inert(self):
+        metrics = MetricsLogger(validate=True)
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            metrics=metrics,
+        )
+        avg = {"params/beta": np.ones((3, 4), np.float32)}
+        out = server._quality_step(0, [], avg)
+        assert out is avg
+        assert server._status()["model_quality"] is None
+        assert not metrics.events("quality_computed")
+        assert metrics.registry.get("quality_npmi") is None
+
+    def test_quality_every_validation(self):
+        with pytest.raises(ValueError):
+            FederatedServer(
+                min_clients=1, family="avitm",
+                model_kwargs=MODEL_KWARGS, quality_every=-1,
+            )
+
+    def test_contributions_measure_accepted_aggregate_not_rollback(self):
+        """When a loss-guardian rollback already swapped the broadcast
+        for a restored checkpoint, contribution cosines are still
+        measured against the cohort's OWN aggregate — cosine to the
+        rollback delta would make every honest client look
+        adversarial."""
+        metrics = MetricsLogger(validate=True)
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            metrics=metrics, quality_every=1,
+        )
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        server.global_vocab = Vocabulary(tuple(VOCAB))
+        server.template = object()  # _current_global guard (unused below)
+        server.last_average = {
+            "params/beta": np.zeros((3, 24), np.float32)
+        }
+        server._round_accepted = [(1, 1.0, 0.5)]
+        up = np.ones((3, 24), np.float32)
+        snapshots = [(1.0, {"params/beta": up})]
+        accepted = {"params/beta": up.copy()}         # cohort aggregate
+        restored = {"params/beta": -up}               # rollback state
+        server._quality_step(0, snapshots, restored, accepted)
+        cos = metrics.registry.get(
+            "client_contribution_cos/client1"
+        ).value
+        # vs the accepted aggregate the update IS the aggregate (cos 1);
+        # vs the restored state it would be -1
+        assert cos == pytest.approx(1.0, abs=1e-9)
+
+    def test_guard_without_checkpoint_keeps_firing(self, tmp_path):
+        """A coherence-collapse verdict with nothing to restore must NOT
+        re-anchor the monitor: the streak stays open and the verdict
+        keeps firing (the loss guardian's no-checkpoint semantics), so
+        the collapsed coherence can never become the quiet baseline."""
+        metrics = MetricsLogger(validate=True)
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            metrics=metrics, save_dir=None, checkpoint_every=0,
+            divergence_patience=0,  # rollback path must tolerate no guardian
+            quality_every=1, quality_guard=True,
+            quality_monitor_kwargs=dict(
+                guard_patience=1, guard_drop=0.25, guard_floor=0.05,
+            ),
+        )
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        server.global_vocab = Vocabulary(tuple(VOCAB))
+        server.quality_ref = None
+        mon = server._ensure_quality_monitor()
+        mon.ref_tokens = _ref_corpus()
+        server._round_accepted = []
+        good = {"params/beta": _block_beta().astype(np.float32)}
+        bad = {"params/beta": _mixed_beta().astype(np.float32)}
+        server._quality_step(0, [], good)
+        out = server._quality_step(1, [], bad)
+        assert out is bad  # nothing restored, aggregate kept
+        assert mon.collapsed  # streak NOT reset: verdict keeps firing
+        server._quality_step(2, [], bad)
+        assert mon.collapsed
+
+    def test_unreadable_reference_degrades_loudly(self, tmp_path):
+        metrics = MetricsLogger(validate=True)
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            metrics=metrics, quality_every=1,
+            quality_ref=str(tmp_path / "missing.txt"),
+        )
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        server.global_vocab = Vocabulary(tuple(VOCAB))
+        server._round_accepted = [(1, 1.0, 0.5)]
+        avg = {"params/beta": _block_beta().astype(np.float32)}
+        snapshots = [(1.0, dict(avg))]
+        out = server._quality_step(0, snapshots, avg)
+        assert out is avg
+        assert metrics.registry.get("quality_errors").value >= 1
+        # monitor was rebuilt without the reference: next round still
+        # computes diversity/drift (npmi None)
+        out = server._quality_step(1, snapshots, avg)
+        assert metrics.events("quality_computed")
+        assert metrics.events("quality_computed")[0]["npmi"] is None
+
+
+# ---- chaos e2e --------------------------------------------------------------
+
+def _write_ref(tmp_path, corpora):
+    path = tmp_path / "ref.txt"
+    with open(path, "w") as fh:
+        for c in corpora:
+            fh.write("\n".join(c.documents) + "\n")
+    return str(path)
+
+
+def _run_federation(tmp_path, corpora, tag, *, metrics, injector=None,
+                    **server_kw):
+    base = dict(
+        min_clients=len(corpora), family="avitm",
+        model_kwargs=MODEL_KWARGS, max_iters=40,
+        save_dir=str(tmp_path / f"{tag}-server"), metrics=metrics,
+        fault_injector=injector, checkpoint_every=0, round_backoff_s=0.05,
+    )
+    base.update(server_kw)
+    server = FederatedServer(**base)
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"{tag}-c{c + 1}"))
+        for c, corpus in enumerate(corpora)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=600), f"{tag}: did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+    return server, clients
+
+
+@pytest.mark.chaos
+def test_quality_plane_e2e_trajectory(tmp_path, capsys):
+    """ISSUE 7 acceptance: a 3-client federation with --quality_every 1
+    emits per-round NPMI/diversity/drift through JSONL, gauges, and
+    /status, and the `report` CLI reconstructs the trajectory from the
+    JSONL stream alone."""
+    corpora = [RawCorpus(documents=_block_docs(24, s)) for s in range(3)]
+    jsonl = tmp_path / "metrics.jsonl"
+    metrics = MetricsLogger(str(jsonl), validate=True, keep_records=True,
+                            node="server")
+    server, _clients = _run_federation(
+        tmp_path, corpora, "quality", metrics=metrics,
+        quality_every=1, quality_ref=_write_ref(tmp_path, corpora),
+        quality_topn=6,
+    )
+    quality = metrics.events("quality_computed")
+    assert len(quality) == server.global_iterations  # every round
+    assert all(np.isfinite(e["npmi"]) for e in quality)
+    assert all(0.0 <= e["diversity"] <= 1.0 for e in quality)
+    drift = metrics.events("topic_drift")
+    assert len(drift) == len(quality) - 1  # all but the first round
+    assert all(np.isfinite(e["mean_drift"]) for e in drift)
+
+    # /status carries the ring buffer + contribution EWMAs
+    mq = server._status()["model_quality"]
+    assert mq["every"] == 1
+    assert len(mq["history"]) == len(quality)
+    assert mq["last"]["round"] == quality[-1]["round"]
+    contrib = mq["contributions"]["clients"]
+    assert set(contrib) == {"1", "2", "3"}
+    assert all(-1.0 <= c["cos_ewma"] <= 1.0 for c in contrib.values())
+    assert mq["contributions"]["pairwise_cos_mean"] is not None
+
+    # gauges made it into the registry and the Prometheus exposition
+    assert metrics.registry.get("quality_npmi").value is not None
+    assert metrics.registry.get(
+        "client_contribution_cos/client1"
+    ).value is not None
+    prom = render_prometheus(metrics.registry.snapshot())
+    assert "gfedntm_quality_npmi" in prom
+    assert 'gfedntm_client_contribution_cos{key="client1"}' in prom
+
+    # `report` reconstructs the trajectory from JSONL alone
+    metrics.snapshot_registry()
+    metrics.close()
+    assert cli_main(["report", str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    assert f"{len(quality)} quality rounds" in out
+    assert "per-client contributions" in out
+
+
+@pytest.mark.chaos
+def test_topic_collapse_triggers_quality_guard(tmp_path, capsys):
+    """ISSUE 7 acceptance: a random-payload corrupted client with the
+    admission gate off drags the global beta into incoherence; the
+    report shows the coherence decay, and --quality_guard routes a
+    coherence_collapse verdict through the divergence-rollback path
+    (restored checkpoint round, codec session resets — the same
+    machinery as a loss divergence)."""
+    corpora = [RawCorpus(documents=_block_docs(24, s)) for s in range(3)]
+    injector = FaultInjector(seed=0)
+    injector.script("TrainStep", kind="corrupt", payload="random",
+                    times=64, peer="client3", skip=12)
+    jsonl = tmp_path / "metrics.jsonl"
+    metrics = MetricsLogger(str(jsonl), validate=True, keep_records=True,
+                            node="server")
+    kwargs = dict(MODEL_KWARGS, num_epochs=24)
+    server, _clients = _run_federation(
+        tmp_path, corpora, "collapse", metrics=metrics, injector=injector,
+        model_kwargs=kwargs, local_steps=4, sanitize=False,
+        divergence_patience=0, checkpoint_every=4,
+        quality_every=1, quality_ref=_write_ref(tmp_path, corpora),
+        quality_topn=6, quality_guard=True,
+        quality_monitor_kwargs=dict(
+            guard_drop=0.25, guard_floor=0.05, guard_patience=2,
+        ),
+    )
+    quality = {e["round"]: e["npmi"]
+               for e in metrics.events("quality_computed")}
+    # clean rounds climb; the corrupted rounds collapse well below them
+    clean_tail = np.mean([quality[r] for r in (10, 11)])
+    corrupt_head = np.mean([quality[r] for r in (12, 13)])
+    assert clean_tail > quality[0] + 0.2  # training visibly improved
+    assert corrupt_head < clean_tail - 0.3  # the collapse is visible
+
+    # the guard fired through the SAME verdict path as a loss divergence
+    rollbacks = metrics.events("divergence_rollback")
+    assert rollbacks and rollbacks[0]["reason"] == "coherence_collapse"
+    assert rollbacks[0]["restored_round"] == 12
+    assert metrics.registry.counter("divergence_rollbacks").value >= 1
+
+    # the decay is visible in the rendered report, and the monotone
+    # gate fails exactly as CI would want it to
+    metrics.snapshot_registry()
+    metrics.close()
+    assert cli_main(["report", str(jsonl),
+                     "--assert-monotone-coherence", "0.25"]) == 1
+    out = capsys.readouterr().out
+    assert "coherence_collapse" in out
