@@ -277,7 +277,10 @@ def run(backend: str) -> dict:
                 traced = trainer.fit(datasets, metrics=None)
                 jax.block_until_ready(traced.client_params)
             traced_fit_s = round(time.perf_counter() - t0, 2)
-        except Exception:
+        except Exception as err:
+            # The failure is banked into the summary's trace_dir field
+            # AND said out loud — a trace-less bench must name why.
+            sys.stderr.write(f"bench: profiler trace failed: {err!r}\n")
             trace_dir = f"profiler-failed-on-{backend}"
 
     global_steps = int(result.losses.shape[0])
@@ -847,6 +850,8 @@ def _cached_tpu_summary() -> "dict | None":
     try:
         with open(_TPU_ARTIFACT) as f:
             summary = json.load(f)
+    # graftlint: disable=exception-hygiene -- an unreadable/corrupt banked
+    # artifact means "no cached summary"; the caller reports the miss
     except Exception:  # noqa: BLE001
         return None
     if summary.get("backend") != "tpu":

@@ -1,0 +1,60 @@
+"""graftlint — repo-native static analysis for gfedntm-tpu.
+
+Machine-checks the invariants PRs 1-7 established by hand review:
+
+====== ==================== ===============================================
+id     rule                 invariant
+====== ==================== ===============================================
+GL001  telemetry-contract   events registered <=> emitted; span call
+                            sites; data-plane/model-quality reverse-lint
+GL002  precision-pin        gram-path jax matmuls pin Precision.HIGHEST
+GL003  donation-safety      donated buffers never referenced after the
+                            donating call (fallback retries included)
+GL004  lock-discipline      '# guarded-by: <lock>' attributes mutate only
+                            under 'with self.<lock>:'
+GL005  exception-hygiene    broad excepts in the planes log/count/
+                            delegate/re-raise — never silent
+====== ==================== ===============================================
+
+Run it::
+
+    python -m gfedntm_tpu.analysis            # whole repo, with baseline
+    python scripts/graftlint.py               # same (shim)
+    python -m gfedntm_tpu.analysis --list-rules
+
+Suppress one finding inline (justification is free text for review)::
+
+    except Exception:  # graftlint: disable=exception-hygiene -- probe
+        ...
+
+Accept a finding into the baseline (``scripts/lint_baseline.json``)::
+
+    python -m gfedntm_tpu.analysis --update-baseline
+    # then FILL IN the empty "justification" fields — the gate fails
+    # on baselined findings without one.
+"""
+
+from __future__ import annotations
+
+from gfedntm_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+    collect_default_files,
+    load_source,
+    run_rules,
+)
+from gfedntm_tpu.analysis.runner import LintResult, run_lint
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "LintResult",
+    "Rule",
+    "SourceFile",
+    "collect_default_files",
+    "load_source",
+    "run_lint",
+    "run_rules",
+]

@@ -1,0 +1,40 @@
+"""graftlint rule registry.
+
+Adding an analyzer: implement a :class:`~gfedntm_tpu.analysis.core.Rule`
+subclass in a module here, then register an instance in
+:func:`make_default_rules` — the single list every execution path (the
+CLI, ``scripts/check.sh``, the shims, ``run_lint``) draws from. See
+README "Static analysis" for the checklist, and
+``tests/test_analysis.py`` for the fixture pattern every rule ships
+with: at least one seeded violation it catches and one negative fixture
+it stays quiet on.
+"""
+
+from __future__ import annotations
+
+from gfedntm_tpu.analysis.rules.donation import DonationSafetyRule
+from gfedntm_tpu.analysis.rules.exceptions import ExceptionHygieneRule
+from gfedntm_tpu.analysis.rules.locks import LockDisciplineRule
+from gfedntm_tpu.analysis.rules.precision import PrecisionPinRule
+from gfedntm_tpu.analysis.rules.telemetry import TelemetryContractRule
+
+__all__ = [
+    "make_default_rules",
+    "DonationSafetyRule",
+    "ExceptionHygieneRule",
+    "LockDisciplineRule",
+    "PrecisionPinRule",
+    "TelemetryContractRule",
+]
+
+
+def make_default_rules() -> list:
+    """Fresh instances of every registered rule (rules are stateless,
+    but fresh instances keep test re-scoping from leaking)."""
+    return [
+        TelemetryContractRule(),
+        PrecisionPinRule(),
+        DonationSafetyRule(),
+        LockDisciplineRule(),
+        ExceptionHygieneRule(),
+    ]

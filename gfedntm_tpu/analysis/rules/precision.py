@@ -1,0 +1,162 @@
+"""GL002 precision-pin: gram-style device matmuls must pin HIGHEST.
+
+Ground truth (PR 6 review pass): TPUs default f32 matmuls to bf16
+passes, and the gram identity ``||a-b||^2 = ||a||^2 + ||b||^2 - 2ab``
+cancels catastrophically for nearby rows — exactly the distances Krum
+ranks and the cosines contribution analytics report — so an unpinned
+matmul is bitwise-fine on the CPU test mesh and silently wrong on the
+hardware the code exists for. In the gram-path modules
+(``federation/device_agg.py``, ``federation/aggregation.py``,
+``eval/monitor.py``) every jax matmul must pin
+``precision=jax.lax.Precision.HIGHEST``.
+
+Mechanics: only *jax-traced* scopes are checked — a function (or the
+module body) counts as jax-traced when its own statements reference the
+``jnp``/``jax``/``lax`` roots. Inside such a scope:
+
+- calls to ``{jnp,jax,lax}...{matmul,dot,dot_general,tensordot,vdot,
+  einsum}`` must carry a ``precision=`` keyword naming ``HIGHEST``;
+- a bare ``@`` (``ast.MatMult``) is flagged unless both operands are
+  provably numpy-derived (host oracles like ``aggregation.Krum`` and
+  ``monitor._cosine_matrix`` run pure numpy and are exempt both ways:
+  their scopes reference no jax root, and their operands carry np
+  taint).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gfedntm_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+    attr_root,
+    expr_roots,
+    iter_scopes,
+    walk_scope,
+)
+
+JAX_ROOTS = frozenset({"jnp", "jax", "lax"})
+NP_ROOTS = frozenset({"np", "numpy"})
+MATMUL_ATTRS = frozenset(
+    {"matmul", "dot", "dot_general", "tensordot", "vdot", "einsum"}
+)
+
+
+def _mentions_jax(body: list[ast.stmt]) -> bool:
+    for n in walk_scope(body):
+        if isinstance(n, ast.Name) and n.id in JAX_ROOTS:
+            return True
+    return False
+
+
+def _precision_is_highest(kw_value: ast.AST) -> bool:
+    for n in ast.walk(kw_value):
+        if isinstance(n, ast.Attribute) and n.attr == "HIGHEST":
+            return True
+        if isinstance(n, ast.Constant) and str(n.value).upper() == "HIGHEST":
+            return True
+    return False
+
+
+class PrecisionPinRule(Rule):
+    id = "GL002"
+    name = "precision-pin"
+    description = (
+        "jax matmuls in gram-path modules must pin "
+        "precision=Precision.HIGHEST (TPU bf16 passes cancel in gram "
+        "identities)"
+    )
+    default_paths = (
+        "gfedntm_tpu/federation/device_agg.py",
+        "gfedntm_tpu/federation/aggregation.py",
+        "gfedntm_tpu/eval/monitor.py",
+    )
+
+    HINT = (
+        "use jnp.matmul(..., precision=jax.lax.Precision.HIGHEST) — "
+        "TPU f32 matmuls default to bf16 passes and the gram identity "
+        "cancels catastrophically for nearby rows"
+    )
+
+    def check_file(self, src: SourceFile, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for _scope, body in iter_scopes(src.tree):
+            if not _mentions_jax(body):
+                continue
+            np_tainted: set[str] = set()
+            # Taint propagates in statement order within the scope:
+            # collect (node, kind) events sorted by position.
+            nodes = sorted(
+                (n for n in walk_scope(body)
+                 if isinstance(n, (ast.Assign, ast.BinOp, ast.Call))),
+                key=lambda n: (n.lineno, n.col_offset),
+            )
+            for node in nodes:
+                if isinstance(node, ast.Assign):
+                    self._propagate_taint(node, np_tainted)
+                elif isinstance(node, ast.Call):
+                    f = self._check_call(node, src)
+                    if f is not None:
+                        out.append(f)
+                elif isinstance(node.op, ast.MatMult):
+                    if not (
+                        self._np_derived(node.left, np_tainted)
+                        and self._np_derived(node.right, np_tainted)
+                    ):
+                        out.append(self.finding(
+                            src, node.lineno,
+                            "bare '@' matmul in a jax-traced scope has no "
+                            "precision pin",
+                            hint=self.HINT,
+                        ))
+        return out
+
+    def _propagate_taint(self, node: ast.Assign, tainted: set[str]) -> None:
+        roots = expr_roots(node.value)
+        is_np = bool(roots & NP_ROOTS) or (
+            bool(roots) and roots <= (tainted | NP_ROOTS)
+        )
+        is_jax = bool(roots & JAX_ROOTS)
+        for tgt in node.targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    if is_np and not is_jax:
+                        tainted.add(n.id)
+                    else:
+                        tainted.discard(n.id)
+
+    def _np_derived(self, node: ast.AST, tainted: set[str]) -> bool:
+        roots = expr_roots(node)
+        if not roots:
+            return False
+        return all(r in NP_ROOTS or r in tainted for r in roots)
+
+    def _check_call(self, node: ast.Call, src: SourceFile) -> Finding | None:
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute) and func.attr in MATMUL_ATTRS
+        ):
+            return None
+        if attr_root(func) not in JAX_ROOTS:
+            return None
+        precision = next(
+            (kw for kw in node.keywords if kw.arg == "precision"), None
+        )
+        if precision is None:
+            return self.finding(
+                src, node.lineno,
+                f"{ast.unparse(func)}() in a gram-path module has no "
+                "precision= pin",
+                hint=self.HINT,
+            )
+        if not _precision_is_highest(precision.value):
+            return self.finding(
+                src, node.lineno,
+                f"{ast.unparse(func)}() pins precision="
+                f"{ast.unparse(precision.value)}, not Precision.HIGHEST",
+                hint=self.HINT,
+            )
+        return None

@@ -60,7 +60,10 @@ class Federation:
     """Registry of connected clients with quorum signalling."""
 
     min_clients: int = 1
-    _clients: dict[int, ClientRecord] = field(default_factory=dict)
+    # Mutated by gRPC servicer threads (connect/disconnect) and read by
+    # the training loop; _cond wraps the same RLock, so holding either
+    # guards the registry.
+    _clients: dict[int, ClientRecord] = field(default_factory=dict)  # guarded-by: _lock, _cond
     _lock: threading.RLock = field(default_factory=threading.RLock)
 
     def __post_init__(self):

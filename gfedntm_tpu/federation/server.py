@@ -239,7 +239,13 @@ class FederatedServer:
         self._downlink_enc = DownlinkEncoder(self.wire_codec, metrics=metrics)
         # Clients that acked the most recent push — a push may only be
         # delta-encoded when every recipient holds the previous broadcast.
-        self._push_acked: set[int] = set()
+        # Written by the training loop (round push results, rollback
+        # clears) AND by gRPC servicer threads (a rejoiner is discarded in
+        # ReadyForTraining), so every mutation holds _push_lock: a lost
+        # discard would let the next push delta-encode against a broadcast
+        # the fresh process never held.
+        self._push_lock = threading.Lock()
+        self._push_acked: set[int] = set()  # guarded-by: _push_lock
         # Set by a divergence rollback: the NEXT push carries
         # Aggregate.reset_session so every recipient drops its wire-codec
         # session state (delta refs + error-feedback residuals) before
@@ -304,9 +310,13 @@ class FederatedServer:
         self.global_iterations = 0
 
         self._setup_lock = threading.Lock()
-        self._setup_reply: pb.GlobalSetup | None = None
+        # Built exactly once under _setup_lock — every joiner blocked in
+        # GetGlobalSetup must receive the SAME consensus reply.
+        self._setup_reply: pb.GlobalSetup | None = None  # guarded-by: _setup_lock
         self._train_lock = threading.Lock()
-        self._train_thread: threading.Thread | None = None
+        # Started exactly once under _train_lock by whichever
+        # ReadyForTraining completes quorum.
+        self._train_thread: threading.Thread | None = None  # guarded-by: _train_lock
         # _stopping is set BEFORE the stop-broadcast client snapshot so a
         # ReadyForTraining that lands in the shutdown window (after the
         # snapshot, before training_done) is turned away with code=1 instead
@@ -729,7 +739,8 @@ class FederatedServer:
         # reference — it must not count as having acked the last push, or
         # the next push could be delta-encoded against state it never held.
         # Its straggler history is a different process's too.
-        self._push_acked.discard(request.client_id)
+        with self._push_lock:
+            self._push_acked.discard(request.client_id)
         self.straggler.forget(request.client_id)
         self.contributions.forget(request.client_id)
         # Re-check after registering: if the training loop began shutting
@@ -919,7 +930,11 @@ class FederatedServer:
                     if jax.default_backend() not in ("cpu",)
                     else "numpy"
                 )
-            except Exception:  # no usable jax backend at all
+            except Exception as err:  # no usable jax backend at all
+                self.logger.warning(
+                    "aggregation backend auto-resolve: jax backend "
+                    "probe failed (%r); using numpy", err,
+                )
                 mode = "numpy"
         if mode == "device":
             try:
@@ -1073,7 +1088,9 @@ class FederatedServer:
                 round=iteration, reset_session=reset_session,
             )
         repliers = {rec.client_id for rec, _reply in replies}
-        allow_delta = bool(self._push_acked) and repliers <= self._push_acked
+        with self._push_lock:
+            acked = set(self._push_acked)
+        allow_delta = bool(acked) and repliers <= acked
         bundle, client_view = self._downlink_enc.encode(
             average, round_idx=iteration, allow_delta=allow_delta
         )
@@ -1146,7 +1163,8 @@ class FederatedServer:
         # Clients hold session state too (delta refs AND error-feedback
         # residuals carrying un-delivered diverged mass): the re-broadcast
         # orders them to reset theirs via Aggregate.reset_session.
-        self._push_acked.clear()
+        with self._push_lock:
+            self._push_acked.clear()
         self._session_reset_pending = True
         if not self.wire_codec.identity:
             self._uplink_dec.reset()
@@ -1599,10 +1617,19 @@ class FederatedServer:
                         return None
 
                 with span(m, "push", parent=round_sp, clients=len(replies)):
-                    self._push_acked = {
+                    acked = {
                         cid for cid in pool.map(push, replies)
                         if cid is not None
                     }
+                    # Install under the lock so a ReadyForTraining
+                    # rejoin's discard can never interleave with the
+                    # swap. (A rejoin that lands between ack collection
+                    # and this install may still appear acked for one
+                    # push — that mis-encode fails LOUDLY client-side as
+                    # a ReferenceMismatch and heals on the next push;
+                    # the lock closes the silent lost-discard window.)
+                    with self._push_lock:
+                        self._push_acked = acked
                 if m is not None:
                     round_sp.annotate(
                         bytes_pushed=agg.ByteSize() * len(replies)

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Shim: run the graftlint static-analysis suite.
+
+The implementation lives in ``gfedntm_tpu/analysis/`` (rules, baseline,
+CLI) — this wrapper exists so the gate is invocable as a script next to
+its siblings (``scripts/check.sh`` stage "graftlint"). Same flags, same
+exit codes as ``python -m gfedntm_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+)
+
+from gfedntm_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

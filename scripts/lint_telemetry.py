@@ -1,140 +1,51 @@
 #!/usr/bin/env python
-"""Telemetry-schema lint: every event the codebase emits must be registered.
+"""Telemetry-schema lint — now a shim over the graftlint framework.
 
-Scans ``gfedntm_tpu`` (plus ``bench.py``) for ``<logger>.log("<event>", ...)``
-call sites and asserts each event name appears in
-``observability.EVENT_SCHEMAS`` — the documented contract the ``summarize``
-CLI and the JSONL stream validators run on. An unregistered event would
-pass silently in un-validated production loggers and then explode the first
-time a test constructs ``MetricsLogger(validate=True)``; this lint moves
-that failure to check time.
-
-Exit code 0 = clean; 1 = drift (unregistered events listed on stderr).
+The implementation moved to
+``gfedntm_tpu/analysis/rules/telemetry.py`` (rule ``telemetry-contract``
+/ GL001) when PR 8 folded the standalone script into the repo's
+static-analysis suite; run the full suite with
+``python -m gfedntm_tpu.analysis`` (or ``scripts/graftlint.py``). This
+wrapper keeps the historical entry point working — same checks, same
+exit codes (0 = clean, 1 = drift) — by running ONLY the telemetry rule,
+without the baseline (telemetry findings are never baselined: the
+schema is cheap to update and silence is the failure mode).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
-
-#: `<expr>.log("name", ...)` where <expr> ends in a metrics-ish name — the
-#: codebase's MetricsLogger handles are `metrics`, `m`, `logger.metrics`,
-#: `self.metrics`. Python `logging` handles are `logger`/`self.logger` and
-#: use level methods (.info/.warning), never `.log("str")`, so a plain
-#: `.log("` with a string literal first arg is a telemetry emission.
-_LOG_CALL = re.compile(r"""\.log\(\s*\n?\s*["']([a-z][a-z0-9_]*)["']""")
-
-#: `span(<logger-expr>, "name", ...)` call sites — the span-name vocabulary
-#: the trace-merge CLI keys on (observability.TRACE_PLANE_SPANS) must keep
-#: existing here, or `trace` would merge streams that can never contain the
-#: spans it aligns and parents by.
-_SPAN_CALL = re.compile(
-    r"""\bspan\(\s*\n?\s*[\w.()\[\]]+\s*,\s*\n?\s*["']([a-z][a-z0-9_]*)["']"""
-)
-
-SCAN_ROOTS = ("gfedntm_tpu", "bench.py")
-
-
-def _scan_paths() -> list[str]:
-    paths: list[str] = []
-    for root in SCAN_ROOTS:
-        full = os.path.join(REPO, root)
-        if os.path.isfile(full):
-            paths.append(full)
-            continue
-        for dirpath, _dirs, files in os.walk(full):
-            paths.extend(
-                os.path.join(dirpath, f) for f in files if f.endswith(".py")
-            )
-    return sorted(paths)
-
-
-def _call_sites(pattern: "re.Pattern") -> dict[str, list[str]]:
-    """Map of matched name -> list of ``path:line`` sites."""
-    sites: dict[str, list[str]] = {}
-    for path in _scan_paths():
-        text = open(path).read()
-        for m in pattern.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            rel = os.path.relpath(path, REPO)
-            sites.setdefault(m.group(1), []).append(f"{rel}:{line}")
-    return sites
-
-
-def emitted_events() -> dict[str, list[str]]:
-    """Map of event name -> list of ``path:line`` emission sites."""
-    return _call_sites(_LOG_CALL)
-
-
-def declared_spans() -> dict[str, list[str]]:
-    """Map of span name -> list of ``path:line`` span() call sites."""
-    return _call_sites(_SPAN_CALL)
+sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    sys.path.insert(0, REPO)
-    from gfedntm_tpu.utils.observability import (
-        DATA_PLANE_EVENTS,
-        EVENT_SCHEMAS,
-        MODEL_QUALITY_EVENTS,
-        TRACE_PLANE_SPANS,
+    from gfedntm_tpu.analysis.core import (
+        LintContext,
+        collect_default_files,
+        load_source,
+        run_rules,
     )
+    from gfedntm_tpu.analysis.rules.telemetry import TelemetryContractRule
 
-    sites = emitted_events()
-    if not sites:
-        sys.stderr.write("lint_telemetry: found no .log() call sites — "
-                         "the scanner regex is probably broken\n")
+    rule = TelemetryContractRule()
+    files = [load_source(p, REPO) for p in collect_default_files(REPO)]
+    findings = run_rules([rule], files, LintContext(root=REPO))
+    if findings:
+        sys.stderr.write("telemetry schema drift:\n")
+        for f in findings:
+            sys.stderr.write(f.render() + "\n")
         return 1
-    drift = {
-        name: where for name, where in sites.items()
-        if name not in EVENT_SCHEMAS
-    }
-    if drift:
-        sys.stderr.write(
-            "telemetry schema drift: events emitted but not registered in "
-            "observability.EVENT_SCHEMAS:\n"
-        )
-        for name, where in sorted(drift.items()):
-            sys.stderr.write(f"  {name!r}: {', '.join(where)}\n")
-        return 1
-    # Reverse direction for the data-plane defense AND model-quality
-    # events: each must keep at least one emission site AND a schema
-    # entry — a refactor that disconnects (or de-registers) the admission
-    # gate / guardian / ckpt integrity / quality-monitor telemetry would
-    # otherwise pass silently.
-    required = DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS
-    unemitted = [e for e in required if e not in sites]
-    unregistered = [e for e in required if e not in EVENT_SCHEMAS]
-    if unemitted or unregistered:
-        sys.stderr.write(
-            "data-plane/model-quality telemetry drift: "
-            f"events with no .log() call site: {unemitted}; "
-            f"events missing from EVENT_SCHEMAS: {unregistered}\n"
-        )
-        return 1
-    spans = declared_spans()
-    if not spans:
-        sys.stderr.write("lint_telemetry: found no span() call sites — "
-                         "the span scanner regex is probably broken\n")
-        return 1
-    missing = [n for n in TRACE_PLANE_SPANS if n not in spans]
-    if missing:
-        sys.stderr.write(
-            "trace-plane drift: span names the trace-merge CLI relies on "
-            f"(observability.TRACE_PLANE_SPANS) have no span() call site: "
-            f"{missing}\n"
-        )
-        return 1
+    scoped = [f for f in files if rule.applies_to(f.rel)]
+    events = rule.emitted_events(scoped)
+    spans = rule.declared_spans(scoped)
     print(
-        f"telemetry lint: {len(sites)} distinct events across "
-        f"{sum(len(w) for w in sites.values())} call sites, all "
-        f"registered; {len(spans)} span names cover the trace plane's "
-        f"{list(TRACE_PLANE_SPANS)}; all {len(DATA_PLANE_EVENTS)} "
-        f"data-plane defense + {len(MODEL_QUALITY_EVENTS)} model-quality "
-        "events wired"
+        f"telemetry lint: {len(events)} distinct events across "
+        f"{sum(len(v) for v in events.values())} call sites, all "
+        f"registered; {len(spans)} span names cover the trace plane "
+        "(full suite: python -m gfedntm_tpu.analysis)"
     )
     return 0
 

@@ -1,0 +1,862 @@
+"""graftlint static-analysis suite (gfedntm_tpu/analysis).
+
+Per-rule fixture tests (every rule catches >= 1 seeded violation and
+stays quiet on >= 1 negative fixture), suppression semantics, the
+baseline add/expire round-trip, and a self-run over the live repo
+asserting zero non-baselined findings — the check.sh gate's contract.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from gfedntm_tpu.analysis import run_lint
+from gfedntm_tpu.analysis.baseline import (
+    BaselineEntry,
+    load_baseline,
+    save_baseline,
+)
+from gfedntm_tpu.analysis.core import (
+    LintContext,
+    SourceFile,
+    load_source,
+    run_rules,
+)
+from gfedntm_tpu.analysis.rules import make_default_rules
+from gfedntm_tpu.analysis.rules.donation import DonationSafetyRule
+from gfedntm_tpu.analysis.rules.exceptions import ExceptionHygieneRule
+from gfedntm_tpu.analysis.rules.locks import LockDisciplineRule
+from gfedntm_tpu.analysis.rules.precision import PrecisionPinRule
+from gfedntm_tpu.analysis.rules.telemetry import TelemetryContractRule
+
+EVERYWHERE = ("",)  # path-prefix scope matching every fixture file
+
+
+def lint_src(tmp_path, rule, source: str, name: str = "fixture.py",
+             options: dict | None = None):
+    """Write one fixture module and run one rule over it (no baseline)."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    result = run_lint(
+        root=str(tmp_path), paths=[str(path)], rules=[rule],
+        use_baseline=False, options=options,
+    )
+    return result.new
+
+
+# ---------------------------------------------------------------------------
+# core: suppressions, scope pruning, parse errors
+# ---------------------------------------------------------------------------
+
+class TestCore:
+    BAD_EXCEPT = """
+    try:
+        x = 1
+    except Exception:
+        pass
+    """
+
+    def test_silent_except_is_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE),
+            self.BAD_EXCEPT,
+        )
+        assert len(found) == 1
+        assert found[0].rule_name == "exception-hygiene"
+        assert found[0].line == 4
+
+    def test_suppression_same_line(self, tmp_path):
+        src = self.BAD_EXCEPT.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=exception-hygiene",
+        )
+        assert lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE), src
+        ) == []
+
+    def test_suppression_comment_line_above(self, tmp_path):
+        src = self.BAD_EXCEPT.replace(
+            "except Exception:",
+            "# graftlint: disable=exception-hygiene -- probe, silence is"
+            "\n    # the answer here\n    except Exception:",
+        )
+        assert lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE), src
+        ) == []
+
+    def test_suppression_of_other_rule_does_not_apply(self, tmp_path):
+        src = self.BAD_EXCEPT.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=precision-pin",
+        )
+        found = lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE), src
+        )
+        assert len(found) == 1
+
+    def test_suppression_disable_all(self, tmp_path):
+        src = self.BAD_EXCEPT.replace(
+            "except Exception:",
+            "except Exception:  # graftlint: disable=all",
+        )
+        assert lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE), src
+        ) == []
+
+    def test_parse_error_is_a_finding_not_a_crash(self, tmp_path):
+        found = lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE),
+            "def broken(:\n    pass\n",
+        )
+        assert len(found) == 1
+        assert found[0].rule_name == "parse"
+
+    def test_scope_pruning_no_duplicate_findings(self, tmp_path):
+        # A violation inside a nested def must be reported exactly once
+        # (the enclosing scope walk prunes nested function bodies).
+        src = """
+        import jax, jax.numpy as jnp
+
+        def outer():
+            y = jnp.ones(3)
+            def gram(mat):
+                return jnp.matmul(mat, mat.T)
+            return gram
+        """
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), src
+        )
+        assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# GL001 telemetry-contract
+# ---------------------------------------------------------------------------
+
+def telemetry_contract(**over):
+    base = {
+        "events": {"good_event": frozenset({"x"})},
+        "required": {},
+        "spans": (),
+        "schema_module": "schemas.py",
+    }
+    base.update(over)
+    return {"telemetry": base}
+
+
+class TestTelemetryContract:
+    def test_unregistered_event_flagged_at_site(self, tmp_path):
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("rogue_event", x=1)\n',
+            options=telemetry_contract(),
+        )
+        assert len(found) == 1
+        assert "rogue_event" in found[0].message
+        assert found[0].line == 1
+
+    def test_registered_event_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("good_event", x=1)\n',
+            options=telemetry_contract(),
+        ) == []
+
+    def test_required_event_without_emission_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("good_event", x=1)\n',
+            options=telemetry_contract(
+                events={
+                    "good_event": frozenset(),
+                    "guard_event": frozenset(),
+                },
+                required={"DEFENSE": ("guard_event",)},
+            ),
+        )
+        assert len(found) == 1
+        assert "no .log() emission site" in found[0].message
+
+    def test_required_event_missing_from_schema_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("good_event", x=1)\n'
+            'metrics.log("good_event", x=2)\n',
+            options=telemetry_contract(
+                required={"DEFENSE": ("gone_event",)},
+            ),
+        )
+        msgs = " | ".join(f.message for f in found)
+        assert "missing from EVENT_SCHEMAS" in msgs
+        assert "no .log() emission site" in msgs
+
+    def test_missing_span_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("good_event", x=1)\n'
+            'with span(metrics, "poll"):\n    pass\n',
+            options=telemetry_contract(spans=("round", "poll")),
+        )
+        assert len(found) == 1
+        assert "'round'" in found[0].message
+
+    def test_spans_present_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("good_event", x=1)\n'
+            'with span(metrics, "round"):\n    pass\n',
+            options=telemetry_contract(spans=("round",)),
+        ) == []
+
+    def test_scanner_selfcheck_fires_on_zero_sites(self, tmp_path):
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            "x = 1\n",
+            options=telemetry_contract(),
+        )
+        assert len(found) == 1
+        assert "scanner regex" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# GL002 precision-pin
+# ---------------------------------------------------------------------------
+
+class TestPrecisionPin:
+    def test_unpinned_jnp_matmul_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax.numpy as jnp
+
+            def gram(mat):
+                return jnp.matmul(mat, mat.T)
+            """,
+        )
+        assert len(found) == 1
+        assert "no precision= pin" in found[0].message
+
+    def test_pinned_matmul_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax
+            import jax.numpy as jnp
+
+            def gram(mat):
+                return jnp.matmul(
+                    mat, mat.T, precision=jax.lax.Precision.HIGHEST
+                )
+            """,
+        ) == []
+
+    def test_non_highest_pin_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax
+            import jax.numpy as jnp
+
+            def gram(mat):
+                return jnp.matmul(
+                    mat, mat.T, precision=jax.lax.Precision.DEFAULT
+                )
+            """,
+        )
+        assert len(found) == 1
+        assert "not Precision.HIGHEST" in found[0].message
+
+    def test_bare_matmul_operator_in_jax_scope_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax.numpy as jnp
+
+            def gram(mat):
+                mat = jnp.asarray(mat)
+                return mat @ mat.T
+            """,
+        )
+        assert len(found) == 1
+        assert "bare '@'" in found[0].message
+
+    def test_numpy_oracle_clean(self, tmp_path):
+        # Pure-numpy host oracle: no jax root in scope -> skipped.
+        assert lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import numpy as np
+
+            def gram(mat):
+                flat = np.stack(mat)
+                return flat @ flat.T
+            """,
+        ) == []
+
+    def test_np_tainted_operands_in_jax_scope_clean(self, tmp_path):
+        # A jax-traced scope may still do host-side numpy math.
+        assert lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def mixed(mat):
+                dev = jnp.ones((2, 2))
+                host = np.asarray(mat)
+                d2 = host @ host.T
+                return dev, d2
+            """,
+        ) == []
+
+    def test_unpinned_matmul_in_lambda_flagged(self, tmp_path):
+        # Lambdas are scopes too — a gram matmul must not hide in one.
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax.numpy as jnp
+
+            gram = lambda mat: jnp.matmul(mat, mat.T)
+            """,
+        )
+        assert len(found) == 1
+
+    def test_unpinned_dot_general_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), """
+            import jax
+
+            def contract(a, b, dims):
+                return jax.lax.dot_general(a, b, dims)
+            """,
+        )
+        assert len(found) == 1
+
+    def test_default_scope_is_gram_path_modules(self):
+        rule = PrecisionPinRule()
+        assert rule.applies_to("gfedntm_tpu/federation/device_agg.py")
+        assert rule.applies_to("gfedntm_tpu/eval/monitor.py")
+        # The Pallas kernel deliberately runs reduced precision.
+        assert not rule.applies_to("gfedntm_tpu/ops/fused_decoder.py")
+
+
+# ---------------------------------------------------------------------------
+# GL003 donation-safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_use_after_donation_flagged(self, tmp_path):
+        found = lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            import jax
+
+            def run(step, state, batch):
+                prog = jax.jit(step, donate_argnums=(0,))
+                out = prog(state, batch)
+                return out, state.shape
+            """,
+        )
+        assert len(found) == 1
+        assert "'state'" in found[0].message
+        assert "referenced again" in found[0].message
+
+    def test_rebind_pattern_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            import jax
+
+            def run(step, state, batches):
+                prog = jax.jit(step, donate_argnums=(0,))
+                for batch in batches:
+                    state = prog(state, batch)
+                return state
+            """,
+        ) == []
+
+    def test_fallback_retry_hazard_flagged(self, tmp_path):
+        # The PR 6 composition hazard: an execution-time failure of a
+        # donating program leaves the state deleted; retrying with the
+        # SAME arrays reads dead buffers.
+        found = lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            def run(build, state):
+                prog = build(donate=True)
+                try:
+                    return prog(state)
+                except RuntimeError:
+                    return prog(state)
+            """,
+        )
+        assert len(found) == 1
+
+    def test_non_donated_position_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            import jax
+
+            def run(step, state, batch):
+                prog = jax.jit(step, donate_argnums=(0,))
+                new_state = prog(state, batch)
+                return new_state, batch.shape
+            """,
+        ) == []
+
+    def test_donation_helper_literal_positions(self, tmp_path):
+        # The repo's backend-gated helper counts as donating its literal
+        # argnums (trainer.py's federated program is built exactly so).
+        found = lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            from gfedntm_tpu.train.steps import donation_argnums
+
+            def run(timed_jit, fn, params, opt_state, batch):
+                prog = timed_jit(fn, donate_argnums=donation_argnums((0, 1)))
+                out = prog(params, opt_state, batch)
+                loss = params["w"].sum()
+                return out, loss
+            """,
+        )
+        assert len(found) == 1
+        assert "'params'" in found[0].message
+
+    def test_donate_false_build_clean(self, tmp_path):
+        assert lint_src(
+            tmp_path, DonationSafetyRule(paths=EVERYWHERE), """
+            def run(build, state):
+                prog = build(donate=False)
+                out = prog(state)
+                return out, state.shape
+            """,
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 lock-discipline
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    HEADER = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._cond = threading.Condition(self._lock)
+            self._items = {}  # guarded-by: _lock, _cond
+
+    """
+
+    def _lint(self, tmp_path, methods: str):
+        src = textwrap.dedent(self.HEADER) + textwrap.indent(
+            textwrap.dedent(methods), "    "
+        )
+        return lint_src(
+            tmp_path, LockDisciplineRule(paths=EVERYWHERE), src
+        )
+
+    def test_lockfree_mutation_flagged(self, tmp_path):
+        found = self._lint(tmp_path, """
+        def bad(self, k):
+            self._items.pop(k, None)
+        """)
+        assert len(found) == 1
+        assert "without holding" in found[0].message
+
+    def test_mutation_under_lock_clean(self, tmp_path):
+        assert self._lint(tmp_path, """
+        def good(self, k, v):
+            with self._lock:
+                self._items[k] = v
+        """) == []
+
+    def test_condition_alias_counts_as_the_lock(self, tmp_path):
+        assert self._lint(tmp_path, """
+        def good(self, k, v):
+            with self._cond:
+                self._items[k] = v
+        """) == []
+
+    def test_subscript_store_lockfree_flagged(self, tmp_path):
+        found = self._lint(tmp_path, """
+        def bad(self, k, v):
+            self._items[k] = v
+        """)
+        assert len(found) == 1
+        assert "assigned" in found[0].message
+
+    def test_whole_attribute_rebind_lockfree_flagged(self, tmp_path):
+        found = self._lint(tmp_path, """
+        def bad(self):
+            self._items = {}
+        """)
+        assert len(found) == 1
+
+    def test_closure_does_not_inherit_the_lock(self, tmp_path):
+        # The exact production shape: a worker fn defined under the lock
+        # but executed later on a pool thread.
+        found = self._lint(tmp_path, """
+        def bad(self, pool):
+            with self._lock:
+                def worker(k):
+                    self._items.pop(k, None)
+                return pool.submit(worker, 1)
+        """)
+        assert len(found) == 1
+        assert "closure" in found[0].hint
+
+    def test_nested_with_still_counts(self, tmp_path):
+        assert self._lint(tmp_path, """
+        def good(self, k):
+            with self._lock:
+                if k in self._items:
+                    self._items.pop(k, None)
+        """) == []
+
+    def test_init_is_exempt(self, tmp_path):
+        # The declaration assignment itself (construction happens-before
+        # publication) must not be a finding.
+        assert self._lint(tmp_path, """
+        def read_ok(self):
+            return len(self._items)
+        """) == []
+
+    def test_unannotated_attribute_ignored(self, tmp_path):
+        assert lint_src(
+            tmp_path, LockDisciplineRule(paths=EVERYWHERE), """
+            class Plain:
+                def __init__(self):
+                    self._free = set()
+
+                def touch(self):
+                    self._free.add(1)
+            """,
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 exception-hygiene
+# ---------------------------------------------------------------------------
+
+class TestExceptionHygiene:
+    def _lint(self, tmp_path, handler_body: str, catch="Exception",
+              bind=""):
+        clause = f"except {catch}{bind}:" if catch else "except:"
+        body = textwrap.indent(
+            textwrap.dedent(handler_body).strip("\n"), "        "
+        )
+        src = (
+            "import logging\n"
+            "logger = logging.getLogger(__name__)\n\n"
+            "def f(metrics):\n"
+            "    try:\n"
+            "        risky()\n"
+            f"    {clause}\n"
+            f"{body}\n"
+        )
+        return lint_src(
+            tmp_path, ExceptionHygieneRule(paths=EVERYWHERE), src
+        )
+
+    def test_silent_pass_flagged(self, tmp_path):
+        assert len(self._lint(tmp_path, "pass\n")) == 1
+
+    def test_silent_fallback_assignment_flagged(self, tmp_path):
+        # The live finding this rule was seeded from: server.py's
+        # backend probe used to swallow the failure into mode="numpy".
+        assert len(self._lint(tmp_path, "mode = 'numpy'\n")) == 1
+
+    def test_logger_warning_clean(self, tmp_path):
+        assert self._lint(
+            tmp_path, "logger.warning('backend probe failed')\n"
+        ) == []
+
+    def test_counter_inc_clean(self, tmp_path):
+        assert self._lint(
+            tmp_path, "metrics.registry.counter('errors').inc()\n"
+        ) == []
+
+    def test_reraise_clean(self, tmp_path):
+        assert self._lint(tmp_path, "raise\n") == []
+
+    def test_delegating_the_exception_clean(self, tmp_path):
+        assert self._lint(
+            tmp_path, "note_failure(exc)\n", bind=" as exc"
+        ) == []
+
+    def test_surfacing_the_exception_clean(self, tmp_path):
+        assert self._lint(
+            tmp_path, "body = f'error: {exc}'\nsend(body)\n",
+            bind=" as exc",
+        ) == []
+
+    def test_binding_without_use_still_flagged(self, tmp_path):
+        assert len(self._lint(tmp_path, "pass\n", bind=" as exc")) == 1
+
+    def test_narrow_except_ignored(self, tmp_path):
+        assert self._lint(tmp_path, "pass\n", catch="ValueError") == []
+
+    def test_bare_except_flagged(self, tmp_path):
+        assert len(self._lint(tmp_path, "pass\n", catch="")) == 1
+
+    def test_scope_excludes_non_plane_modules(self):
+        rule = ExceptionHygieneRule()
+        assert rule.applies_to("gfedntm_tpu/federation/server.py")
+        assert rule.applies_to("gfedntm_tpu/utils/observability.py")
+        assert not rule.applies_to("gfedntm_tpu/data/vocab.py")
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+BAD_FIXTURE = """\
+try:
+    x = 1
+except Exception:
+    pass
+"""
+
+
+class TestBaseline:
+    def _run(self, tmp_path, **kw):
+        return run_lint(
+            root=str(tmp_path), paths=[str(tmp_path / "mod.py")],
+            rules=[ExceptionHygieneRule(paths=EVERYWHERE)],
+            baseline_path=str(tmp_path / "baseline.json"), **kw,
+        )
+
+    def test_add_justify_expire_roundtrip(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        bl = tmp_path / "baseline.json"
+        mod.write_text(BAD_FIXTURE)
+
+        # 1. Finding is new -> gate fails.
+        res = self._run(tmp_path)
+        assert not res.ok and len(res.new) == 1
+
+        # 2. Accept into the baseline; the fresh entry has no
+        # justification yet -> gate still fails, loudly.
+        res = self._run(tmp_path, update_baseline=True)
+        assert len(res.unjustified) == 1
+        res = self._run(tmp_path)
+        assert not res.ok and res.new == [] and len(res.unjustified) == 1
+
+        # 3. Justify it -> gate passes, finding is baselined.
+        entries = load_baseline(str(bl))
+        entries = [
+            BaselineEntry(e.rule, e.path, e.line_text, e.index,
+                          "probe loop: silence is the signal")
+            for e in entries
+        ]
+        save_baseline(str(bl), entries)
+        res = self._run(tmp_path)
+        assert res.ok and len(res.baselined) == 1 and res.stale == []
+
+        # 4. Fix the code -> the entry is STALE (reported, still ok).
+        mod.write_text(BAD_FIXTURE.replace("pass", "raise"))
+        res = self._run(tmp_path)
+        assert res.ok and res.new == [] and len(res.stale) == 1
+
+        # 5. --update-baseline prunes the stale entry.
+        res = self._run(tmp_path, update_baseline=True)
+        assert load_baseline(str(bl)) == []
+        res = self._run(tmp_path)
+        assert res.ok and res.stale == []
+
+    def test_baseline_is_content_keyed_not_line_keyed(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        bl = tmp_path / "baseline.json"
+        mod.write_text(BAD_FIXTURE)
+        self._run(tmp_path, update_baseline=True)
+        entries = load_baseline(str(bl))
+        save_baseline(str(bl), [
+            BaselineEntry(e.rule, e.path, e.line_text, e.index, "ok")
+            for e in entries
+        ])
+        # Shift the finding down 3 lines: still baselined.
+        mod.write_text("# pad\n# pad\n# pad\n" + BAD_FIXTURE)
+        res = self._run(tmp_path)
+        assert res.ok and res.new == [] and len(res.baselined) == 1
+        # Edit the ANCHOR line itself: the entry no longer matches.
+        mod.write_text(BAD_FIXTURE.replace(
+            "except Exception:", "except (Exception,):"
+        ))
+        res = self._run(tmp_path)
+        assert not res.ok and len(res.new) == 1 and len(res.stale) == 1
+
+    def test_same_line_text_disambiguated_by_index(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        bl = tmp_path / "baseline.json"
+        mod.write_text(BAD_FIXTURE + "\n" + BAD_FIXTURE)
+        res = self._run(tmp_path)
+        assert len(res.new) == 2
+        self._run(tmp_path, update_baseline=True)
+        entries = load_baseline(str(bl))
+        assert sorted(e.index for e in entries) == [0, 1]
+        save_baseline(str(bl), [
+            BaselineEntry(e.rule, e.path, e.line_text, e.index, "ok")
+            for e in entries
+        ])
+        assert self._run(tmp_path).ok
+
+    def test_subset_update_preserves_out_of_scope_entries(self, tmp_path):
+        # --update-baseline on a rule/path subset must not delete (or
+        # re-judge) entries the run made no statement about.
+        mod = tmp_path / "mod.py"
+        bl = tmp_path / "baseline.json"
+        mod.write_text(BAD_FIXTURE)
+        foreign = BaselineEntry(
+            "precision-pin", "other/module.py", "x = a @ b", 0,
+            "reviewed: host-side oracle",
+        )
+        save_baseline(str(bl), [foreign])
+        res = self._run(tmp_path)  # exception-hygiene only
+        # The finding is new; the foreign entry is NOT reported stale.
+        assert len(res.new) == 1 and res.stale == []
+        self._run(tmp_path, update_baseline=True)
+        entries = load_baseline(str(bl))
+        assert foreign in entries, "out-of-scope entry was dropped"
+        assert len(entries) == 2
+
+    def test_malformed_baseline_is_loud(self, tmp_path):
+        from gfedntm_tpu.analysis.baseline import BaselineError
+
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        (tmp_path / "baseline.json").write_text("{not json")
+        with pytest.raises(BaselineError):
+            self._run(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_list_rules(self, capsys):
+        from gfedntm_tpu.analysis.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("GL001", "GL002", "GL003", "GL004", "GL005"):
+            assert rid in out
+
+    def test_unknown_rule_is_usage_error(self, capsys):
+        from gfedntm_tpu.analysis.__main__ import main
+
+        assert main(["--rules", "no-such-rule"]) == 2
+
+    def test_no_baseline_with_update_baseline_conflicts(self, capsys):
+        # --update-baseline under --no-baseline used to CLAIM a rewrite
+        # while writing nothing; the combination is a usage error.
+        from gfedntm_tpu.analysis.__main__ import main
+
+        assert main(["--no-baseline", "--update-baseline"]) == 2
+        assert "conflict" in capsys.readouterr().err
+
+    def test_exit_codes_on_fixture(self, tmp_path, capsys):
+        from gfedntm_tpu.analysis.__main__ import main
+
+        bad = tmp_path / "mod.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def gram(mat):\n"
+            "    return jnp.matmul(mat, mat.T)\n"
+        )
+        # precision-pin's default scope doesn't include the fixture; the
+        # module CLI still lints explicit paths with the full rule set,
+        # so use a clean file for rc=0 and the telemetry rule (scoped to
+        # everything it is handed via bench.py-style rel paths) for rc=1.
+        assert main([str(bad), "--root", str(tmp_path),
+                     "--no-baseline"]) == 0
+        emitting = tmp_path / "bench.py"  # inside telemetry's scope
+        emitting.write_text('metrics.log("rogue_event_xyz", x=1)\n')
+        rc = main([str(emitting), "--root", str(tmp_path),
+                   "--no-baseline"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "rogue_event_xyz" in err and "bench.py:1" in err
+
+
+# ---------------------------------------------------------------------------
+# self-run over the live repo (the check.sh gate's exact contract)
+# ---------------------------------------------------------------------------
+
+class TestSelfRun:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_lint()
+
+    def test_zero_non_baselined_findings(self, result):
+        assert result.new == [], (
+            "graftlint found NEW findings in the live tree:\n"
+            + "\n".join(f.render() for f in result.new)
+        )
+
+    def test_every_baselined_finding_is_justified(self, result):
+        assert result.unjustified == []
+        for _f, entry in result.baselined:
+            assert entry.justification.strip()
+
+    def test_no_stale_baseline_entries(self, result):
+        assert result.stale == [], (
+            "baseline entries whose finding was fixed: prune with "
+            "--update-baseline"
+        )
+
+    def test_gate_verdict_ok(self, result):
+        assert result.ok
+
+    def test_scan_covers_the_package_and_entrypoints(self, result):
+        assert result.files > 50  # the whole package, not a subset
+
+    def test_subset_lint_of_schema_module_is_clean(self):
+        # Linting ONLY observability.py must not false-fire the
+        # repo-wide reverse-lints (the emission sites live elsewhere).
+        import os
+
+        from gfedntm_tpu.analysis.runner import repo_root
+
+        res = run_lint(paths=[os.path.join(
+            repo_root(), "gfedntm_tpu/utils/observability.py"
+        )])
+        assert res.new == [], "\n".join(f.render() for f in res.new)
+
+    def test_seeded_unpinned_gram_matmul_fails(self, tmp_path):
+        # The acceptance regression, run against a COPY of the live
+        # device_agg module with one precision pin stripped (check.sh
+        # runs the same rule against the real file).
+        import os
+
+        from gfedntm_tpu.analysis.runner import repo_root
+
+        live = os.path.join(
+            repo_root(), "gfedntm_tpu/federation/device_agg.py"
+        )
+        src = open(live).read()
+        assert ", precision=jax.lax.Precision.HIGHEST" in src
+        seeded = src.replace(
+            ", precision=jax.lax.Precision.HIGHEST", "", 1
+        )
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), seeded,
+            name="device_agg_seeded.py",
+        )
+        assert any(f.rule_name == "precision-pin" for f in found)
+
+    def test_seeded_lockfree_registry_mutation_fails(self, tmp_path):
+        import os
+
+        from gfedntm_tpu.analysis.runner import repo_root
+
+        live = os.path.join(
+            repo_root(), "gfedntm_tpu/federation/registry.py"
+        )
+        src = open(live).read()
+        # Seed a lock-free mutator into the class body, exactly what the
+        # acceptance regression does to the live file.
+        seeded = src.replace(
+            "    def __len__(self) -> int:",
+            "    def purge(self, client_id: int) -> None:\n"
+            "        self._clients.pop(client_id, None)\n\n"
+            "    def __len__(self) -> int:",
+        )
+        found = lint_src(
+            tmp_path, LockDisciplineRule(paths=EVERYWHERE), seeded,
+            name="registry_seeded.py",
+        )
+        assert any(f.rule_name == "lock-discipline" for f in found)
