@@ -109,6 +109,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--liveness_timeout", type=float, default=300.0,
                    help="client mode: self-finalize if no server activity "
                         "arrives within this many seconds (0 disables)")
+    # Round pacing (README "Federation pacing"): cohort sampling and
+    # buffered async — the knobs that decouple round time from the
+    # population size.
+    p.add_argument("--pacing", type=str, default="sync",
+                   help="server mode: round pacing policy — sync (the "
+                        "all-clients barrier, default), cohort[:K] "
+                        "(seeded K-of-N sampling with unbiased "
+                        "reweighting), async[:B] (FedBuff-style buffered "
+                        "aggregation with staleness discounting)")
+    p.add_argument("--cohort_size", type=int, default=None,
+                   help="server mode: K for --pacing cohort (alternative "
+                        "to the inline cohort:<K> form)")
+    p.add_argument("--async_buffer", type=int, default=None,
+                   help="server mode: admitted updates per aggregation "
+                        "for --pacing async (alternative to async:<B>)")
+    p.add_argument("--staleness_alpha", type=float, default=0.5,
+                   help="server mode, async pacing: staleness discount "
+                        "exponent — each buffered update's weight is "
+                        "scaled by 1/(1+s)^alpha (0 disables)")
+    p.add_argument("--pacing_seed", type=int, default=0,
+                   help="server mode: seed for the per-round cohort "
+                        "sampler (rosters are deterministic per round)")
     # Aggregation strategy + wire compression (README "Aggregation
     # strategies & wire compression").
     p.add_argument("--aggregator", default="fedavg",
@@ -335,6 +357,11 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         outlier_mad_k=getattr(args, "outlier_mad_k", 4.0),
         divergence_patience=getattr(args, "divergence_patience", 3),
         wire_codec=getattr(args, "wire_codec", None) or "none",
+        pacing_policy=getattr(args, "pacing", "sync"),
+        cohort_size=getattr(args, "cohort_size", None),
+        async_buffer=getattr(args, "async_buffer", None),
+        staleness_alpha=getattr(args, "staleness_alpha", 0.5),
+        pacing_seed=getattr(args, "pacing_seed", 0),
         ops_port=getattr(args, "ops_port", None),
         profiler=profiler,
         quality_every=getattr(args, "quality_every", 0),

@@ -10,6 +10,7 @@ remote clients into the same stepper protocol.
 from gfedntm_tpu.federation import codec as codec
 from gfedntm_tpu.federation import rpc as rpc
 from gfedntm_tpu.federation.client import Client, FederatedClientServicer
+from gfedntm_tpu.federation.pacing import PacingSpec, parse_pacing
 from gfedntm_tpu.federation.registry import ClientRecord, Federation
 from gfedntm_tpu.federation.resilience import (
     FaultInjector,

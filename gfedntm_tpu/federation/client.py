@@ -83,6 +83,10 @@ class FederatedClientServicer:
         # snapshotting results — finalization must never read model state
         # mid-mutation from a concurrent TrainStep.
         self._lock = threading.RLock()
+        # Round tag of the last aggregate applied (-1 = still on the
+        # replicated init). Reported as StepReply.base_round (1 + tag) so
+        # an async server can staleness-discount free-running updates.
+        self._applied_round = -1  # guarded-by: _lock
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
         """The round's local step(s); reply with the post-step shared
@@ -139,6 +143,7 @@ class FederatedClientServicer:
                 current_mb=self.stepper.current_mb,
                 current_epoch=self.stepper.current_epoch,
                 finished=self.stepper.finished,
+                base_round=self._applied_round + 1,
             )
 
     def ApplyAggregate(self, request: pb.Aggregate, context) -> pb.AggregateReply:
@@ -194,6 +199,7 @@ class FederatedClientServicer:
                 average = codec.bundle_to_flatdict(
                     request.shared, metrics=self.metrics
                 )
+            self._applied_round = int(request.round)
             status = self.stepper.delta_update_fit(average)
             if status.epoch_ended:
                 self.logger.info(

@@ -453,6 +453,15 @@ class DownlinkEncoder(_Session):
         self._last_round = -1
         super().reset()
 
+    @property
+    def last_round(self) -> int:
+        """Round of the broadcast a delta push would reference (-1 =
+        none yet). Under cohort/async pacing recipients hold broadcasts
+        of different rounds, so the server's ``allow_delta`` check
+        compares each recipient's last-acked round to THIS — not merely
+        membership in an acked set."""
+        return self._last_round
+
     def encode(
         self,
         average: Mapping[str, np.ndarray],

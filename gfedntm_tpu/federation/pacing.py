@@ -1,0 +1,1006 @@
+"""Round engines with pluggable pacing policies for the federation server.
+
+The reference loop (and every PR through 8) is synchronous and
+all-clients-every-round: each round polls the full membership, so
+wall-clock is gated by the slowest member and wire cost is
+O(N·|params|) per round — tens of clients is the practical ceiling.
+The communication-perspective FL survey (PAPERS.md, arXiv 2405.20431)
+identifies partial participation + buffered asynchrony as the dominant
+scaling lever; the EM-perspective analysis (arXiv 2111.10192) justifies
+sample-weighted aggregation under partial participation. This module
+factors the server's round *control plane* out of
+:mod:`~gfedntm_tpu.federation.server` into three pacing policies
+(README "Federation pacing"):
+
+- ``sync`` — the historical all-clients barrier, preserved as the
+  default. :class:`SyncEngine` is a line-for-line port of the old
+  ``FederatedServer._round_loop``: same operation order, same quorum
+  denominator (the full unfinished membership), same aggregate
+  expression — the FedAvg trajectory is bitwise unchanged.
+- ``cohort:<K>`` — each round samples K of the N *eligible* clients
+  (seeded ``np.random.default_rng((seed, round))``, so the roster is
+  deterministic per round and independent of history; probation
+  suspects inside their backoff window are never eligible, exactly as
+  in sync). Non-participants skip the poll entirely — no RPC, no
+  decode, no gate slot — so per-round wire and compute cost are O(K).
+  The admitted aggregate is corrected by the inverse inclusion
+  probability (:func:`inclusion_scale`) so its expectation equals the
+  full-population FedAvg update (unit-tested against the closed form).
+  The quorum denominator becomes the sampled cohort — denominating
+  over the full membership would make quorum unreachable for K ≪ N
+  (the PR 9 quorum bugfix).
+- ``async:<B>`` — FedBuff-style buffered aggregation: every eligible
+  client has (at most) one poll permanently in flight and trains
+  against the last broadcast it applied; the server aggregates as soon
+  as ``B`` admitted updates accumulate, discounting each by the
+  staleness factor ``1/(1+s)^alpha`` (:func:`staleness_discount`)
+  where ``s`` is how many aggregations happened since the update's
+  base broadcast (``StepReply.base_round``, mirrored from the
+  broadcast-round tag pushes carry). Updates are drained in client-id
+  order so the aggregation arithmetic is deterministic given the same
+  buffered set.
+
+The engines drive the server's existing *data plane* unchanged —
+:meth:`~gfedntm_tpu.federation.server.FederatedServer._collect_snapshots`
+(decode + admission gate), the aggregator strategies, the divergence
+guardian, the model-quality plane, and the wire-codec sessions — so
+every defense proven under sync carries over to sampled and buffered
+pacing.
+
+Poll deadlines are no longer the fixed population-scale ``120 + 2E``:
+once a client's compile-dominated first poll is behind it, the deadline
+derives from the StragglerDetector's live per-client EWMAs
+(:meth:`RoundEngine.poll_deadline`), with the historical constant kept
+as the cold-start fallback and upper bound — a fixed 120 s deadline
+over-waits a K=8 cohort by two orders of magnitude when steps take
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import SUSPECT
+from gfedntm_tpu.utils.observability import span, trace_pairs
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from gfedntm_tpu.federation.server import FederatedServer
+
+__all__ = [
+    "PacingSpec",
+    "parse_pacing",
+    "make_engine",
+    "inclusion_scale",
+    "scale_update",
+    "staleness_discount",
+    "RoundEngine",
+    "SyncEngine",
+    "CohortEngine",
+    "AsyncEngine",
+]
+
+#: Adaptive poll-deadline constants: never below the floor (an EWMA of
+#: milliseconds must not produce a deadline a GC pause can blow), at most
+#: the historical fixed deadline (the cold-start fallback), and sized as
+#: margin + headroom x the slower of (this client's EWMA, the population's
+#: slowest EWMA) — generous enough that an honest straggler inside its own
+#: usual envelope never times out.
+POLL_DEADLINE_FLOOR_S = 10.0
+POLL_DEADLINE_HEADROOM = 10.0
+POLL_DEADLINE_MARGIN_S = 5.0
+
+
+def fallback_deadline(local_steps: int) -> float:
+    """The historical fixed poll deadline: 120 s covers one minibatch plus
+    the first-poll jit compile; an E-step round adds 2 s/step."""
+    return 120.0 + 2.0 * float(local_steps)
+
+
+@dataclass(frozen=True)
+class PacingSpec:
+    """Parsed pacing configuration (see :func:`parse_pacing`)."""
+
+    policy: str  # "sync" | "cohort" | "async"
+    cohort_size: int = 0  # cohort: K clients sampled per round
+    buffer_size: int = 0  # async: admitted updates per aggregation
+    staleness_alpha: float = 0.5
+    seed: int = 0
+
+    @property
+    def spec_id(self) -> str:
+        """Canonical spec string (CLI / ``/status`` / telemetry form)."""
+        if self.policy == "cohort":
+            return f"cohort:{self.cohort_size}"
+        if self.policy == "async":
+            return f"async:{self.buffer_size}"
+        return "sync"
+
+
+def parse_pacing(
+    spec: "str | PacingSpec | None",
+    *,
+    cohort_size: "int | None" = None,
+    async_buffer: "int | None" = None,
+    staleness_alpha: float = 0.5,
+    seed: int = 0,
+) -> PacingSpec:
+    """Parse a pacing spec: ``sync`` (default), ``cohort[:K]``,
+    ``async[:B]``. The K/B may come inline (``cohort:8``) or from the
+    dedicated knobs (``--cohort_size`` / ``--async_buffer``); inline
+    wins when both are given and disagree loudly otherwise."""
+    if isinstance(spec, PacingSpec):
+        return spec
+    raw = (spec or "sync").strip().lower()
+    name, _, arg = raw.partition(":")
+    if name not in ("sync", "cohort", "async"):
+        raise ValueError(
+            f"unknown pacing policy {raw!r} (want sync, cohort[:K] or "
+            f"async[:B])"
+        )
+    if staleness_alpha < 0:
+        raise ValueError(
+            f"staleness_alpha must be >= 0, got {staleness_alpha}"
+        )
+    if name == "sync":
+        if arg:
+            raise ValueError("sync pacing takes no argument")
+        return PacingSpec("sync", staleness_alpha=staleness_alpha, seed=seed)
+    inline = int(arg) if arg else None
+    if name == "cohort":
+        k = inline if inline is not None else cohort_size
+        if k is None:
+            raise ValueError(
+                "cohort pacing needs a size: --pacing cohort:<K> or "
+                "--cohort_size"
+            )
+        if inline is not None and cohort_size not in (None, inline):
+            raise ValueError(
+                f"conflicting cohort sizes: pacing spec says {inline}, "
+                f"--cohort_size says {cohort_size}"
+            )
+        if k < 1:
+            raise ValueError(f"cohort size must be >= 1, got {k}")
+        return PacingSpec(
+            "cohort", cohort_size=int(k),
+            staleness_alpha=staleness_alpha, seed=seed,
+        )
+    b = inline if inline is not None else async_buffer
+    if b is None:
+        raise ValueError(
+            "async pacing needs a buffer: --pacing async:<B> or "
+            "--async_buffer"
+        )
+    if inline is not None and async_buffer not in (None, inline):
+        raise ValueError(
+            f"conflicting async buffers: pacing spec says {inline}, "
+            f"--async_buffer says {async_buffer}"
+        )
+    if b < 1:
+        raise ValueError(f"async buffer must be >= 1, got {b}")
+    return PacingSpec(
+        "async", buffer_size=int(b),
+        staleness_alpha=staleness_alpha, seed=seed,
+    )
+
+
+def make_engine(server: "FederatedServer", spec: PacingSpec) -> "RoundEngine":
+    if spec.policy == "cohort":
+        return CohortEngine(server, spec)
+    if spec.policy == "async":
+        return AsyncEngine(server, spec)
+    return SyncEngine(server, spec)
+
+
+# ---- unbiased partial-participation reweighting -----------------------------
+
+def inclusion_scale(
+    admitted_weight: float, inclusion_p: float, expected_weight: float,
+    max_scale: float = float("inf"),
+) -> float:
+    """Horvitz-Thompson participation correction for a K-of-N cohort.
+
+    With uniform K-of-N sampling (inclusion probability ``p = K/N``) and
+    per-client round weights ``w_i``, the unbiased estimate of the full-
+    population FedAvg update ``sum_i (w_i / W) u_i`` from the sampled
+    cohort S is ``sum_{i in S} (w_i / (p W)) u_i``. The cohort's own
+    normalized aggregate is ``g + sum_S (w_i / W_S) u_i``, so multiplying
+    its *update* by ``W_S / (p W)`` — this function — recovers the HT
+    estimate exactly for the weighted-mean stage:
+
+        E[g + scale * (mean_S - g)] = g + sum_i (w_i / W) u_i
+
+    (each client appears with probability ``p``, cancelling the ``1/p``).
+    ``expected_weight`` is W, the expected full-round weight over the
+    eligible population; when all clients carry equal weight the factor
+    is exactly 1 and cohort pacing degenerates to the plain cohort mean.
+    Degenerate inputs (empty cohort, unknown population weight) return
+    the neutral 1.0; ``max_scale`` caps the factor at its natural bound
+    ``1/p`` so a stale population-weight estimate can never overshoot.
+    """
+    if (
+        inclusion_p <= 0.0 or expected_weight <= 0.0
+        or admitted_weight <= 0.0
+    ):
+        return 1.0
+    return float(
+        min(admitted_weight / (inclusion_p * expected_weight), max_scale)
+    )
+
+
+def scale_update(
+    average: "dict[str, np.ndarray]",
+    current_global: "dict[str, np.ndarray]",
+    scale: float,
+) -> "dict[str, np.ndarray]":
+    """``g + scale * (average - g)`` per float tensor (in float64, cast
+    back to each tensor's dtype); non-float tensors pass through. The
+    identity scale returns ``average`` unchanged — and bit-identical."""
+    if scale == 1.0:
+        return average
+    out: dict[str, np.ndarray] = {}
+    for key, val in average.items():
+        arr = np.asarray(val)
+        if arr.dtype.kind != "f":
+            out[key] = arr
+            continue
+        cur = np.asarray(current_global[key], np.float64)
+        out[key] = np.asarray(
+            cur + float(scale) * (np.asarray(arr, np.float64) - cur),
+            dtype=arr.dtype,
+        )
+    return out
+
+
+def staleness_discount(staleness: int, alpha: float) -> float:
+    """FedBuff-style staleness damping ``1/(1+s)^alpha``: an update based
+    on the current broadcast (s=0) keeps full weight; ``alpha=0``
+    disables discounting."""
+    return float(1.0 / (1.0 + max(0, int(staleness))) ** float(alpha))
+
+
+# ---- engines ----------------------------------------------------------------
+
+class RoundEngine:
+    """Shared machinery for all pacing policies: persistent per-client
+    stubs, the bounded poll executor, adaptive poll deadlines, and the
+    guardian/quality/encode tail every aggregation runs through. The
+    driving loop itself is policy-specific (:meth:`run`)."""
+
+    policy = "sync"
+
+    def __init__(self, server: "FederatedServer", spec: PacingSpec):
+        self.server = server
+        self.spec = spec
+        self._lock = threading.Lock()
+        # The most recent round's polled roster — read by /status from
+        # ops-endpoint threads while the loop mutates it.
+        self._last_cohort: tuple[int, ...] = ()  # guarded-by: _lock
+        # Last-known per-round admitted weight per client (the HT
+        # population-weight estimate) — loop-thread only, but /status
+        # summarizes it, so writes stay under the same lock.
+        self._round_weight: dict[int, float] = {}  # guarded-by: _lock
+
+    # ---- sizing ------------------------------------------------------------
+    def pool_workers(self, poll_workers: int) -> int:
+        """Bound the persistent poll executor: sync/async keep the
+        configured width; a cohort engine never needs more threads than
+        the cohort (non-participants are not polled at all)."""
+        return max(1, int(poll_workers))
+
+    # ---- adaptive poll deadline (PR 9 satellite) ---------------------------
+    def poll_deadline(self, rec) -> float:
+        """Per-call TrainStep deadline derived from the straggler
+        detector's live poll-latency EWMAs. The fixed ``120 + 2E``
+        deadline is kept as the cold-start fallback (no EWMA history,
+        or a first poll whose jit compile dominates) and as the upper
+        bound; the floor keeps a milliseconds-scale EWMA from producing
+        a deadline that ordinary jitter could blow."""
+        base = fallback_deadline(self.server.local_steps)
+        if rec.client_id not in self.server._poll_warmed:
+            return base  # first poll carries trace+compile
+        ewmas = self.server.straggler.ewma_view()
+        if not ewmas:
+            return base
+        # Per-client: a fast client's deadline must not be inflated by an
+        # unrelated straggler's EWMA. A warmed client with no EWMA of its
+        # own yet (just past its compile poll) borrows the population's
+        # slowest as the conservative cold-start default.
+        mine = ewmas.get(rec.client_id, max(ewmas.values()))
+        derived = POLL_DEADLINE_MARGIN_S + POLL_DEADLINE_HEADROOM * mine
+        return min(base, max(POLL_DEADLINE_FLOOR_S, derived))
+
+    # ---- staleness (shared by cohort gate screen + async discounts) --------
+    def clamped_staleness(self, replies, iteration: int) -> "dict[int, int]":
+        """Per-client staleness: the client's claim
+        (``iteration - StepReply.base_round``) clamped to the server's own
+        upper bound from the push-ack bookkeeping. The claim alone is
+        attacker-controlled — a byzantine client reporting ``base_round=0``
+        at round 100 would have its norm screened at 1/101 of its true
+        magnitude, evading the MAD screen entirely. The server knows when
+        it last delivered a broadcast to each client (``_push_acked``), so
+        a claim can never exceed ``iteration - (last_acked + 1)``; a
+        client with no acked push may genuinely still be on the replicated
+        init, so its bound is ``iteration`` itself."""
+        s = self.server
+        with s._push_lock:
+            acked = dict(s._push_acked)
+        out: dict[int, int] = {}
+        for rec, reply in replies:
+            claimed = max(0, int(iteration) - int(reply.base_round))
+            seen = acked.get(rec.client_id)
+            observed = (
+                iteration - (int(seen) + 1) if seen is not None
+                else iteration
+            )
+            out[rec.client_id] = max(0, min(claimed, observed))
+        return out
+
+    # ---- status ------------------------------------------------------------
+    def status(self) -> "dict[str, Any]":
+        with self._lock:
+            return {
+                "policy": self.spec.spec_id,
+                "staleness_alpha": self.spec.staleness_alpha,
+                "last_cohort": list(self._last_cohort),
+            }
+
+    def _note_cohort(self, cohort) -> None:
+        with self._lock:
+            self._last_cohort = tuple(rec.client_id for rec in cohort)
+
+    def _note_admitted_weights(self) -> None:
+        """Fold this round's admitted per-client weights into the
+        population-weight estimate the HT correction uses."""
+        with self._lock:
+            for client_id, weight, _loss in self.server._round_accepted:
+                self._round_weight[client_id] = float(weight)
+
+    # ---- one poll ----------------------------------------------------------
+    def _poll_one(self, stubs: dict, rec, iteration: int, rpc_kwargs: dict):
+        """Poll one client for its round step; failures feed the
+        probation machinery and return a reply-less triple, exactly like
+        the historical inline closure."""
+        s = self.server
+        addr = rec.address  # snapshot: rejoin may change it mid-RPC
+        t0 = time.perf_counter()
+        try:
+            stub = s._stub_for(stubs, rec)
+            if stub is None:
+                raise RuntimeError("client has no serving address")
+            reply = stub.TrainStep(
+                pb.StepRequest(
+                    global_iter=iteration,
+                    local_steps=s.local_steps,
+                    broadcast_round=s.global_iterations,
+                ),
+                timeout=self.poll_deadline(rec),
+                **rpc_kwargs,
+            )
+            return rec, reply, time.perf_counter() - t0
+        except Exception as exc:
+            s._note_client_failure(rec, addr, iteration, exc, "TrainStep")
+            return rec, None, time.perf_counter() - t0
+
+    # ---- the guardian/quality/encode tail ----------------------------------
+    def _guard_quality_encode(
+        self, iteration: int, snapshots, average, replies
+    ):
+        """The post-aggregate pipeline every policy shares: divergence
+        guardian verdict (and rollback swap), model-quality plane, the
+        ``last_average`` install, and the wire-codec push encode —
+        verbatim from the historical sync loop."""
+        s = self.server
+        accepted_average = average
+        if s.guardian is not None:
+            verdict = s.guardian.observe(
+                iteration,
+                losses=[loss for _c, _w, loss in s._round_accepted],
+                average=average,
+                contributors=[(c, w) for c, w, _l in s._round_accepted],
+            )
+            if verdict is not None:
+                restored = s._divergence_rollback(iteration, verdict)
+                if restored is not None:
+                    average = restored
+        average = s._quality_step(
+            iteration, snapshots, average, accepted_average
+        )
+        s.last_average = average
+        return s._encode_push(average, iteration, replies)
+
+    def _push_round(self, stubs: dict, pool, agg, replies, rpc_kwargs,
+                    iteration: int):
+        """Concurrent push + progress bookkeeping; returns the acked
+        client ids and records each acker's broadcast round (the
+        delta-reference bookkeeping the next push's ``allow_delta``
+        check reads)."""
+        s = self.server
+
+        def push(item):
+            rec, reply = item
+            addr = rec.address
+            try:
+                ack = stubs[rec.client_id][2].ApplyAggregate(
+                    agg, **rpc_kwargs
+                )
+                s.federation.update_progress(
+                    rec.client_id, reply.current_mb,
+                    reply.current_epoch, reply.loss,
+                    finished=ack.finished,
+                )
+                return rec.client_id
+            except Exception as exc:
+                s.federation.update_progress(
+                    rec.client_id, reply.current_mb,
+                    reply.current_epoch, reply.loss, finished=False,
+                )
+                s._note_client_failure(
+                    rec, addr, iteration, exc, "ApplyAggregate"
+                )
+                return None
+
+        acked = {cid for cid in pool.map(push, replies) if cid is not None}
+        # Install under the lock so a ReadyForTraining rejoin's discard
+        # can never interleave with the update (see server._push_acked).
+        with s._push_lock:
+            for rec, _reply in replies:
+                if rec.client_id in acked:
+                    s._push_acked[rec.client_id] = iteration
+                else:
+                    s._push_acked.pop(rec.client_id, None)
+        return acked
+
+    def _maybe_checkpoint(self, iteration: int) -> None:
+        s = self.server
+        if (
+            s.checkpoint_every > 0 and s.save_dir is not None
+            and s.last_average is not None
+            and s.global_iterations % s.checkpoint_every == 0
+            and (s.guardian is None or s.guardian.healthy)
+        ):
+            # While the guardian has an open unhealthy streak, the
+            # periodic checkpoint is withheld: the state it would persist
+            # is exactly what a rollback may be about to discard.
+            s._save_round_checkpoint()
+
+    def _final_checkpoint(self) -> None:
+        s = self.server
+        if (
+            s.checkpoint_every > 0 and s.save_dir is not None
+            and s.last_average is not None and not s._aborted.is_set()
+        ):
+            s._save_round_checkpoint()
+
+    def run(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
+        raise NotImplementedError
+
+
+class SyncEngine(RoundEngine):
+    """The historical all-clients barrier, line-for-line: poll every
+    eligible client, quorum over the full unfinished membership, FedAvg
+    over the admitted cohort, push to every replier. The default — and
+    the bitwise-regression anchor every other policy is judged against."""
+
+    policy = "sync"
+
+    # -- policy hooks (overridden by CohortEngine) ---------------------------
+    def select_cohort(self, iteration: int, active: list) -> list:
+        return active
+
+    def gate_staleness(self, replies, iteration: int):
+        """Per-client staleness map for the admission gate's normalized
+        outlier screen. Sync pacing returns None — every replier stepped
+        from the same broadcast, and the historical screen must stay
+        bit-identical."""
+        return None
+
+    def quorum_denominator(self, cohort: list) -> int:
+        """Sync denominates over the round's full unfinished membership —
+        INCLUDING suspects still inside their backoff window (any drop
+        from this round's poll is already finished, so it no longer
+        counts). Denominating over only the polled set would make the
+        quorum vacuous exactly when it matters: with every peer in
+        backoff, a lone straggler would be 1/1 and its solo reply would
+        become the average."""
+        return len(self.server.federation.active_clients())
+
+    def combine(self, snapshots, iteration: int):
+        s = self.server
+        return s.aggregator.aggregate(
+            snapshots, current_global=s._current_global()
+        )
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
+        s = self.server
+        m = s.metrics
+        # Resume path: global_iterations was restored from the checkpoint,
+        # so a resumed server continues from that round, not round 0.
+        for iteration in range(s.global_iterations, s.max_iters):
+            if s._stopping.is_set():
+                break
+            active = s.federation.active_clients(iteration)
+            if not active:
+                pending = s.federation.pending_suspects(iteration)
+                if not pending:
+                    break
+                # Every pollable client is inside its probation backoff
+                # window, so no round can advance the round clock the
+                # backoff is denominated in. Convert the gap to the
+                # earliest scheduled retry into wall-clock (one backoff
+                # tick per round), wait it out, then poll the suspects
+                # early — instead of burning one max_iters round per tick.
+                gap = min(x.next_retry_round for x in pending) - iteration
+                if s._stopping.wait(s.round_backoff_s * max(1, gap)):
+                    break
+                active = s.federation.active_clients()
+                if not active:
+                    break
+
+            if s.profiler is not None:
+                s.profiler.observe(iteration)
+
+            cohort = self.select_cohort(iteration, active)
+            self._note_cohort(cohort)
+
+            with span(m, "round", round=iteration) as round_sp:
+                # Trace metadata for this round's polls/pushes — built once
+                # here because the pool threads the RPCs run on do not
+                # inherit the round span's contextvars.
+                rpc_kwargs = {}
+                if m is not None:
+                    rpc_kwargs["metadata"] = trace_pairs(
+                        s.trace_id, round_sp.span_id, iteration
+                    )
+
+                # Suspects entering this round's poll: probation clearance
+                # is admission-scoped (see _collect_snapshots) — the set is
+                # snapshotted here because a successful RPC alone no
+                # longer proves the client is healthy.
+                was_suspect = frozenset(
+                    rec.client_id for rec in cohort
+                    if rec.status == SUSPECT
+                )
+
+                # 1. concurrent poll: one local step per polled client.
+                with span(m, "poll", parent=round_sp, clients=len(cohort)):
+                    polled = list(pool.map(
+                        lambda rec: self._poll_one(
+                            stubs, rec, iteration, rpc_kwargs
+                        ),
+                        cohort,
+                    ))
+                replies = [
+                    (rec, reply) for rec, reply, _lat in polled
+                    if reply is not None
+                ]
+                if m is not None:
+                    s._note_round_poll(round_sp, polled, replies, iteration)
+                if not replies:
+                    # A fully failed round ends the federation only when
+                    # nobody is left to come back (everyone dropped or
+                    # finished); otherwise wait out a backoff tick and let
+                    # probation re-poll.
+                    if not s.federation.active_clients():
+                        break
+                    s._stopping.wait(s.round_backoff_s)
+                    continue
+                membership = self.quorum_denominator(cohort)
+                quorum = max(
+                    1, math.ceil(s.quorum_fraction * membership)
+                )
+                if len(replies) < quorum:
+                    # Below-quorum rounds are SKIPPED, not averaged: a
+                    # weighted average over one straggler would silently
+                    # overwrite every other client's progress with its
+                    # parameters on the next push.
+                    s._skip_below_quorum(
+                        iteration, len(replies), membership, quorum,
+                        "replies",
+                    )
+                    continue
+
+                # 2. aggregate step over the shared subset: decode + gate
+                # the replies, then hand the admitted cohort to the
+                # configured strategy (policy hook: cohort pacing applies
+                # the inverse-inclusion-probability correction on top).
+                with span(m, "average", parent=round_sp):
+                    snapshots = s._collect_snapshots(
+                        replies, iteration, was_suspect,
+                        staleness=self.gate_staleness(replies, iteration),
+                    )
+                    if len(snapshots) < quorum:
+                        # Gate exclusions can take a round that passed the
+                        # reply quorum back below it — skip, same as a
+                        # below-quorum poll.
+                        s._skip_below_quorum(
+                            iteration, len(snapshots), membership, quorum,
+                            "admitted by the update gate",
+                        )
+                        continue
+                    self._note_admitted_weights()
+                    average = self.combine(snapshots, iteration)
+                    agg = self._guard_quality_encode(
+                        iteration, snapshots, average, replies
+                    )
+
+                # 3. concurrent push + progress bookkeeping.
+                with span(m, "push", parent=round_sp, clients=len(replies)):
+                    self._push_round(
+                        stubs, pool, agg, replies, rpc_kwargs, iteration
+                    )
+                if m is not None:
+                    round_sp.annotate(
+                        bytes_pushed=agg.ByteSize() * len(replies)
+                    )
+            s.global_iterations = iteration + 1
+            self._maybe_checkpoint(iteration)
+            if m is not None and iteration % 50 == 0:
+                # Periodic snapshot alongside the progress event so even a
+                # SIGKILLed run keeps registry state no older than 50
+                # rounds (summarize reads the LAST snapshot per metric).
+                m.snapshot_registry(rounds=iteration + 1)
+                m.log(
+                    "federated_iteration", iteration=iteration,
+                    mean_loss=float(
+                        np.mean([r.loss for _, r in replies])
+                    ),
+                )
+        # Final checkpoint so a resume of a finished (or stopped) run does
+        # not replay rounds since the last periodic save.
+        self._final_checkpoint()
+
+
+class CohortEngine(SyncEngine):
+    """K-of-N cohort sampling on top of the sync barrier: the round only
+    ever touches the sampled clients, the quorum denominates over the
+    cohort, and the aggregate is corrected to the unbiased full-
+    population expectation (:func:`inclusion_scale`)."""
+
+    policy = "cohort"
+
+    def __init__(self, server: "FederatedServer", spec: PacingSpec):
+        super().__init__(server, spec)
+        self._inclusion_p = 1.0
+        self._expected_weight = 0.0
+        self._last_scale = 1.0
+
+    def pool_workers(self, poll_workers: int) -> int:
+        # The executor is sized to the cohort: non-participants are never
+        # polled, so threads beyond K would only ever idle.
+        return max(1, min(int(poll_workers), self.spec.cohort_size))
+
+    def select_cohort(self, iteration: int, active: list) -> list:
+        s = self.server
+        k = min(self.spec.cohort_size, len(active))
+        if k >= len(active):
+            cohort = list(active)
+            self._inclusion_p = 1.0
+        else:
+            # Seeded per-round sampling: the roster is a pure function of
+            # (seed, round, eligible set) — reproducible across resumes
+            # and independent of poll timing. Eligibility already encodes
+            # the PR 5 registry states: suspects inside their backoff
+            # window and quarantined/dropped clients are not in `active`.
+            rng = np.random.default_rng((self.spec.seed, iteration))
+            picked = rng.choice(len(active), size=k, replace=False)
+            chosen = {active[int(i)].client_id for i in picked}
+            cohort = [rec for rec in active if rec.client_id in chosen]
+            self._inclusion_p = k / len(active)
+        # Expected full-round population weight W for the HT correction:
+        # per-client last-known admitted round weights, defaulting to the
+        # cohort mean (neutral — scale 1.0 — until heterogeneity is
+        # actually observed).
+        with self._lock:
+            known = dict(self._round_weight)
+        default = (
+            sum(known.values()) / len(known) if known else 1.0
+        )
+        self._expected_weight = float(sum(
+            known.get(rec.client_id, default) for rec in active
+        ))
+        if s.metrics is not None:
+            s.metrics.registry.gauge("cohort_size").set(len(cohort))
+            s.metrics.registry.gauge("cohort_eligible").set(len(active))
+            s.metrics.log(
+                "cohort_sampled", round=iteration, k=len(cohort),
+                eligible=len(active),
+                cohort=[rec.client_id for rec in cohort],
+            )
+        return cohort
+
+    def quorum_denominator(self, cohort: list) -> int:
+        """The PR 9 quorum bugfix: under cohort pacing the denominator is
+        the sampled cohort — against the full membership, a K=8 sample of
+        N=100 could never reach a 0.5 quorum and every round would skip."""
+        return len(cohort)
+
+    def gate_staleness(self, replies, iteration: int):
+        """Cohort members step from whatever broadcast they last applied
+        (they may not have been sampled for many rounds), so the gate's
+        outlier screen judges staleness-normalized norms — an honest
+        client carrying ``s`` rounds of global drift must not read as a
+        poisoner against freshly-synced peers. Claims are clamped to the
+        server-observed bound (:meth:`clamped_staleness`) so the
+        normalization is not an attacker-widened screen."""
+        return self.clamped_staleness(replies, iteration)
+
+    def combine(self, snapshots, iteration: int):
+        s = self.server
+        average = super().combine(snapshots, iteration)
+        if s.aggregator.estimator.name != "mean":
+            # Byzantine-robust mean stages deliberately ignore sample
+            # weights (influence must not be buyable), so inverse-
+            # inclusion-probability reweighting has no unbiasedness to
+            # restore — the robust estimate passes through.
+            self._last_scale = 1.0
+            return average
+        admitted = sum(w for _c, w, _l in s._round_accepted)
+        scale = inclusion_scale(
+            admitted, self._inclusion_p, self._expected_weight,
+            max_scale=1.0 / max(self._inclusion_p, 1e-9),
+        )
+        self._last_scale = scale
+        if s.metrics is not None:
+            s.metrics.registry.gauge("cohort_inclusion_scale").set(scale)
+        return scale_update(average, s._current_global(), scale)
+
+    def status(self) -> "dict[str, Any]":
+        out = super().status()
+        out.update(
+            cohort_size=self.spec.cohort_size,
+            inclusion_p=self._inclusion_p,
+            inclusion_scale=self._last_scale,
+        )
+        return out
+
+
+class AsyncEngine(RoundEngine):
+    """FedBuff-style buffered asynchrony: one free-running poll per
+    eligible client, aggregation whenever ``buffer_size`` admitted
+    updates accumulate, staleness-discounted weights, push (and re-poll)
+    only for the drained contributors."""
+
+    policy = "async"
+
+    def __init__(self, server: "FederatedServer", spec: PacingSpec):
+        super().__init__(server, spec)
+        # Completed-but-unaggregated updates: appended by the loop thread
+        # as poll futures resolve, drained at each aggregation; /status
+        # reads the depth from ops-endpoint threads.
+        self._pending: list = []  # guarded-by: _lock
+        self._stale_max = 0
+
+    def status(self) -> "dict[str, Any]":
+        out = super().status()
+        with self._lock:
+            depth = len(self._pending)
+        out.update(
+            buffer_size=self.spec.buffer_size,
+            buffer_depth=depth,
+            stale_max=self._stale_max,
+        )
+        return out
+
+    # -- deterministic buffer mechanics (unit-tested directly) ---------------
+    def buffer_append(self, rec, reply, latency: float) -> int:
+        """Buffer one completed poll; returns the new depth."""
+        with self._lock:
+            self._pending.append((rec, reply, latency))
+            return len(self._pending)
+
+    def buffer_drain(self) -> list:
+        """Drain the whole buffer in client-id order: the aggregation
+        arithmetic (weighted sums in list order) is then deterministic
+        given the same buffered set, regardless of arrival order."""
+        with self._lock:
+            drained = list(self._pending)
+            self._pending.clear()
+        drained.sort(key=lambda item: item[0].client_id)
+        return drained
+
+    def staleness_of(self, reply, iteration: int) -> int:
+        """How many aggregations happened since this update's base
+        broadcast. ``StepReply.base_round`` is 1 + the round tag of the
+        last aggregate the client applied (0 = never, i.e. the initial
+        replicated state), which equals the number of aggregations the
+        client had seen — so staleness is the plain difference against
+        the server's aggregation counter."""
+        return max(0, int(iteration) - int(reply.base_round))
+
+    def discounts_for(
+        self, drained: list, iteration: int,
+        stale_map: "dict[int, int] | None" = None,
+    ) -> "dict[int, float]":
+        """Per-client staleness discount factors for one drained batch,
+        with telemetry for every actually-discounted update. ``stale_map``
+        (the production path) carries server-clamped staleness from
+        :meth:`clamped_staleness`; without it the reply's own claim is
+        used (unit-test convenience)."""
+        s = self.server
+        out: dict[int, float] = {}
+        stales: list[int] = []
+        for rec, reply, _lat in drained:
+            stale = (
+                stale_map[rec.client_id] if stale_map is not None
+                else self.staleness_of(reply, iteration)
+            )
+            factor = staleness_discount(stale, self.spec.staleness_alpha)
+            out[rec.client_id] = factor
+            stales.append(stale)
+            if stale > 0 and s.metrics is not None:
+                s.metrics.registry.counter("updates_stale_discounted").inc()
+                s.metrics.log(
+                    "update_stale_discounted", client=rec.client_id,
+                    round=iteration, staleness=stale, factor=factor,
+                )
+        self._stale_max = max(stales) if stales else 0
+        return out
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
+        s = self.server
+        iteration = s.global_iterations
+        inflight: dict[int, Any] = {}  # client_id -> Future
+        held: set[int] = set()  # buffered, awaiting an aggregation
+        # Budget: aggregations are bounded by max_iters; skipped (below-
+        # quorum) aggregation attempts get their own generous budget so a
+        # fleet that only ever sends poison still terminates.
+        skips = 0
+        while (
+            iteration < s.max_iters
+            and skips < max(16, 4 * s.max_iters)
+            and not s._stopping.is_set()
+        ):
+            if s.profiler is not None:
+                s.profiler.observe(iteration)
+            # 1. keep one poll in flight per eligible client (free-running
+            # clients: each new poll starts the moment the previous
+            # completes and its update is aggregated + pushed).
+            active = s.federation.active_clients(iteration)
+            for rec in active:
+                if rec.client_id in inflight or rec.client_id in held:
+                    continue
+                inflight[rec.client_id] = pool.submit(
+                    self._poll_one, stubs, rec, iteration, {}
+                )
+            if not inflight:
+                with self._lock:
+                    buffered = len(self._pending)
+                if buffered:
+                    # End-game partial drain: fewer unfinished clients
+                    # remain than the buffer asks for.
+                    iteration, skips = self._aggregate_once(
+                        stubs, pool, iteration, skips, held
+                    )
+                    continue
+                pending = s.federation.pending_suspects(iteration)
+                if not pending:
+                    break
+                gap = min(x.next_retry_round for x in pending) - iteration
+                if s._stopping.wait(s.round_backoff_s * max(1, gap)):
+                    break
+                continue
+            # 2. fold completed polls into the buffer.
+            done, _not_done = wait(
+                set(inflight.values()), timeout=0.05,
+                return_when=FIRST_COMPLETED,
+            )
+            if done:
+                for client_id in [
+                    cid for cid, fut in inflight.items() if fut in done
+                ]:
+                    rec, reply, lat = inflight.pop(client_id).result()
+                    if reply is None:
+                        continue  # failure: probation already recorded
+                    self.buffer_append(rec, reply, lat)
+                    held.add(rec.client_id)
+            with self._lock:
+                buffered = len(self._pending)
+            # 3. aggregate as soon as the buffer fills. The effective
+            # buffer shrinks to the live population so a fleet smaller
+            # than B (clients finishing out) still aggregates.
+            alive = s.federation.alive_count()
+            effective = max(1, min(self.spec.buffer_size, alive))
+            if buffered >= effective:
+                iteration, skips = self._aggregate_once(
+                    stubs, pool, iteration, skips, held
+                )
+        self._final_checkpoint()
+
+    def _aggregate_once(
+        self, stubs: dict, pool, iteration: int, skips: int,
+        held: "set[int]",
+    ) -> "tuple[int, int]":
+        """One buffered aggregation: drain, discount by staleness, gate,
+        aggregate, guard, push to the drained contributors. Returns the
+        (possibly advanced) aggregation counter and skip count; drained
+        clients leave ``held`` and re-enter the free-running poll."""
+        s = self.server
+        m = s.metrics
+        drained = self.buffer_drain()
+        held.difference_update(rec.client_id for rec, _r, _l in drained)
+        if not drained:
+            return iteration, skips
+        self._note_cohort([rec for rec, _r, _l in drained])
+        with span(m, "round", round=iteration, pacing="async") as round_sp:
+            rpc_kwargs = {}
+            if m is not None:
+                rpc_kwargs["metadata"] = trace_pairs(
+                    s.trace_id, round_sp.span_id, iteration
+                )
+            polled = [(rec, reply, lat) for rec, reply, lat in drained]
+            replies = [(rec, reply) for rec, reply, _lat in drained]
+            if m is not None:
+                s._note_round_poll(round_sp, polled, replies, iteration)
+            was_suspect = frozenset(
+                rec.client_id for rec, _r, _l in drained
+                if rec.status == SUSPECT
+            )
+            stale_map = self.clamped_staleness(replies, iteration)
+            discounts = self.discounts_for(drained, iteration, stale_map)
+            quorum = max(
+                1, math.ceil(s.quorum_fraction * len(drained))
+            )
+            with span(m, "average", parent=round_sp):
+                snapshots = s._collect_snapshots(
+                    replies, iteration, was_suspect,
+                    weight_scale=discounts,
+                    staleness=stale_map,
+                )
+                if len(snapshots) < quorum:
+                    # Below-quorum drains are dropped (not averaged); the
+                    # contributors are NOT pushed — they re-enter the
+                    # free-running poll and their next update supersedes
+                    # the dropped one.
+                    s._skip_below_quorum(
+                        iteration, len(snapshots), len(drained), quorum,
+                        "admitted by the update gate",
+                    )
+                    return iteration, skips + 1
+                self._note_admitted_weights()
+                average = s.aggregator.aggregate(
+                    snapshots, current_global=s._current_global()
+                )
+                agg = self._guard_quality_encode(
+                    iteration, snapshots, average, replies
+                )
+            if m is not None:
+                stales = [
+                    stale_map[rec.client_id] for rec, _reply in replies
+                ]
+                m.log(
+                    "async_aggregated", round=iteration,
+                    buffered=len(drained), admitted=len(snapshots),
+                    stale_max=max(stales), stale_mean=float(
+                        sum(stales) / len(stales)
+                    ),
+                )
+            with span(m, "push", parent=round_sp, clients=len(replies)):
+                self._push_round(
+                    stubs, pool, agg, replies, rpc_kwargs, iteration
+                )
+            if m is not None:
+                round_sp.annotate(
+                    bytes_pushed=agg.ByteSize() * len(replies),
+                    clients=len(replies),
+                )
+        s.global_iterations = iteration + 1
+        self._maybe_checkpoint(iteration)
+        if m is not None and iteration % 50 == 0:
+            m.snapshot_registry(rounds=iteration + 1)
+            m.log(
+                "federated_iteration", iteration=iteration,
+                mean_loss=float(
+                    np.mean([r.loss for _, r in replies])
+                ),
+            )
+        return iteration + 1, skips

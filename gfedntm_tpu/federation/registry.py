@@ -255,6 +255,17 @@ class Federation:
                 for c in self.get_clients()
             ]
 
+    def alive_count(self) -> int:
+        """Unfinished, training-ready clients — INCLUDING suspects inside
+        their backoff window (they will be polled again). The async
+        engine's effective buffer shrinks to this so a fleet smaller
+        than the configured buffer still aggregates."""
+        with self._lock:
+            return sum(
+                1 for c in self._clients.values()
+                if c.ready_for_training and not c.finished
+            )
+
     def total_weight(self) -> float:
         with self._lock:
             return float(

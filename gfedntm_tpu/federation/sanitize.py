@@ -238,20 +238,43 @@ class UpdateGate:
         return med + self.mad_k * scale
 
     # ---- the round pass ----------------------------------------------------
+    @staticmethod
+    def _screen_norm(
+        norm: float, client_id: int, staleness: "Mapping[int, int] | None"
+    ) -> float:
+        """The norm the MAD outlier screen judges: raw, divided by
+        ``1 + staleness``. Under cohort/async pacing a client steps from
+        the broadcast it last applied, so its raw update-vs-current-global
+        norm carries the drift of ``s`` intervening aggregations — honest
+        stale members would read as outliers against fresh peers. The
+        first-order normalization makes the cohort statistics compare
+        like with like (the gate's cohort-awareness, ISSUE 9); with no
+        staleness map (sync pacing) the division is by exactly 1.0 and
+        decisions are bit-identical to the historical screen. The hard
+        clip deliberately still uses the RAW norm — influence on the
+        aggregate is bounded in absolute terms no matter how stale the
+        update claims to be."""
+        if staleness is None:
+            return norm
+        return norm / (1.0 + max(0, int(staleness.get(client_id, 0))))
+
     def admit_round(
         self,
         candidates: "list[tuple[int, float, dict[str, np.ndarray]]]",
         current_global: Mapping[str, np.ndarray],
         round_idx: int,
+        staleness: "Mapping[int, int] | None" = None,
     ) -> GateResult:
         """Screen one round's ``(client_id, weight, snapshot)`` candidates.
 
         Order matters: conformance and finiteness run per candidate; norms
         are then computed for the structurally-sound survivors ONLY (a
         shape-skewed or NaN update must not pollute the cohort statistics
-        it is judged against); MAD outliers are rejected on raw norms;
-        finally the hard clip bounds whoever remains. Telemetry and streak
-        bookkeeping happen here so every caller gets identical accounting.
+        it is judged against); MAD outliers are rejected on staleness-
+        normalized norms (see :meth:`_screen_norm`; raw norms when no
+        ``staleness`` map is given); finally the hard clip bounds whoever
+        remains on RAW norms. Telemetry and streak bookkeeping happen
+        here so every caller gets identical accounting.
 
         With a device engine attached (:meth:`set_engine`) the same pass
         runs on the stacked device plane — identical decisions, and the
@@ -260,7 +283,7 @@ class UpdateGate:
         """
         if self._engine is not None and self._template is not None:
             return self._admit_round_device(
-                candidates, current_global, round_idx
+                candidates, current_global, round_idx, staleness
             )
         rejected: list[Rejection] = []
         clipped: list[tuple[int, float, float]] = []
@@ -280,16 +303,18 @@ class UpdateGate:
             )
             sound.append((client_id, weight, snap, norm))
 
-        threshold = self._outlier_threshold(
-            [n for _c, _w, _s, n in sound if np.isfinite(n)]
-        )
+        threshold = self._outlier_threshold([
+            self._screen_norm(n, c, staleness)
+            for c, _w, _s, n in sound if np.isfinite(n)
+        ])
         accepted: list[tuple[int, float, dict]] = []
         for client_id, weight, snap, norm in sound:
-            if threshold is not None and norm > threshold:
+            screen = self._screen_norm(norm, client_id, staleness)
+            if threshold is not None and screen > threshold:
                 rejected.append(Rejection(
                     client_id, NORM_OUTLIER,
-                    f"update norm {norm:.3e} > cohort threshold "
-                    f"{threshold:.3e}",
+                    f"update norm {norm:.3e} (screened {screen:.3e}) > "
+                    f"cohort threshold {threshold:.3e}",
                     norm=norm,
                 ))
                 continue
@@ -321,6 +346,7 @@ class UpdateGate:
         candidates: "list[tuple[int, float, dict[str, np.ndarray]]]",
         current_global: Mapping[str, np.ndarray],
         round_idx: int,
+        staleness: "Mapping[int, int] | None" = None,
     ) -> GateResult:
         """The admission pass on the device plane: conformance stays host
         metadata work, then the structurally-sound candidates are stacked
@@ -399,8 +425,8 @@ class UpdateGate:
 
         threshold = (
             self._outlier_threshold([
-                float(norms[i]) for i in finite_rows
-                if np.isfinite(norms[i])
+                self._screen_norm(float(norms[i]), sound[i][0], staleness)
+                for i in finite_rows if np.isfinite(norms[i])
             ])
             if need_norm else None
         )
@@ -412,11 +438,12 @@ class UpdateGate:
         for i in finite_rows:
             client_id, weight, snap = sound[i]
             norm = float(norms[i]) if need_norm else float("nan")
-            if threshold is not None and norm > threshold:
+            screen = self._screen_norm(norm, client_id, staleness)
+            if threshold is not None and screen > threshold:
                 rejected.append(Rejection(
                     client_id, NORM_OUTLIER,
-                    f"update norm {norm:.3e} > cohort threshold "
-                    f"{threshold:.3e}",
+                    f"update norm {norm:.3e} (screened {screen:.3e}) > "
+                    f"cohort threshold {threshold:.3e}",
                     norm=norm,
                 ))
                 continue

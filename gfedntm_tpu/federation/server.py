@@ -33,7 +33,16 @@ sleep × N clients per step plus 2N fresh channels, SURVEY.md §3.3):
   (conformance, finiteness, cohort norm screening) before it can enter the
   aggregate, the mean stage may be **byzantine-robust**
   (trimmed-mean/median/Krum), and a **divergence guardian** rolls the
-  global model back to the last good checkpoint when it diverges anyway.
+  global model back to the last good checkpoint when it diverges anyway;
+- the round *control plane* lives in
+  :mod:`~gfedntm_tpu.federation.pacing` (README "Federation pacing"):
+  this module keeps the data plane (decode + admission, aggregation
+  strategies, guardian, quality plane, codec sessions, checkpointing)
+  and the gRPC servicer surface, while the pacing engine decides who is
+  polled when — the all-clients ``sync`` barrier (default, bitwise the
+  historical trajectory), seeded ``cohort:<K>`` sampling with unbiased
+  reweighting, or ``async:<B>`` FedBuff-style buffered aggregation with
+  staleness-discounted updates.
 """
 
 from __future__ import annotations
@@ -42,7 +51,6 @@ import json
 import logging
 import math
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -50,7 +58,7 @@ import numpy as np
 
 from gfedntm_tpu.config import SHARE_ALL
 from gfedntm_tpu.data.vocab import Vocabulary
-from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation import codec, pacing, rpc
 from gfedntm_tpu.federation.aggregation import make_aggregator
 from gfedntm_tpu.federation.compression import (
     CodecError,
@@ -60,7 +68,7 @@ from gfedntm_tpu.federation.compression import (
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.eval.monitor import COHERENCE_COLLAPSE, ContributionTracker
-from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
+from gfedntm_tpu.federation.registry import DROPPED, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate
 from gfedntm_tpu.models.avitm import AVITM
@@ -71,8 +79,6 @@ from gfedntm_tpu.utils.observability import (
     RoundProfiler,
     StragglerDetector,
     new_trace_id,
-    span,
-    trace_pairs,
 )
 
 
@@ -146,6 +152,11 @@ class FederatedServer:
         quality_guard: bool = False,
         quality_history: int = 64,
         quality_monitor_kwargs: dict[str, Any] | None = None,
+        pacing_policy: str = "sync",
+        cohort_size: int | None = None,
+        async_buffer: int | None = None,
+        staleness_alpha: float = 0.5,
+        pacing_seed: int = 0,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -165,6 +176,19 @@ class FederatedServer:
         self.logger = logger or logging.getLogger("FederatedServer")
         self.metrics = metrics
         self.poll_workers = poll_workers
+        # Round pacing (README "Federation pacing"): "sync" preserves the
+        # historical all-clients barrier bitwise; "cohort:<K>" samples a
+        # seeded K-of-N roster per round with unbiased inverse-inclusion-
+        # probability reweighting; "async:<B>" is FedBuff-style buffered
+        # aggregation with staleness-discounted updates. Parsed eagerly so
+        # a bad spec fails at construction, not mid-federation; the engine
+        # itself is built when the training loop starts.
+        self.pacing = pacing.parse_pacing(
+            pacing_policy, cohort_size=cohort_size,
+            async_buffer=async_buffer, staleness_alpha=staleness_alpha,
+            seed=pacing_seed,
+        )
+        self._engine: pacing.RoundEngine | None = None
         # FedAvg exchange period in local minibatches (1 = the reference's
         # per-minibatch averaging; E>1 = FedAvg proper — the same knob as
         # FederatedTrainer.local_steps, carried to clients per StepRequest).
@@ -237,15 +261,20 @@ class FederatedServer:
             self.wire_codec, metrics=metrics, max_refs=codec_ref_cache,
         )
         self._downlink_enc = DownlinkEncoder(self.wire_codec, metrics=metrics)
-        # Clients that acked the most recent push — a push may only be
-        # delta-encoded when every recipient holds the previous broadcast.
-        # Written by the training loop (round push results, rollback
-        # clears) AND by gRPC servicer threads (a rejoiner is discarded in
-        # ReadyForTraining), so every mutation holds _push_lock: a lost
-        # discard would let the next push delta-encode against a broadcast
-        # the fresh process never held.
+        # Per-client round of the last acked push — a push may only be
+        # delta-encoded when every recipient holds the encoder's delta
+        # reference (the immediately-previous broadcast). Under cohort and
+        # async pacing, different clients legitimately hold broadcasts of
+        # different rounds, so this is a round-tagged map rather than the
+        # historical single-round set; sync semantics are unchanged (the
+        # allow_delta check compares each recipient's tag to the encoder's
+        # reference round). Written by the training loop (round push
+        # results, rollback clears) AND by gRPC servicer threads (a
+        # rejoiner is discarded in ReadyForTraining), so every mutation
+        # holds _push_lock: a lost discard would let the next push
+        # delta-encode against a broadcast the fresh process never held.
         self._push_lock = threading.Lock()
-        self._push_acked: set[int] = set()  # guarded-by: _push_lock
+        self._push_acked: dict[int, int] = {}  # guarded-by: _push_lock
         # Set by a divergence rollback: the NEXT push carries
         # Aggregate.reset_session so every recipient drops its wire-codec
         # session state (delta refs + error-feedback residuals) before
@@ -434,6 +463,13 @@ class FederatedServer:
             "aggregator": self.aggregator.name,
             "local_steps": self.local_steps,
             "quorum_fraction": self.quorum_fraction,
+            # Pacing view (README "Federation pacing"): policy, the last
+            # polled roster, and the policy-specific extras (inclusion
+            # scale / buffer depth / staleness).
+            "pacing": (
+                self._engine.status() if self._engine is not None
+                else {"policy": self.pacing.spec_id}
+            ),
             "clients": self.federation.membership_snapshot(),
             "compression": {
                 "ratio_sent": gauge("compression_ratio_sent"),
@@ -740,7 +776,7 @@ class FederatedServer:
         # the next push could be delta-encoded against state it never held.
         # Its straggler history is a different process's too.
         with self._push_lock:
-            self._push_acked.discard(request.client_id)
+            self._push_acked.pop(request.client_id, None)
         self.straggler.forget(request.client_id)
         self.contributions.forget(request.client_id)
         # Re-check after registering: if the training loop began shutting
@@ -963,6 +999,8 @@ class FederatedServer:
     def _collect_snapshots(
         self, replies: list, iteration: int,
         was_suspect: frozenset = frozenset(),
+        weight_scale: "dict[int, float] | None" = None,
+        staleness: "dict[int, int] | None" = None,
     ) -> list[tuple[float, dict[str, np.ndarray]]]:
         """Decode a round's replies and pass them through the update
         admission gate (:class:`~gfedntm_tpu.federation.sanitize.UpdateGate`):
@@ -981,7 +1019,13 @@ class FederatedServer:
         The FedAvg weight is the reply's ``nr_samples`` — the samples the
         client actually consumed this round (summed over all E local
         minibatches, ADVICE r5) — falling back to the client's join-time
-        corpus size for replies that don't report one.
+        corpus size for replies that don't report one. ``weight_scale``
+        multiplies individual candidates' weights before admission (the
+        async engine's staleness discount); absent entries scale by 1.
+        ``staleness`` (rounds since each client's base broadcast) makes
+        the gate's MAD outlier screen judge staleness-normalized norms —
+        under cohort/async pacing an honest client polled from an old
+        broadcast must not read as a poisoner against fresher peers.
 
         Returns the admitted cohort as ``[(weight, snapshot)]`` on the
         numpy backend, or as a device-resident
@@ -1020,13 +1064,14 @@ class FederatedServer:
                 continue
             records[rec.client_id] = rec
             losses[rec.client_id] = float(reply.loss)
-            candidates.append(
-                (rec.client_id,
-                 float(reply.nr_samples) or rec.nr_samples, snap)
-            )
+            weight = float(reply.nr_samples) or rec.nr_samples
+            if weight_scale is not None:
+                weight *= float(weight_scale.get(rec.client_id, 1.0))
+            candidates.append((rec.client_id, weight, snap))
 
         result = self.update_gate.admit_round(
-            candidates, self._current_global(), iteration
+            candidates, self._current_global(), iteration,
+            staleness=staleness,
         )
         # Repeat offenders enter probation exactly like transport failures:
         # backoff, then the permanent drop — a client that only ever sends
@@ -1075,7 +1120,9 @@ class FederatedServer:
     ) -> pb.Aggregate:
         """Encode one round's push through the negotiated wire codec. A
         delta-encoded push is only legal when every recipient holds the
-        previous broadcast (acked it); otherwise the push is
+        encoder's delta reference — the immediately-previous broadcast
+        (cohort/async recipients may instead hold older broadcasts, in
+        which case the push is self-contained); otherwise the push is
         self-contained. The client-held view of this push becomes an
         uplink delta reference for the following rounds. A pending
         session reset (divergence rollback) rides out on this push's
@@ -1089,8 +1136,12 @@ class FederatedServer:
             )
         repliers = {rec.client_id for rec, _reply in replies}
         with self._push_lock:
-            acked = set(self._push_acked)
-        allow_delta = bool(acked) and repliers <= acked
+            acked = dict(self._push_acked)
+        ref_round = self._downlink_enc.last_round
+        allow_delta = (
+            ref_round >= 0 and bool(repliers)
+            and all(acked.get(cid) == ref_round for cid in repliers)
+        )
         bundle, client_view = self._downlink_enc.encode(
             average, round_idx=iteration, allow_delta=allow_delta
         )
@@ -1393,13 +1444,37 @@ class FederatedServer:
 
     def _training_loop(self) -> None:
         stubs: dict[int, tuple[str, Any, rpc.ServiceStub]] = {}
-        pool = ThreadPoolExecutor(max_workers=self.poll_workers)
+        # The round control plane is a pacing engine (README "Federation
+        # pacing"): sync is the historical barrier verbatim; cohort/async
+        # sample or buffer. The poll pool is persistent and bounded —
+        # sized by the engine (a K-cohort never needs more than K
+        # threads), created once for the whole training run.
+        self._engine = pacing.make_engine(self, self.pacing)
+        if (
+            self.pacing.policy != "sync"
+            and not self.wire_codec.identity
+        ):
+            # Cohort/async recipients sync at different rounds, so uplink
+            # deltas may reference broadcasts much older than the sync
+            # default cache depth — size the reference cache to the
+            # rotation period (every client is re-polled within ~N/K
+            # aggregations in expectation) so codec_ref_miss stays 0.
+            fan = max(
+                self.pacing.cohort_size, self.pacing.buffer_size, 1
+            )
+            self._uplink_dec.max_refs = max(
+                self._uplink_dec.max_refs,
+                4 * math.ceil(max(1, len(self.federation)) / fan),
+            )
+        pool = ThreadPoolExecutor(
+            max_workers=self._engine.pool_workers(self.poll_workers)
+        )
         self.logger.info(
-            "starting federated training: total weight %.0f",
-            self.federation.total_weight(),
+            "starting federated training (%s pacing): total weight %.0f",
+            self.pacing.spec_id, self.federation.total_weight(),
         )
         try:
-            self._round_loop(stubs, pool)
+            self._engine.run(stubs, pool)
         finally:
             if not self._aborted.is_set():
                 self._stop_broadcast(stubs)
@@ -1407,263 +1482,6 @@ class FederatedServer:
             pool.shutdown(wait=False)
             for _addr, channel, _stub in stubs.values():
                 channel.close()
-
-    def _round_loop(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
-        m = self.metrics
-        # Resume path: global_iterations was restored from the checkpoint,
-        # so a resumed server continues from that round, not round 0.
-        for iteration in range(self.global_iterations, self.max_iters):
-            if self._stopping.is_set():
-                break
-            active = self.federation.active_clients(iteration)
-            if not active:
-                pending = self.federation.pending_suspects(iteration)
-                if not pending:
-                    break
-                # Every pollable client is inside its probation backoff
-                # window, so no round can advance the round clock the
-                # backoff is denominated in. Convert the gap to the
-                # earliest scheduled retry into wall-clock (one backoff
-                # tick per round), wait it out, then poll the suspects
-                # early — instead of burning one max_iters round per tick.
-                gap = min(s.next_retry_round for s in pending) - iteration
-                if self._stopping.wait(self.round_backoff_s * max(1, gap)):
-                    break
-                active = self.federation.active_clients()
-                if not active:
-                    break
-
-            if self.profiler is not None:
-                self.profiler.observe(iteration)
-
-            with span(m, "round", round=iteration) as round_sp:
-                # Trace metadata for this round's polls/pushes — built once
-                # here because the pool threads the RPCs run on do not
-                # inherit the round span's contextvars.
-                rpc_kwargs = {}
-                if m is not None:
-                    rpc_kwargs["metadata"] = trace_pairs(
-                        self.trace_id, round_sp.span_id, iteration
-                    )
-
-                # Suspects entering this round's poll: probation clearance
-                # moved to update ADMISSION (see _collect_snapshots) — the
-                # set is snapshotted here because a successful RPC alone no
-                # longer proves the client is healthy.
-                was_suspect = frozenset(
-                    rec.client_id for rec in active
-                    if rec.status == SUSPECT
-                )
-
-                # 1. concurrent poll: one local step per client. The round
-                # span is handed down explicitly — pool threads don't
-                # inherit the loop thread's contextvars.
-                def poll(rec):
-                    addr = rec.address  # snapshot: rejoin may change it mid-RPC
-                    t0 = time.perf_counter()
-                    try:
-                        stub = self._stub_for(stubs, rec)
-                        if stub is None:
-                            raise RuntimeError("client has no serving address")
-                        # Deadline scales with the round's local-step count:
-                        # the stub default (120 s) covers ONE minibatch + the
-                        # first-poll jit compile; an E-step round multiplies
-                        # the compute part (2 s/step allowance is ~10x the
-                        # observed CPU step time at test scale).
-                        reply = stub.TrainStep(
-                            pb.StepRequest(
-                                global_iter=iteration,
-                                local_steps=self.local_steps,
-                            ),
-                            timeout=120.0 + 2.0 * self.local_steps,
-                            **rpc_kwargs,
-                        )
-                        return rec, reply, time.perf_counter() - t0
-                    except Exception as exc:
-                        self._note_client_failure(
-                            rec, addr, iteration, exc, "TrainStep"
-                        )
-                        return rec, None, time.perf_counter() - t0
-
-                with span(m, "poll", parent=round_sp, clients=len(active)):
-                    polled = list(pool.map(poll, active))
-                replies = [
-                    (rec, reply) for rec, reply, _lat in polled
-                    if reply is not None
-                ]
-                if m is not None:
-                    self._note_round_poll(round_sp, polled, replies,
-                                          iteration)
-                if not replies:
-                    # A fully failed round ends the federation only when
-                    # nobody is left to come back (everyone dropped or
-                    # finished); otherwise wait out a backoff tick and let
-                    # probation re-poll.
-                    if not self.federation.active_clients():
-                        break
-                    self._stopping.wait(self.round_backoff_s)
-                    continue
-                # The quorum denominator is the round's full unfinished
-                # membership — INCLUDING suspects still inside their backoff
-                # window (and any drop from this round's poll is already
-                # finished, so it no longer counts). Denominating over only
-                # the polled set would make the quorum vacuous exactly when
-                # it matters: with every peer in backoff, a lone straggler
-                # would be 1/1 and its solo reply would become the average.
-                membership = len(self.federation.active_clients())
-                quorum = max(
-                    1, math.ceil(self.quorum_fraction * membership)
-                )
-                if len(replies) < quorum:
-                    # Below-quorum rounds are SKIPPED, not averaged: a
-                    # weighted average over one straggler would silently
-                    # overwrite every other client's progress with its
-                    # parameters on the next push.
-                    self._skip_below_quorum(
-                        iteration, len(replies), membership, quorum,
-                        "replies",
-                    )
-                    continue
-
-                # 2. aggregate step over the shared subset: decode + key-
-                # validate the replies, then hand the (weight, snapshot)
-                # pairs to the configured strategy — FedAvg is the
-                # reference's sample-weighted average (server.py:476-487)
-                # bit-for-bit; the adaptive aggregators apply a server
-                # optimizer step toward it. The weight denominator is THIS
-                # round's contributors — clients that finished early or
-                # were dropped must not dilute the average.
-                with span(m, "average", parent=round_sp):
-                    snapshots = self._collect_snapshots(
-                        replies, iteration, was_suspect
-                    )
-                    if len(snapshots) < quorum:
-                        # Gate exclusions (skew, non-finite, norm outliers)
-                        # can take a round that passed the reply quorum back
-                        # below it — skip, same as a below-quorum poll, so
-                        # the average never comes from fewer contributors
-                        # than the quorum promises.
-                        self._skip_below_quorum(
-                            iteration, len(snapshots), membership, quorum,
-                            "admitted by the update gate",
-                        )
-                        continue
-                    average = self.aggregator.aggregate(
-                        snapshots, current_global=self._current_global()
-                    )
-                    # The cohort's own aggregate, pinned before any
-                    # guardian rollback swaps `average`: contribution
-                    # analytics measure alignment with what the clients
-                    # accepted, never with a rollback re-broadcast.
-                    accepted_average = average
-                    # Divergence backstop: the guardian judges the fresh
-                    # aggregate BEFORE it becomes last_average or reaches
-                    # any client; a verdict swaps in the restored
-                    # checkpoint state instead (the rollback re-broadcast).
-                    if self.guardian is not None:
-                        verdict = self.guardian.observe(
-                            iteration,
-                            losses=[
-                                loss for _c, _w, loss in
-                                self._round_accepted
-                            ],
-                            average=average,
-                            contributors=[
-                                (c, w) for c, w, _l in self._round_accepted
-                            ],
-                        )
-                        if verdict is not None:
-                            restored = self._divergence_rollback(
-                                iteration, verdict
-                            )
-                            if restored is not None:
-                                average = restored
-                    # Model-quality plane: contribution analytics +
-                    # (on cadence) coherence/diversity/drift over the
-                    # fresh aggregate, BEFORE it becomes last_average —
-                    # a coherence-collapse verdict swaps in the restored
-                    # checkpoint state exactly like a loss divergence.
-                    average = self._quality_step(
-                        iteration, snapshots, average, accepted_average
-                    )
-                    self.last_average = average
-                    agg = self._encode_push(average, iteration, replies)
-
-                # 3. concurrent push + progress bookkeeping. A push worker
-                # returns the client id iff the client applied the
-                # aggregate — the set of ackers gates whether the NEXT
-                # push may be delta-encoded.
-                def push(item):
-                    rec, reply = item
-                    addr = rec.address
-                    try:
-                        ack = stubs[rec.client_id][2].ApplyAggregate(
-                            agg, **rpc_kwargs
-                        )
-                        self.federation.update_progress(
-                            rec.client_id, reply.current_mb,
-                            reply.current_epoch, reply.loss,
-                            finished=ack.finished,
-                        )
-                        return rec.client_id
-                    except Exception as exc:
-                        self.federation.update_progress(
-                            rec.client_id, reply.current_mb,
-                            reply.current_epoch, reply.loss, finished=False,
-                        )
-                        self._note_client_failure(
-                            rec, addr, iteration, exc, "ApplyAggregate"
-                        )
-                        return None
-
-                with span(m, "push", parent=round_sp, clients=len(replies)):
-                    acked = {
-                        cid for cid in pool.map(push, replies)
-                        if cid is not None
-                    }
-                    # Install under the lock so a ReadyForTraining
-                    # rejoin's discard can never interleave with the
-                    # swap. (A rejoin that lands between ack collection
-                    # and this install may still appear acked for one
-                    # push — that mis-encode fails LOUDLY client-side as
-                    # a ReferenceMismatch and heals on the next push;
-                    # the lock closes the silent lost-discard window.)
-                    with self._push_lock:
-                        self._push_acked = acked
-                if m is not None:
-                    round_sp.annotate(
-                        bytes_pushed=agg.ByteSize() * len(replies)
-                    )
-            self.global_iterations = iteration + 1
-            if (
-                self.checkpoint_every > 0 and self.save_dir is not None
-                and self.last_average is not None
-                and self.global_iterations % self.checkpoint_every == 0
-                and (self.guardian is None or self.guardian.healthy)
-            ):
-                # While the guardian has an open unhealthy streak, the
-                # periodic checkpoint is withheld: the state it would
-                # persist is exactly what a rollback may be about to
-                # discard, and the rollback target must stay good.
-                self._save_round_checkpoint()
-            if m is not None and iteration % 50 == 0:
-                # Periodic snapshot alongside the progress event so even a
-                # SIGKILLed run keeps registry state no older than 50 rounds
-                # (summarize reads the LAST snapshot of each metric).
-                m.snapshot_registry(rounds=iteration + 1)
-                m.log(
-                    "federated_iteration", iteration=iteration,
-                    mean_loss=float(
-                        np.mean([r.loss for _, r in replies])
-                    ),
-                )
-        # Final checkpoint so a resume of a finished (or stopped) run does
-        # not replay rounds since the last periodic save.
-        if (
-            self.checkpoint_every > 0 and self.save_dir is not None
-            and self.last_average is not None and not self._aborted.is_set()
-        ):
-            self._save_round_checkpoint()
 
     def _stop_broadcast(self, stubs: dict) -> None:
         # Stop broadcast + server-side artifact (server.py:523-551); every

@@ -105,6 +105,13 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     # cross-process observability plane (README "Distributed tracing & ops
     # endpoint"): trace identity, live ops endpoint, device profiler window,
     # straggler analytics
+    # federation pacing (cohort sampling / buffered async; README
+    # "Federation pacing")
+    "cohort_sampled": frozenset({"round", "k", "eligible"}),
+    "async_aggregated": frozenset({"round", "buffered", "admitted"}),
+    "update_stale_discounted": frozenset(
+        {"client", "round", "staleness", "factor"}
+    ),
     "trace_started": frozenset({"trace_id"}),
     "ops_server_started": frozenset({"port"}),
     "profiler_started": frozenset({"dir", "round"}),
@@ -1632,6 +1639,14 @@ class StragglerDetector:
                     self._current[cid]["straggler"] = True
                     flagged.append({"client": cid, "z": z, "ewma_s": e})
             return flagged
+
+    def ewma_view(self) -> dict[Any, float]:
+        """Snapshot of the per-client poll-latency EWMAs — the live input
+        to the pacing engines' adaptive poll deadline (a warmed client's
+        deadline derives from these instead of the fixed 120 + 2E
+        population-scale constant)."""
+        with self._lock:
+            return dict(self._ewma)
 
     def forget(self, client_id: Any) -> None:
         """Evict a departed client: a dropped client's frozen EWMA would

@@ -74,6 +74,74 @@ def _best_of(fn, repeats: int) -> float:
     return best * 1e3
 
 
+def run_pacing_sweep(args) -> None:
+    """Pacing scale sweep (ISSUE 9): for each (population N, cohort K)
+    cell, time ONE aggregation's data-plane cost — the admission gate
+    pass plus the estimator's mean stage — over a seeded K-of-N cohort
+    sample. Non-participants cost nothing (no decode, no gate slot, no
+    plane row), so the wall-clock of a cell must track K, not N; the
+    summary line per (estimator, K) reports the N-growth ratio
+    (``cohort_cost_growth``), which stays ~1 for fixed K while the
+    ``all`` column grows with N — the scale claim, measured."""
+    import numpy as np
+
+    from gfedntm_tpu.federation.aggregation import make_estimator
+    from gfedntm_tpu.federation.pacing import staleness_discount
+    from gfedntm_tpu.federation.sanitize import UpdateGate
+
+    ns = [int(x) for x in args.sweep_populations.split(",") if x]
+    ks = [k.strip() for k in args.sweep_cohorts.split(",") if k.strip()]
+    wall: dict[tuple[str, str, int], float] = {}
+    for spec in [s.strip() for s in args.estimators.split(",") if s.strip()]:
+        est = make_estimator(spec)
+        for n in ns:
+            template, pairs = _build_pairs(n, args.d, seed=n)
+            zeros = {k: np.zeros_like(v) for k, v in template.items()}
+            for k_spec in ks:
+                k = n if k_spec == "all" else min(int(k_spec), n)
+                rng = np.random.default_rng((0, n, k))
+                picked = rng.choice(n, size=k, replace=False)
+                # Staleness-discounted candidate weights, exactly as the
+                # async engine hands them to the gate.
+                cohort = [
+                    (int(i), pairs[i][0] * staleness_discount(0, 0.5),
+                     pairs[i][1])
+                    for i in sorted(int(x) for x in picked)
+                ]
+                gate = UpdateGate(mad_k=4.0)
+                gate.set_template(template)
+
+                def run_cell():
+                    result = gate.admit_round(cohort, zeros, 0)
+                    est([(w, s) for _c, w, s in result.accepted])
+
+                run_cell()  # warm allocators / caches
+                ms = _best_of(run_cell, args.repeats)
+                wall[(spec, k_spec, n)] = ms
+                print(json.dumps({
+                    "metric": "pacing_round_wall_ms", "estimator": spec,
+                    "n_clients": n, "cohort": k, "cohort_spec": k_spec,
+                    "d": args.d, "wall_ms": round(ms, 3),
+                }), flush=True)
+    # Growth summary: for each (estimator, K) the wall-clock ratio from
+    # the smallest to the largest population. Fixed-K rows must stay ~1
+    # (cost tracks the cohort); the 'all' row is the sync barrier and
+    # grows with N.
+    lo, hi = min(ns), max(ns)
+    for (spec, k_spec) in sorted({(s, k) for s, k, _n in wall}):
+        a, b = wall.get((spec, k_spec, lo)), wall.get((spec, k_spec, hi))
+        if not (a and b):
+            continue
+        row = {
+            "metric": "pacing_cost_growth", "estimator": spec,
+            "cohort_spec": k_spec, "n_lo": lo, "n_hi": hi,
+            "growth": round(b / a, 3), "d": args.d,
+        }
+        if k_spec != "all":
+            row["tracks_cohort"] = row["growth"] < 2.0
+        print(json.dumps(row), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--d", type=int, default=262_144,
@@ -85,7 +153,24 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--backends", default="numpy,device",
                     help="comma subset of numpy,device")
+    ap.add_argument("--pacing-sweep", action="store_true",
+                    dest="pacing_sweep",
+                    help="scale sweep: time one round's data-plane cost "
+                         "(gate admission + estimator) at cohort size K "
+                         "sampled from population N, for N in "
+                         "--sweep-populations x K in --sweep-cohorts — "
+                         "the per-round cost must track K, not N")
+    ap.add_argument("--sweep-populations", default="16,64,128",
+                    dest="sweep_populations")
+    ap.add_argument("--sweep-cohorts", default="4,8,all",
+                    dest="sweep_cohorts",
+                    help="cohort sizes; 'all' = the full population "
+                         "(the sync barrier's data-plane cost)")
     args = ap.parse_args()
+
+    if args.pacing_sweep:
+        run_pacing_sweep(args)
+        return
 
     import numpy as np
 

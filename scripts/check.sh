@@ -51,7 +51,7 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_trace_plane.py tests/test_ops_endpoint.py \
     tests/test_data_plane.py tests/test_device_agg.py \
     tests/test_metrics.py tests/test_quality_plane.py \
-    tests/test_analysis.py >/dev/null || exit 1
+    tests/test_analysis.py tests/test_pacing.py >/dev/null || exit 1
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,863 @@
+"""Federation pacing tests (ISSUE 9): cohort sampling, buffered async,
+unbiased reweighting, staleness discounting, adaptive poll deadlines,
+quorum denominators per policy, registry scale, and end-to-end
+federations under non-sync pacing.
+
+The scale demo (128 simulated clients over a loopback transport, marked
+``slow``) is the acceptance harness: median round wall-clock at K=8 must
+be <= 0.25x the all-clients sync round with FaultInjector-delayed
+stragglers in the population, while the final model's NPMI stays within
+tolerance of the sync run's.
+"""
+
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation import codec
+from gfedntm_tpu.federation.aggregation import weighted_mean
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.pacing import (
+    POLL_DEADLINE_FLOOR_S,
+    AsyncEngine,
+    CohortEngine,
+    SyncEngine,
+    fallback_deadline,
+    inclusion_scale,
+    make_engine,
+    parse_pacing,
+    scale_update,
+    staleness_discount,
+)
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import (
+    DROPPED,
+    SUSPECT,
+    ClientRecord,
+    Federation,
+)
+from gfedntm_tpu.federation.resilience import FaultInjector
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+# ---- spec parsing -----------------------------------------------------------
+
+def test_parse_pacing_specs():
+    assert parse_pacing(None).policy == "sync"
+    assert parse_pacing("sync").spec_id == "sync"
+    spec = parse_pacing("cohort:8")
+    assert (spec.policy, spec.cohort_size, spec.spec_id) == (
+        "cohort", 8, "cohort:8"
+    )
+    spec = parse_pacing("async:4", staleness_alpha=0.7, seed=3)
+    assert (spec.policy, spec.buffer_size) == ("async", 4)
+    assert spec.staleness_alpha == 0.7 and spec.seed == 3
+    # knob forms
+    assert parse_pacing("cohort", cohort_size=5).cohort_size == 5
+    assert parse_pacing("async", async_buffer=2).buffer_size == 2
+    # inline + matching knob is fine; conflict is loud
+    assert parse_pacing("cohort:8", cohort_size=8).cohort_size == 8
+    with pytest.raises(ValueError):
+        parse_pacing("cohort:8", cohort_size=4)
+    with pytest.raises(ValueError):
+        parse_pacing("async:2", async_buffer=3)
+    for bad in ("cohort", "async", "cohort:0", "async:0", "nope",
+                "sync:1"):
+        with pytest.raises(ValueError):
+            parse_pacing(bad)
+    with pytest.raises(ValueError):
+        parse_pacing("sync", staleness_alpha=-1.0)
+
+
+def test_server_parses_pacing_eagerly():
+    with pytest.raises(ValueError):
+        FederatedServer(min_clients=1, pacing_policy="cohort")  # no K
+    with pytest.raises(ValueError):
+        FederatedServer(min_clients=1, pacing_policy="wat")
+    server = FederatedServer(
+        min_clients=1, pacing_policy="cohort", cohort_size=8,
+    )
+    assert server.pacing.spec_id == "cohort:8"
+    assert server._status()["pacing"]["policy"] == "cohort:8"
+
+
+def test_make_engine_dispatch():
+    server = FederatedServer(min_clients=1)
+    assert type(make_engine(server, parse_pacing("sync"))) is SyncEngine
+    assert isinstance(
+        make_engine(server, parse_pacing("cohort:2")), CohortEngine
+    )
+    assert isinstance(
+        make_engine(server, parse_pacing("async:2")), AsyncEngine
+    )
+
+
+# ---- cohort sampling --------------------------------------------------------
+
+def _server(**kw):
+    base = dict(min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS)
+    base.update(kw)
+    server = FederatedServer(**base)
+    server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+    return server
+
+
+def _populate(server, n, ready=True):
+    for cid in range(1, n + 1):
+        server.federation.connect_vocab(cid, (f"w{cid}",), 10.0 + cid)
+        if ready:
+            server.federation.connect_ready(cid, f"localhost:{cid}")
+
+
+def test_cohort_sampler_deterministic_and_seeded():
+    server = _server(pacing_policy="cohort:3", pacing_seed=7)
+    _populate(server, 10)
+    engine = make_engine(server, server.pacing)
+    active = server.federation.active_clients(0)
+    roster_a = [r.client_id for r in engine.select_cohort(4, active)]
+    roster_b = [r.client_id for r in engine.select_cohort(4, active)]
+    assert roster_a == roster_b and len(roster_a) == 3
+    # a different round (or seed) gives a different roster eventually
+    others = {
+        tuple(
+            r.client_id for r in engine.select_cohort(i, active)
+        )
+        for i in range(12)
+    }
+    assert len(others) > 1
+    # K >= eligible degenerates to everyone, inclusion probability 1
+    small = active[:2]
+    assert [r.client_id for r in engine.select_cohort(0, small)] == [
+        r.client_id for r in small
+    ]
+    assert engine._inclusion_p == 1.0
+
+
+def test_cohort_sampler_respects_probation_backoff():
+    """Suspects inside their backoff window are not eligible — the PR 5
+    registry states gate sampling exactly as they gate the sync poll."""
+    server = _server(pacing_policy="cohort:4", pacing_seed=0)
+    _populate(server, 6)
+    server.federation.mark_suspect(
+        3, "localhost:3", round_idx=0, probation_rounds=5
+    )
+    engine = make_engine(server, server.pacing)
+    rec3 = {r.client_id: r for r in server.federation.get_clients()}[3]
+    assert rec3.status == SUSPECT and rec3.next_retry_round == 1
+    for round_idx in range(1):  # round 0: inside the backoff window
+        active = server.federation.active_clients(round_idx)
+        assert 3 not in {r.client_id for r in active}
+        cohort = engine.select_cohort(round_idx, active)
+        assert 3 not in {r.client_id for r in cohort}
+    # once the retry round arrives, the suspect is eligible again
+    active = server.federation.active_clients(1)
+    assert 3 in {r.client_id for r in active}
+
+
+def test_cohort_sampled_event_schema_registered():
+    metrics = MetricsLogger(validate=True)
+    server = _server(pacing_policy="cohort:2", metrics=metrics)
+    _populate(server, 5)
+    engine = make_engine(server, server.pacing)
+    engine.select_cohort(0, server.federation.active_clients(0))
+    events = metrics.events("cohort_sampled")
+    assert events and events[0]["k"] == 2 and events[0]["eligible"] == 5
+    assert len(events[0]["cohort"]) == 2
+
+
+# ---- unbiased inverse-inclusion-probability reweighting ---------------------
+
+def test_inclusion_scale_unbiased_closed_form():
+    """Acceptance: enumerating every K-of-N cohort, the mean of the
+    HT-corrected cohort aggregates equals the full-population weighted
+    mean exactly — the closed-form expectation."""
+    rng = np.random.default_rng(0)
+    n, k = 4, 2
+    weights = [1.0, 2.0, 3.0, 4.0]
+    values = [rng.normal(size=(3, 5)).astype(np.float64) for _ in range(n)]
+    g = {"x": np.zeros((3, 5))}
+    w_total = sum(weights)
+    p = k / n
+    acc = np.zeros((3, 5))
+    subsets = list(itertools.combinations(range(n), k))
+    for subset in subsets:
+        pairs = [(weights[i], {"x": values[i]}) for i in subset]
+        est = weighted_mean(pairs)
+        scale = inclusion_scale(
+            sum(weights[i] for i in subset), p, w_total,
+        )
+        corrected = scale_update(est, g, scale)
+        acc += corrected["x"]
+    expectation = acc / len(subsets)
+    full = weighted_mean([(w, {"x": v}) for w, v in zip(weights, values)])
+    np.testing.assert_allclose(expectation, full["x"], atol=1e-12)
+
+
+def test_inclusion_scale_neutral_and_capped():
+    # homogeneous weights: the correction is exactly 1 (cohort mean)
+    assert inclusion_scale(2.0, 0.5, 4.0) == 1.0
+    # degenerate inputs are neutral, never explosive
+    assert inclusion_scale(0.0, 0.5, 4.0) == 1.0
+    assert inclusion_scale(2.0, 0.0, 4.0) == 1.0
+    assert inclusion_scale(2.0, 0.5, 0.0) == 1.0
+    # the natural bound 1/p caps a stale population-weight estimate
+    assert inclusion_scale(100.0, 0.25, 1.0, max_scale=4.0) == 4.0
+
+
+def test_scale_update_identity_and_affine():
+    g = {"x": np.ones(4, np.float32), "n": np.arange(4)}
+    avg = {"x": np.full(4, 3.0, np.float32), "n": np.arange(4)}
+    assert scale_update(avg, g, 1.0) is avg  # bit-identical passthrough
+    out = scale_update(avg, g, 0.5)
+    np.testing.assert_allclose(out["x"], 2.0)
+    assert out["x"].dtype == np.float32
+    np.testing.assert_array_equal(out["n"], np.arange(4))  # non-float
+
+
+def test_cohort_combine_skips_reweight_for_robust_estimators():
+    """Byzantine-robust mean stages ignore sample weights by design, so
+    the HT correction must not scale their estimates."""
+    server = _server(
+        pacing_policy="cohort:2", robust_aggregator="median",
+    )
+    engine = make_engine(server, server.pacing)
+    engine._inclusion_p = 0.5
+    engine._expected_weight = 100.0
+    server._round_accepted = [(1, 5.0, 1.0), (2, 5.0, 1.0)]
+    snaps = [
+        (5.0, {k: np.asarray(v) for k, v in
+               server._shared_template().items()})
+        for _ in range(2)
+    ]
+    out = engine.combine(snaps, iteration=0)
+    assert engine._last_scale == 1.0
+    assert set(out) == set(server._shared_template())
+
+
+# ---- staleness discounting --------------------------------------------------
+
+def test_staleness_discount_closed_form():
+    assert staleness_discount(0, 0.5) == 1.0
+    assert staleness_discount(3, 0.0) == 1.0  # alpha 0 disables
+    for s in range(5):
+        np.testing.assert_allclose(
+            staleness_discount(s, 0.5), 1.0 / (1.0 + s) ** 0.5
+        )
+    # monotone non-increasing in staleness
+    vals = [staleness_discount(s, 1.0) for s in range(6)]
+    assert vals == sorted(vals, reverse=True)
+    assert staleness_discount(-3, 1.0) == 1.0  # clamped
+
+
+def test_async_buffer_deterministic_under_arrival_order():
+    """The same buffered set drains in client-id order regardless of
+    arrival order, so the aggregation arithmetic (and the staleness
+    discounts) are deterministic given a fixed seed/scenario."""
+    server = _server(pacing_policy="async:3", staleness_alpha=0.5)
+    engine = make_engine(server, server.pacing)
+
+    def replies(order):
+        out = []
+        for cid in order:
+            rec = ClientRecord(cid, nr_samples=4.0)
+            reply = pb.StepReply(
+                client_id=cid, nr_samples=4.0, base_round=cid % 3,
+            )
+            engine.buffer_append(rec, reply, 0.01 * cid)
+            out.append((rec, reply))
+        return engine.buffer_drain()
+
+    a = replies([3, 1, 2])
+    b = replies([2, 3, 1])
+    assert [rec.client_id for rec, _r, _l in a] == [1, 2, 3]
+    assert [rec.client_id for rec, _r, _l in b] == [1, 2, 3]
+    da = engine.discounts_for(a, iteration=5)
+    db = engine.discounts_for(b, iteration=5)
+    assert da == db
+    # staleness = iteration - base_round, discounted 1/(1+s)^alpha
+    np.testing.assert_allclose(da[1], 1.0 / (1.0 + (5 - 1)) ** 0.5)
+    np.testing.assert_allclose(da[3], 1.0 / (1.0 + (5 - 0)) ** 0.5)
+
+
+def test_stale_discount_scales_collect_weights_and_emits_event():
+    metrics = MetricsLogger(validate=True)
+    server = _server(metrics=metrics, pacing_policy="async:2")
+    engine = make_engine(server, server.pacing)
+    tmpl = server._shared_template()
+    bundle = codec.flatdict_to_bundle(tmpl)
+    rec1 = ClientRecord(1, nr_samples=100.0)
+    rec2 = ClientRecord(2, nr_samples=100.0)
+    fresh = pb.StepReply(
+        client_id=1, shared=bundle, nr_samples=8.0, base_round=4,
+    )
+    stale = pb.StepReply(
+        client_id=2, shared=bundle, nr_samples=8.0, base_round=1,
+    )
+    drained = [(rec1, fresh, 0.0), (rec2, stale, 0.0)]
+    discounts = engine.discounts_for(drained, iteration=4)
+    out = server._collect_snapshots(
+        [(rec1, fresh), (rec2, stale)], iteration=4,
+        weight_scale=discounts,
+    )
+    weights = [w for w, _snap in out]
+    np.testing.assert_allclose(weights[0], 8.0)  # s=0: undiscounted
+    np.testing.assert_allclose(weights[1], 8.0 / (1.0 + 3) ** 0.5)
+    events = metrics.events("update_stale_discounted")
+    assert len(events) == 1 and events[0]["client"] == 2
+    assert events[0]["staleness"] == 3
+
+
+def test_staleness_claims_clamped_to_server_observation():
+    """A byzantine client cannot widen its own norm screen by claiming
+    maximal staleness: the engine clamps StepReply.base_round claims to
+    the server's push-ack bookkeeping."""
+    server = _server(pacing_policy="cohort:2")
+    engine = make_engine(server, server.pacing)
+    rec = ClientRecord(1, nr_samples=4.0)
+    liar = pb.StepReply(client_id=1, base_round=0)  # "never synced"
+    # the server pushed round 8 to this client and saw the ack
+    with server._push_lock:
+        server._push_acked[1] = 8
+    stale = engine.clamped_staleness([(rec, liar)], iteration=10)
+    assert stale[1] == 1  # 10 - (8 + 1), not the claimed 10
+    # an honest claim below the bound passes through
+    honest = pb.StepReply(client_id=1, base_round=10)
+    assert engine.clamped_staleness([(rec, honest)], iteration=10)[1] == 0
+    # a client the server never pushed may genuinely be on the init
+    rec2 = ClientRecord(2, nr_samples=4.0)
+    fresh_join = pb.StepReply(client_id=2, base_round=0)
+    assert engine.clamped_staleness(
+        [(rec2, fresh_join)], iteration=10
+    )[2] == 10
+
+
+def test_gate_screen_normalizes_staleness():
+    """Cohort-aware admission: an honest-but-stale update whose raw norm
+    would trip the MAD screen is admitted once norms are staleness-
+    normalized — while a genuinely poisoned fresh update still rejects."""
+    from gfedntm_tpu.federation.sanitize import UpdateGate
+
+    gate = UpdateGate(mad_k=3.0, mad_rel_floor=0.1)
+    g = {"x": np.zeros(16, np.float32)}
+    gate.set_template(g)
+
+    def snap(scale):
+        return {"x": np.full(16, scale, np.float32)}
+
+    # clients 1-3 fresh (norm ~1), client 4 stale by 3 rounds (norm ~4)
+    candidates = [
+        (1, 1.0, snap(0.25)), (2, 1.0, snap(0.26)),
+        (3, 1.0, snap(0.24)), (4, 1.0, snap(1.0)),
+    ]
+    raw = gate.admit_round(candidates, g, 0)
+    assert [r.client_id for r in raw.rejected] == [4]  # raw screen trips
+    gate2 = UpdateGate(mad_k=3.0, mad_rel_floor=0.1)
+    gate2.set_template(g)
+    ok = gate2.admit_round(
+        candidates, g, 0, staleness={4: 3},
+    )
+    assert not ok.rejected  # normalized: 4/(1+3) ~ the fresh peers
+    # a poisoned FRESH update is still screened out under normalization
+    gate3 = UpdateGate(mad_k=3.0, mad_rel_floor=0.1)
+    gate3.set_template(g)
+    poisoned = candidates[:3] + [(5, 1.0, snap(25.0))]
+    bad = gate3.admit_round(poisoned, g, 0, staleness={4: 3})
+    assert [r.client_id for r in bad.rejected] == [5]
+
+
+# ---- quorum denominators (the PR 9 bugfix) ----------------------------------
+
+def test_quorum_denominates_over_cohort_not_membership():
+    """Regression (both modes): sync keeps the full-membership
+    denominator; cohort denominates over the sampled cohort — against
+    the membership, a K=8 sample of N=100 could never reach a 0.5
+    quorum."""
+    server = _server(pacing_policy="cohort:8", quorum_fraction=0.5)
+    _populate(server, 100)
+    cohort_engine = make_engine(server, server.pacing)
+    active = server.federation.active_clients(0)
+    cohort = cohort_engine.select_cohort(0, active)
+    assert cohort_engine.quorum_denominator(cohort) == 8
+    import math
+
+    quorum = max(
+        1, math.ceil(server.quorum_fraction
+                     * cohort_engine.quorum_denominator(cohort))
+    )
+    assert quorum == 4  # reachable by a K=8 cohort
+
+    sync_server = _server(quorum_fraction=0.5)
+    _populate(sync_server, 100)
+    sync_engine = make_engine(sync_server, sync_server.pacing)
+    sync_active = sync_server.federation.active_clients(0)
+    # sync: the denominator is the full unfinished membership, even when
+    # handed a subset — the historical semantics, unchanged
+    assert sync_engine.quorum_denominator(sync_active[:8]) == 100
+
+
+# ---- adaptive poll deadline -------------------------------------------------
+
+def test_poll_deadline_derived_from_ewmas_with_fallback():
+    server = _server(local_steps=3)
+    engine = make_engine(server, server.pacing)
+    rec = ClientRecord(1, nr_samples=1.0)
+    base = fallback_deadline(3)
+    # cold start: no warm poll yet -> the historical fixed deadline
+    assert engine.poll_deadline(rec) == base
+    # warmed but no EWMA history -> still the fallback
+    server._poll_warmed.add(1)
+    assert engine.poll_deadline(rec) == base
+    # fast fleet: derived deadline collapses to the floor, not 120 s
+    for _ in range(3):
+        server.straggler.observe_round({1: 0.02, 2: 0.03, 3: 0.025})
+    assert engine.poll_deadline(rec) == POLL_DEADLINE_FLOOR_S
+    # a genuinely slow fleet is never given LESS than its envelope...
+    for _ in range(6):
+        server.straggler.observe_round({1: 3.0, 2: 2.0, 3: 2.5})
+    dl = engine.poll_deadline(rec)
+    assert POLL_DEADLINE_FLOOR_S < dl < base
+    assert dl >= 10.0 * 3.0  # headroom x own EWMA (EWMA converged ~3s)
+    # ...and a pathological EWMA is capped at the historical constant
+    for _ in range(8):
+        server.straggler.observe_round({1: 50.0, 2: 40.0, 3: 45.0})
+    assert engine.poll_deadline(rec) == base
+
+
+# ---- push-ack round tags (delta codec under rotating cohorts) ---------------
+
+def test_push_ack_round_tags_gate_delta_encoding():
+    server = _server(wire_codec="delta")
+    tmpl = server._shared_template()
+    rec1, rec2 = ClientRecord(1), ClientRecord(2)
+    reply = pb.StepReply(client_id=1)
+
+    # round 0: nobody holds a broadcast -> self-contained
+    agg0 = server._encode_push(tmpl, 0, [(rec1, reply), (rec2, reply)])
+    assert agg0.shared.ref_round == 0
+    # both recipients acked round 0 -> round 1 may delta against it
+    with server._push_lock:
+        server._push_acked.update({1: 0, 2: 0})
+    agg1 = server._encode_push(tmpl, 1, [(rec1, reply), (rec2, reply)])
+    assert agg1.shared.ref_round == 1  # delta vs round 0 (1 + ref)
+    # rotating cohort: recipient 3 last acked an OLDER round -> the push
+    # must be self-contained, never a mis-decodable delta
+    rec3 = ClientRecord(3)
+    with server._push_lock:
+        server._push_acked.update({1: 1, 2: 1, 3: 0})
+    agg2 = server._encode_push(
+        tmpl, 2, [(rec1, reply), (rec3, reply)]
+    )
+    assert agg2.shared.ref_round == 0
+
+
+# ---- registry + sampler scale (satellite) -----------------------------------
+
+def _registry_workout(n: int) -> Federation:
+    fed = Federation(min_clients=1)
+    for cid in range(1, n + 1):
+        fed.connect_vocab(cid, (f"w{cid}",), float(cid))
+        fed.connect_ready(cid, f"localhost:{cid}")
+    for round_idx in range(10):
+        fed.active_clients(round_idx)
+        fed.membership_snapshot()
+        fed.alive_count()
+        fed.pending_suspects(round_idx)
+        for cid in range(1, n + 1, 7):  # suspect/backoff bookkeeping
+            fed.mark_suspect(cid, f"localhost:{cid}", round_idx,
+                             probation_rounds=50)
+        for cid in range(1, n + 1, 14):
+            fed.mark_recovered(cid)
+    return fed
+
+
+def test_registry_scale_500_time_budget_and_linear_allocation():
+    """N=500 membership: snapshots, suspect/backoff bookkeeping, and
+    cohort sampling complete within a CI-safe time budget and allocate
+    O(N) — the peak traced allocation grows ~linearly from N=100 to
+    N=500, nowhere near the 25x a quadratic structure would show."""
+    import tracemalloc
+
+    t0 = time.perf_counter()
+    fed = _registry_workout(500)
+    server = _server(pacing_policy="cohort:8")
+    server.federation = fed
+    engine = make_engine(server, server.pacing)
+    for round_idx in range(50):
+        active = fed.active_clients(round_idx)
+        cohort = engine.select_cohort(round_idx, active)
+        assert len(cohort) == 8
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"registry workout took {elapsed:.1f}s at N=500"
+
+    def peak(n):
+        tracemalloc.start()
+        _registry_workout(n)
+        _current, peak_bytes = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        return peak_bytes
+
+    p100, p500 = peak(100), peak(500)
+    assert p500 < 15 * max(p100, 1), (
+        f"allocation grew {p500 / max(p100, 1):.1f}x for 5x clients "
+        f"({p100} -> {p500} bytes): not O(N)"
+    )
+
+
+# ---- end-to-end federations under non-sync pacing ---------------------------
+
+def _corpora(n_clients, docs, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(45)]
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+def _run_federation(tmp_path, corpora, tag, *, metrics=None, injector=None,
+                    poisoned_peer=None, payload=None, fault_times=64,
+                    timeout=600, **server_kw):
+    if injector is None and poisoned_peer is not None:
+        injector = FaultInjector(seed=0, metrics=metrics)
+    if poisoned_peer is not None:
+        injector.script("TrainStep", kind="corrupt", payload=payload,
+                        times=fault_times, peer=poisoned_peer)
+    base = dict(
+        min_clients=len(corpora), family="avitm",
+        model_kwargs=MODEL_KWARGS, max_iters=60,
+        save_dir=str(tmp_path / f"{tag}-server"), metrics=metrics,
+        fault_injector=injector, checkpoint_every=0, round_backoff_s=0.05,
+    )
+    base.update(server_kw)
+    server = FederatedServer(**base)
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"{tag}-c{c + 1}"),
+               metrics=metrics)
+        for c, corpus in enumerate(corpora)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=timeout), f"{tag}: did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+    return server, clients
+
+
+def test_cohort_federation_e2e_with_delta_codec(tmp_path):
+    """A 3-client federation under cohort:2 pacing with the delta wire
+    codec: completes, every round's roster is a K<=2 sample, quorum is
+    reachable (the bugfix), and the codec sessions stay consistent even
+    though clients sync at different rounds (codec_ref_miss == 0)."""
+    metrics = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, _corpora(3, docs=16, seed=2), "cohort", metrics=metrics,
+        pacing_policy="cohort:2", pacing_seed=1, wire_codec="delta",
+    )
+    assert server.global_iterations > 0
+    assert server.global_betas is not None
+    assert np.isfinite(server.global_betas).all()
+    for c in clients:
+        assert c.stepper.finished and c.results is not None
+    sampled = metrics.events("cohort_sampled")
+    assert sampled and all(e["k"] <= 2 for e in sampled)
+    # sampling actually rotates the roster
+    rosters = {tuple(e["cohort"]) for e in sampled if e["eligible"] >= 3}
+    assert len(rosters) > 1
+    # delta/topk sessions stayed consistent across rotating cohorts
+    assert metrics.registry.counter("codec_ref_miss").value == 0
+    # no quorum starvation: the denominator is the cohort
+    assert metrics.registry.counter("quorum_skipped_rounds").value == 0
+
+
+def test_async_federation_e2e(tmp_path):
+    """A 3-client federation under async:2 pacing: buffered aggregations
+    happen (async_aggregated events), stale updates are discounted, and
+    the run converges to a finite model with all clients finished."""
+    metrics = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, _corpora(3, docs=16, seed=3), "async", metrics=metrics,
+        pacing_policy="async:2", staleness_alpha=0.5,
+    )
+    assert server.global_iterations > 0
+    assert server.global_betas is not None
+    assert np.isfinite(server.global_betas).all()
+    for c in clients:
+        assert c.stepper.finished and c.results is not None
+    aggs = metrics.events("async_aggregated")
+    assert aggs and all(e["buffered"] >= 1 for e in aggs)
+    status = server._status()["pacing"]
+    assert status["policy"] == "async:2"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("pacing_policy", ["cohort:3", "async:3"])
+def test_poisoned_client_chaos_under_pacing(tmp_path, pacing_policy):
+    """PR 5 chaos e2e under non-sync pacing: a 4-client federation where
+    client 4 emits 100x-scaled updates finishes with a finite model, the
+    poisoned client lands in probation with reason='poisoned', and the
+    honest clients train to completion."""
+    metrics = MetricsLogger(validate=True)
+    server, clients = _run_federation(
+        tmp_path, _corpora(4, docs=16, seed=5), f"poison-{pacing_policy}",
+        metrics=metrics, poisoned_peer="client4", payload="scale:100",
+        pacing_policy=pacing_policy, robust_aggregator="trimmed_mean:0.25",
+        outlier_mad_k=6.0, max_iters=80,
+    )
+    assert server.global_betas is not None
+    assert np.isfinite(server.global_betas).all()
+    rejections = metrics.events("update_rejected")
+    assert rejections and all(e["client"] == 4 for e in rejections)
+    rec = {r.client_id: r for r in server.federation.get_clients()}[4]
+    assert rec.status in (SUSPECT, DROPPED)
+    assert rec.suspect_reason == "poisoned"
+    for c in clients[:3]:
+        assert c.stepper.finished
+
+
+# ---- the 128-client scale demo (acceptance) ---------------------------------
+
+class _LoopbackChannel:
+    def close(self):
+        pass
+
+
+class _LoopbackStub:
+    """In-process transport: invokes a FederatedClientServicer directly,
+    routing TrainStep through the server's FaultInjector so scripted
+    straggler delays apply exactly as they would on the wire."""
+
+    def __init__(self, servicer, injector=None, peer=""):
+        self._servicer = servicer
+        self._injector = injector
+        self._peer = peer
+
+    def TrainStep(self, request, timeout=None, **_kw):
+        if self._injector is not None:
+            self._injector.before_call(
+                "gfedntm.FederationClient", "TrainStep", request,
+                peer=self._peer,
+            )
+        return self._servicer.TrainStep(request, None)
+
+    def ApplyAggregate(self, request, timeout=None, **_kw):
+        return self._servicer.ApplyAggregate(request, None)
+
+
+class _SimServer(FederatedServer):
+    """FederatedServer whose transport is loopback calls into in-process
+    client servicers — full data-plane fidelity (real steppers, real
+    codec bundles, real gate) without 128 gRPC servers."""
+
+    def __init__(self, servicers, **kw):
+        super().__init__(**kw)
+        self._sim_servicers = servicers
+
+    def _stub_for(self, stubs, rec):
+        entry = stubs.get(rec.client_id)
+        if entry is None:
+            stub = _LoopbackStub(
+                self._sim_servicers[rec.client_id],
+                injector=self.fault_injector,
+                peer=f"client{rec.client_id}",
+            )
+            entry = (rec.address, _LoopbackChannel(), stub)
+            stubs[rec.client_id] = entry
+        return entry[2]
+
+
+def _topic_corpus(n_docs, vocab, topics=4, words_per_doc=18, seed=0):
+    """Synthetic topical corpus: each doc draws most words from one
+    latent topic's slice of the vocabulary — NPMI rewards recovering the
+    slices."""
+    rng = np.random.default_rng(seed)
+    slice_size = len(vocab) // topics
+    docs = []
+    for _ in range(n_docs):
+        t = int(rng.integers(topics))
+        own = vocab[t * slice_size:(t + 1) * slice_size]
+        words = list(rng.choice(own, size=words_per_doc - 4))
+        words += list(rng.choice(vocab, size=4))  # noise
+        docs.append(words)
+    return docs
+
+
+def _run_sim(tmp_path, tag, *, n_clients, pacing_policy, max_iters,
+             straggler_delay=0.25, n_stragglers=6, **server_kw):
+    from gfedntm_tpu.data.datasets import BowDataset
+    from gfedntm_tpu.data.vocab import Vocabulary
+    from gfedntm_tpu.federated.stepper import FederatedStepper
+    from gfedntm_tpu.federation.client import FederatedClientServicer
+
+    kwargs = dict(
+        n_components=4, hidden_sizes=(16,), batch_size=8, num_epochs=2,
+        seed=0,
+    )
+    vocab_tokens = tuple(sorted(f"word{i:03d}" for i in range(60)))
+    vocab = Vocabulary(vocab_tokens)
+    id2token = vocab.id2token
+
+    injector = FaultInjector(seed=0)
+    for cid in range(1, n_stragglers + 1):
+        # deterministic stragglers: clients 1..n_stragglers are slow on
+        # EVERY poll
+        injector.script(
+            "TrainStep", kind="delay", delay_s=straggler_delay,
+            times=10 ** 6, peer=f"client{cid}",
+        )
+
+    metrics = MetricsLogger(validate=True)
+    servicers = {}
+    steppers = {}
+    logger = logging.getLogger(f"sim-{tag}")
+    docs_per_client = 12
+    for cid in range(1, n_clients + 1):
+        docs = _topic_corpus(
+            docs_per_client, vocab_tokens, seed=1000 + cid
+        )
+        X = np.zeros((docs_per_client, len(vocab_tokens)), np.float32)
+        for d, words in enumerate(docs):
+            for w in words:
+                X[d, vocab.token2id[w]] += 1.0
+        model = build_template_model("avitm", len(vocab_tokens), kwargs)
+        stepper = FederatedStepper(model)
+        stepper.pre_fit(BowDataset(X=X, idx2token=id2token))
+        steppers[cid] = stepper
+        servicers[cid] = FederatedClientServicer(
+            cid, stepper, on_stop=lambda: None, logger=logger,
+        )
+
+    # Warm every client's jitted step BEFORE the timed federation: a real
+    # fleet pays its trace+compile once at join time, and the sync run
+    # front-loads all of it into round 0 anyway — leaving it in would
+    # make the cohort medians measure jax compile scheduling, not pacing.
+    # The warm call passes a throwaway rng and discards its outputs, so
+    # model state (and the run's trajectory) is untouched.
+    import jax
+    import jax.numpy as jnp
+
+    def warm(stepper):
+        m = stepper.model
+        sched = stepper._schedule
+        out = stepper._step_fn(
+            m.params, m.batch_stats, m.opt_state, stepper._data,
+            jnp.asarray(sched.indices[0]), jnp.asarray(sched.mask[0]),
+            jax.random.PRNGKey(0),
+        )
+        jax.block_until_ready(out[3])
+
+    with ThreadPoolExecutor(max_workers=16) as warm_pool:
+        list(warm_pool.map(warm, steppers.values()))
+
+    server = _SimServer(
+        servicers, min_clients=n_clients, family="avitm",
+        model_kwargs=kwargs, max_iters=max_iters,
+        save_dir=str(tmp_path / tag), metrics=metrics,
+        fault_injector=injector, checkpoint_every=0,
+        round_backoff_s=0.02, pacing_policy=pacing_policy,
+        **server_kw,
+    )
+    server.global_vocab = vocab
+    server.template = build_template_model(
+        "avitm", len(vocab_tokens), kwargs
+    )
+    for cid in range(1, n_clients + 1):
+        server.federation.connect_vocab(cid, (), float(docs_per_client))
+        ack = server.ReadyForTraining(
+            pb.JoinRequest(client_id=cid, address=f"sim:{cid}"), None
+        )
+        assert ack.code == 0
+    assert server.wait_done(timeout=900), f"{tag}: sim did not finish"
+
+    rounds = [
+        e["seconds"] for e in metrics.events("span")
+        if e.get("name") == "round"
+    ]
+    betas = None
+    if server.last_average is not None:
+        from gfedntm_tpu.eval.monitor import find_beta_key
+
+        betas = np.asarray(
+            server.last_average[find_beta_key(server.last_average)]
+        )
+    return server, metrics, rounds, betas
+
+
+@pytest.mark.slow
+def test_scale_demo_cohort_round_time_tracks_cohort(tmp_path):
+    """ISSUE 9 acceptance: a 128-simulated-client federation with
+    FaultInjector-delayed stragglers. Median round wall-clock under
+    cohort:8 must be <= 0.25x the all-clients sync round, while the
+    final model's NPMI stays within 5% (absolute-floored) of the sync
+    run's on the synthetic topical corpus."""
+    from gfedntm_tpu.eval.metrics import npmi_coherence
+    from gfedntm_tpu.eval.monitor import topics_from_beta
+
+    n = 128
+    sync_server, _m_sync, sync_rounds, sync_betas = _run_sim(
+        tmp_path, "sync", n_clients=n, pacing_policy="sync", max_iters=6,
+    )
+    cohort_server, m_cohort, cohort_rounds, cohort_betas = _run_sim(
+        tmp_path, "cohort", n_clients=n, pacing_policy="cohort:8",
+        cohort_size=None, pacing_seed=0, max_iters=120,
+    )
+    assert sync_rounds and cohort_rounds
+    med_sync = float(np.median(sync_rounds))
+    med_cohort = float(np.median(cohort_rounds))
+    print(
+        f"\nscale demo: sync rounds={len(sync_rounds)} med={med_sync:.3f}s"
+        f" | cohort rounds={len(cohort_rounds)} med={med_cohort:.3f}s"
+        f" | ratio={med_cohort / med_sync:.3f}"
+    )
+    assert med_cohort <= 0.25 * med_sync, (
+        f"cohort:8 median round {med_cohort:.3f}s vs sync "
+        f"{med_sync:.3f}s — not <= 0.25x"
+    )
+    # wire/compute cost is O(K): every sampled roster is K=8
+    sampled = m_cohort.events("cohort_sampled")
+    assert sampled
+    assert max(e["k"] for e in sampled) <= 8
+
+    # model quality: both runs converge to comparable NPMI
+    assert sync_betas is not None and cohort_betas is not None
+    vocab_tokens = sorted(f"word{i:03d}" for i in range(60))
+    id2token = dict(enumerate(vocab_tokens))
+    ref_docs = []
+    for cid in range(1, n + 1):
+        ref_docs.extend(
+            _topic_corpus(12, tuple(vocab_tokens), seed=1000 + cid)
+        )
+    npmi_sync = npmi_coherence(
+        topics_from_beta(sync_betas, id2token, topn=8), ref_docs, topn=8
+    )
+    npmi_cohort = npmi_coherence(
+        topics_from_beta(cohort_betas, id2token, topn=8), ref_docs, topn=8
+    )
+    print(
+        f"scale demo: npmi sync={npmi_sync:.4f} cohort={npmi_cohort:.4f}"
+    )
+    tol = max(0.05, 0.05 * abs(npmi_sync))
+    assert abs(npmi_cohort - npmi_sync) <= tol, (
+        f"NPMI diverged: sync {npmi_sync:.4f} vs cohort {npmi_cohort:.4f}"
+    )
