@@ -107,8 +107,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "clients that must answer for a round's average "
                         "to count")
     p.add_argument("--liveness_timeout", type=float, default=300.0,
-                   help="client mode: self-finalize if no server activity "
-                        "arrives within this many seconds (0 disables)")
+                   help="client mode: treat the server as gone if no "
+                        "activity arrives within this many seconds "
+                        "(cold-start window; once polls flow the window "
+                        "adapts to the observed cadence; 0 disables)")
+    # Crash survival (README "Crash recovery & sessions"): durable client
+    # sessions, the per-round recovery journal, and process-level chaos.
+    p.add_argument("--reconnect_window", type=float, default=180.0,
+                   help="client mode: when the server goes quiet, keep "
+                        "re-presenting the session token for up to this "
+                        "many seconds (RECONNECTING) before "
+                        "self-finalizing (0 restores the legacy "
+                        "watchdog-finalize behaviour)")
+    p.add_argument("--journal_every", type=int, default=1,
+                   help="server mode: journal the pushed round state "
+                        "every K rounds for zero-flag crash "
+                        "auto-recovery (default 1 — at most one in-"
+                        "flight round replays after a kill; 0 disables "
+                        "the journal AND auto-recovery)")
+    p.add_argument("--no_autorecover", action="store_true",
+                   help="server mode: do not auto-resume an interrupted "
+                        "run from the journal/checkpoint at startup "
+                        "(auto-recovery is otherwise on whenever "
+                        "save_dir holds recovery state)")
+    p.add_argument("--chaos", type=str, default=None,
+                   help="server mode, chaos harness: JSON list of fault "
+                        "specs injected into the server's client stubs, "
+                        "e.g. '[{\"method\": \"*\", \"kind\": "
+                        "\"partition\", \"peer\": \"client2\", "
+                        "\"delay_s\": 5}]' (see resilience.FaultSpec)")
     # Round pacing (README "Federation pacing"): cohort sampling and
     # buffered async — the knobs that decouple round time from the
     # population size.
@@ -337,6 +364,23 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
             raise SystemExit("--server_lr needs a server-optimizer "
                              "aggregator (fedavgm/fedadam/fedyogi)")
         aggregator_kwargs["server_lr"] = args.server_lr
+    fault_injector = None
+    if getattr(args, "chaos", None):
+        # Process-level chaos harness hook: scripted faults on the
+        # server's client stubs (partition personas, drops, delays).
+        from gfedntm_tpu.federation.resilience import FaultInjector
+
+        fault_injector = FaultInjector(seed=0, metrics=metrics)
+        try:
+            specs = json.loads(args.chaos)
+            for spec in specs:
+                if isinstance(spec.get("code"), str):
+                    import grpc
+
+                    spec["code"] = getattr(grpc.StatusCode, spec["code"])
+                fault_injector.script(spec.pop("method"), **spec)
+        except (ValueError, KeyError, TypeError, AttributeError) as err:
+            raise SystemExit(f"--chaos: bad fault spec ({err})")
     server = FederatedServer(
         min_clients=args.min_clients_federation,
         family=args.model_type,
@@ -362,6 +406,8 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         async_buffer=getattr(args, "async_buffer", None),
         staleness_alpha=getattr(args, "staleness_alpha", 0.5),
         pacing_seed=getattr(args, "pacing_seed", 0),
+        journal_every=getattr(args, "journal_every", 1),
+        fault_injector=fault_injector,
         ops_port=getattr(args, "ops_port", None),
         profiler=profiler,
         quality_every=getattr(args, "quality_every", 0),
@@ -377,6 +423,23 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         except (FileNotFoundError, CheckpointIntegrityError) as err:
             raise SystemExit(f"--resume: {err}")
         logging.info("resuming federation from round %d", round_idx)
+    elif not getattr(args, "no_autorecover", False):
+        # Zero-flag crash recovery (README "Crash recovery & sessions"):
+        # an interrupted run's journal/checkpoint under save_dir resumes
+        # automatically — no operator intervention after a server kill.
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        try:
+            round_idx = server.maybe_autorecover()
+        except CheckpointIntegrityError as err:
+            raise SystemExit(
+                f"auto-recovery found corrupt state: {err} (start with "
+                "--no_autorecover to ignore it and begin fresh)"
+            )
+        if round_idx is not None:
+            logging.info(
+                "auto-recovered federation from round %d", round_idx
+            )
     port = args.listen_port if args.listen_port is not None else 50051
     server.start(f"[::]:{port}")
     logging.info("server on port %d; waiting for federation", port)
@@ -426,6 +489,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         save_dir=save_dir,
         metrics=metrics,
         liveness_timeout=getattr(args, "liveness_timeout", 300.0),
+        reconnect_window=getattr(args, "reconnect_window", 180.0),
         wire_codec=getattr(args, "wire_codec", None) or "auto",
         profiler=profiler,
     )

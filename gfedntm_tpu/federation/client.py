@@ -35,6 +35,19 @@ from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.federated.stepper import FederatedStepper
 from gfedntm_tpu.utils.observability import span
 
+#: Adaptive liveness-window constants (README "Crash recovery &
+#: sessions"): once inter-poll gaps have been observed, the watchdog
+#: window is margin + headroom x the gap EWMA — wide enough that the
+#: server's ordinary cadence (including quorum-skip backoffs an order of
+#: magnitude above the typical gap) never reads as a dead server, tight
+#: enough that a genuinely dead one is detected in seconds, not minutes.
+#: The floor keeps a milliseconds-scale cadence from producing a window
+#: ordinary jitter could blow. The fixed ``liveness_timeout x (120+2E)/120``
+#: formula remains the cold-start fallback (no gaps observed yet).
+WATCHDOG_GAP_HEADROOM = 10.0
+WATCHDOG_GAP_MARGIN_S = 5.0
+WATCHDOG_FLOOR_S = 10.0
+
 
 class FederatedClientServicer:
     """The in-client gRPC service the server polls during training
@@ -87,6 +100,14 @@ class FederatedClientServicer:
         # replicated init). Reported as StepReply.base_round (1 + tag) so
         # an async server can staleness-discount free-running updates.
         self._applied_round = -1  # guarded-by: _lock
+        # Idempotency replay cache (README "Crash recovery & sessions"):
+        # the last server-minted TrainStep seq and the reply it produced.
+        # A replayed delivery — the server retrying a call that timed out
+        # AFTER executing here — is answered from this cache; re-running
+        # the local steps would double-advance training and double-count
+        # this client in the average.
+        self._last_step_seq = 0  # guarded-by: _lock
+        self._last_step_reply: pb.StepReply | None = None  # guarded-by: _lock
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
         """The round's local step(s); reply with the post-step shared
@@ -103,6 +124,26 @@ class FederatedClientServicer:
 
     def _train_step(self, request: pb.StepRequest) -> pb.StepReply:
         with self._lock:
+            seq = int(request.seq)
+            if (
+                seq and self._last_step_reply is not None
+                and seq <= self._last_step_seq
+            ):
+                # Replayed delivery (retry after a timed-out-but-delivered
+                # call): idempotent — answer from the cache, advance
+                # nothing.
+                self.logger.warning(
+                    "client %d: replayed TrainStep seq %d (have %d); "
+                    "answering from the replay cache",
+                    self.client_id, seq, self._last_step_seq,
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter("rpcs_deduplicated").inc()
+                    self.metrics.log(
+                        "rpc_deduplicated", client=self.client_id,
+                        method="TrainStep", seq=seq,
+                    )
+                return self._last_step_reply
             if self.profiler is not None:
                 self.profiler.observe(int(request.global_iter))
             requested = max(1, int(request.local_steps or 1))
@@ -135,7 +176,7 @@ class FederatedClientServicer:
                 shared = codec.flatdict_to_bundle(
                     snapshot, metrics=self.metrics
                 )
-            return pb.StepReply(
+            reply = pb.StepReply(
                 client_id=self.client_id,
                 shared=shared,
                 loss=float(sum(losses) / len(losses)),
@@ -144,7 +185,12 @@ class FederatedClientServicer:
                 current_epoch=self.stepper.current_epoch,
                 finished=self.stepper.finished,
                 base_round=self._applied_round + 1,
+                seq=seq,
             )
+            if seq:
+                self._last_step_seq = seq
+                self._last_step_reply = reply
+            return reply
 
     def ApplyAggregate(self, request: pb.Aggregate, context) -> pb.AggregateReply:
         """Overwrite shared params with the global average and advance
@@ -162,6 +208,31 @@ class FederatedClientServicer:
                 self.on_stop()
                 return pb.AggregateReply(
                     client_id=self.client_id, finished=True,
+                    current_epoch=self.stepper.current_epoch,
+                )
+            if (
+                not request.reset_session
+                and int(request.round) <= self._applied_round
+            ):
+                # Replayed push for a round already applied (retry after a
+                # timed-out-but-delivered delivery, or a restarted server
+                # replaying its in-flight round): applying it again would
+                # rewind the model and corrupt the delta-reference chain.
+                # A reset_session push is exempt — it deliberately
+                # re-delivers state (rollback / recovery re-broadcast).
+                self.logger.warning(
+                    "client %d: ignoring replayed push for round %d "
+                    "(already applied)", self.client_id, int(request.round),
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter("rpcs_deduplicated").inc()
+                    self.metrics.log(
+                        "rpc_deduplicated", client=self.client_id,
+                        method="ApplyAggregate", round=int(request.round),
+                    )
+                return pb.AggregateReply(
+                    client_id=self.client_id,
+                    finished=self.stepper.finished,
                     current_epoch=self.stepper.current_epoch,
                 )
             if request.reset_session:
@@ -238,6 +309,7 @@ class Client:
         retry_policy=None,
         wire_codec: str | None = "auto",
         profiler=None,
+        reconnect_window: float = 180.0,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -270,6 +342,22 @@ class Client:
         self.liveness_timeout = float(liveness_timeout)
         self.watchdog_poll_s = float(watchdog_poll_s)
         self._deadline_scale = 1.0
+        # Inter-poll gap EWMA: every server contact measures the idle gap
+        # since the previous one, and the watchdog window derives from it
+        # (WATCHDOG_GAP_* above) — the fixed formula is only the
+        # cold-start fallback, so a server legitimately running short
+        # adaptive poll deadlines is detected dead in seconds while a
+        # slow one cannot race this client into premature finalization.
+        self._gap_ewma: float | None = None
+        # Durable session (README "Crash recovery & sessions"): with a
+        # server-minted session token and reconnect_window > 0, a client
+        # whose server contact dies enters RECONNECTING — re-presenting
+        # the token under retry backoff for up to reconnect_window
+        # seconds — instead of self-finalizing. 0 restores the legacy
+        # watchdog-finalize behaviour.
+        self.reconnect_window = float(reconnect_window)
+        self.session_token = ""
+        self._advertised_address = ""
         # Retries transient failures of the client->server control RPCs
         # (join, readiness) — covers a server that is restarting for resume.
         from gfedntm_tpu.federation.resilience import RetryPolicy
@@ -304,8 +392,18 @@ class Client:
         self._last_activity = time.monotonic()
 
     def _rpc_begin(self) -> None:
+        now = time.monotonic()
         with self._inflight_lock:
             self._inflight += 1
+            # The idle gap since the last contact ended is exactly the
+            # quantity the watchdog measures — fold it into the EWMA the
+            # adaptive window derives from.
+            gap = now - self._last_activity
+            if gap >= 0.0:
+                self._gap_ewma = (
+                    gap if self._gap_ewma is None
+                    else 0.7 * self._gap_ewma + 0.3 * gap
+                )
         self._touch()
 
     def _rpc_end(self) -> None:
@@ -320,18 +418,63 @@ class Client:
             1.0, (120.0 + 2.0 * local_steps) / 120.0
         )
 
+    def _reconnect_available(self) -> bool:
+        """Reconnecting makes sense only while this client still has
+        training to resume: an early finisher waiting for the fleet-wide
+        stop broadcast sees the server go legitimately quiet (finished
+        members are not polled) — probing ReadyForTraining then would
+        re-enroll it as unfinished server-side and flap it through
+        pointless extra polls forever. Finished clients fall back to the
+        legacy conservative watchdog-finalize."""
+        return (
+            self.reconnect_window > 0
+            and bool(self.session_token)
+            and not (self.stepper is not None and self.stepper.finished)
+        )
+
+    def _watchdog_window(self) -> float:
+        """The liveness window: before any inter-poll gap is observed,
+        the historical fixed formula (``liveness_timeout`` scaled by the
+        server's ``(120+2E)/120`` deadline factor); afterwards, derived
+        from the observed cadence. When detection only triggers a cheap
+        reconnect probe the adaptive window may shrink below the fixed
+        one (fast dead-server detection); when it triggers the
+        destructive self-finalize (``reconnect_window=0``, or this
+        client already finished) it may only ever widen it."""
+        fixed = self.liveness_timeout * self._deadline_scale
+        with self._inflight_lock:
+            ewma = self._gap_ewma
+        if ewma is None:
+            return fixed
+        adaptive = WATCHDOG_GAP_MARGIN_S + WATCHDOG_GAP_HEADROOM * ewma
+        if self._reconnect_available():
+            # Detection triggers only a cheap reconnect probe: the window
+            # may shrink well below the fixed formula (fast dead-server
+            # detection), floored against ordinary jitter and capped at
+            # the operator's own bound.
+            return min(fixed, max(adaptive, min(WATCHDOG_FLOOR_S, fixed)))
+        # Detection self-finalizes — destructive — so the observed
+        # cadence may only ever WIDEN the operator's window (the
+        # premature-finalize fix: a server legitimately pacing slower
+        # than the configured window must not read as dead).
+        return max(fixed, adaptive)
+
     def _idle_expired(self) -> float | None:
-        """Seconds of idle time iff past the (scaled) liveness window."""
+        """Seconds of idle time iff past the liveness window."""
         idle = time.monotonic() - self._last_activity
-        window = self.liveness_timeout * self._deadline_scale
-        return idle if idle > window else None
+        return idle if idle > self._watchdog_window() else None
 
     def run(self) -> None:
         """Blocking end-to-end client lifecycle; returns once the server's
-        stop broadcast has been processed and artifacts are written — or
-        once the liveness watchdog concludes the server is gone and
-        self-finalizes (the reference client, and our first rewrite, would
-        block in ``stopped.wait()`` forever)."""
+        stop broadcast has been processed and artifacts are written. When
+        the liveness watchdog concludes the server is gone, the client
+        first enters RECONNECTING (re-presenting its session token under
+        backoff for up to ``reconnect_window`` seconds — a restarted
+        server re-admits it and training continues from the current
+        broadcast round) and only self-finalizes once the window is
+        exhausted or the federation is reported finished (the reference
+        client, and our first rewrite, would block in ``stopped.wait()``
+        forever)."""
         self.join_federation()
         self.serve_training()
         if self.liveness_timeout <= 0:
@@ -346,10 +489,110 @@ class Client:
                 # An open server call IS liveness, however long its local
                 # steps run — idle time only accrues between calls.
                 continue
-            if self._idle_expired() is None:
+            idle = self._idle_expired()
+            if idle is None:
                 continue
+            if self._reconnect_available():
+                if self._reconnect_loop(idle):
+                    continue  # reconnected (or stop arrived meanwhile)
             if self._watchdog_finalize():
                 break
+
+    def _reconnect_loop(self, idle: float) -> bool:
+        """RECONNECTING: the server went quiet past the liveness window —
+        keep re-presenting the session token (each attempt a fresh
+        ReadyForTraining carrying this client's serving address) under
+        capped decorrelated backoff until the server answers, the window
+        is exhausted, or a stop arrives. Returns True to resume the
+        watchdog wait, False to let it self-finalize."""
+        start = time.monotonic()
+        self.logger.warning(
+            "client %d: no server activity for %.0f s — RECONNECTING "
+            "(session %s…, up to %.0f s)",
+            self.client_id, idle, self.session_token[:8],
+            self.reconnect_window,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("reconnects_entered").inc()
+        attempts = 0
+        delays = self.retry_policy.delays()
+        while not self.stopped.is_set():
+            if time.monotonic() - start > self.reconnect_window:
+                self.logger.error(
+                    "client %d: reconnect window (%.0f s) exhausted after "
+                    "%d attempts; self-finalizing",
+                    self.client_id, self.reconnect_window, attempts,
+                )
+                return False
+            attempts += 1
+            try:
+                ack = self._federation_stub.ReadyForTraining(
+                    pb.JoinRequest(
+                        client_id=self.client_id,
+                        address=self._advertised_address,
+                        codec_id=(
+                            self._codec.codec_id if self._codec is not None
+                            else "none"
+                        ),
+                        session_token=self.session_token,
+                    ),
+                    timeout=10.0,
+                )
+            except Exception as exc:
+                self.logger.info(
+                    "client %d: reconnect attempt %d failed (%s)",
+                    self.client_id, attempts, exc,
+                )
+                # Capped decorrelated jitter between probes; a stop
+                # broadcast (the servicer stays up throughout) wakes the
+                # wait immediately.
+                self.stopped.wait(min(next(delays), 5.0))
+                continue
+            if ack.code == 1:
+                self.logger.warning(
+                    "client %d: federation finished while disconnected; "
+                    "finalizing", self.client_id,
+                )
+                return False
+            if ack.code == 2:
+                self.logger.error(
+                    "client %d: reconnect rejected (%s); finalizing",
+                    self.client_id, ack.detail,
+                )
+                return False
+            if ack.code == 3:
+                # A recovered server process holds none of the wire-codec
+                # session state this client still carries — drop both
+                # directions so the next exchanged bundles are
+                # self-contained on both ends (the PR 5 reset semantics,
+                # client-initiated).
+                self.logger.warning(
+                    "client %d: recovered server ordered a wire-codec "
+                    "session reset", self.client_id,
+                )
+                lock = (
+                    self._servicer._lock if self._servicer is not None
+                    else threading.RLock()
+                )
+                with lock:
+                    if self._uplink is not None:
+                        self._uplink.reset()
+                    if self._downlink is not None:
+                        self._downlink.reset()
+            self._touch()
+            downtime = time.monotonic() - start
+            self.logger.warning(
+                "client %d: reconnected after %d attempt(s) (%.1f s "
+                "offline)", self.client_id, attempts, downtime,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("client_reconnections").inc()
+                self.metrics.log(
+                    "client_reconnected", client=self.client_id,
+                    attempts=attempts, downtime_s=downtime,
+                )
+            return True
+        return True  # stop arrived mid-reconnect: nothing left to do
 
     def _watchdog_finalize(self) -> bool:
         """Self-finalize under the servicer's lock, re-checking liveness
@@ -376,7 +619,7 @@ class Client:
         self.logger.warning(
             "client %d: no server activity for %.0f s (> %.0f s liveness "
             "window); self-finalizing", self.client_id, idle,
-            self.liveness_timeout * self._deadline_scale,
+            self._watchdog_window(),
         )
         if self.metrics is not None:
             self.metrics.registry.counter("watchdog_self_finalized").inc()
@@ -418,6 +661,10 @@ class Client:
                 pb.JoinRequest(client_id=self.client_id),
                 timeout=self.setup_timeout,
             )
+            # Durable-session credential: presented on every
+            # ReadyForTraining; a reconnect re-presenting it is re-admitted
+            # as this same live process.
+            self.session_token = setup.session_token or ""
             self.global_vocab = Vocabulary(tuple(setup.vocab))
             self._negotiate_codec(setup.codec_id or "none")
             hyper = json.loads(setup.hyperparams_json)
@@ -526,14 +773,16 @@ class Client:
         port = self._grpc_server.add_insecure_port(self.listen_address)
         self._grpc_server.start()
         self.logger.info("client %d serving on port %d", self.client_id, port)
+        self._advertised_address = f"{self.advertise_host}:{port}"
         ack = self._federation_stub.ReadyForTraining(
             pb.JoinRequest(
                 client_id=self.client_id,
-                address=f"{self.advertise_host}:{port}",
+                address=self._advertised_address,
                 codec_id=(
                     self._codec.codec_id if self._codec is not None
                     else "none"
                 ),
+                session_token=self.session_token,
             )
         )
         if ack.code == 2:

@@ -375,11 +375,16 @@ class RoundEngine:
             stub = s._stub_for(stubs, rec)
             if stub is None:
                 raise RuntimeError("client has no serving address")
+            # One seq per logical delivery: retry attempts reuse the same
+            # request, so a retry after a timed-out-but-delivered call is
+            # answered from the client's replay cache instead of running
+            # more local steps (README "Crash recovery & sessions").
             reply = stub.TrainStep(
                 pb.StepRequest(
                     global_iter=iteration,
                     local_steps=s.local_steps,
                     broadcast_round=s.global_iterations,
+                    seq=s._next_step_seq(),
                 ),
                 timeout=self.poll_deadline(rec),
                 **rpc_kwargs,
@@ -456,7 +461,38 @@ class RoundEngine:
                     s._push_acked[rec.client_id] = iteration
                 else:
                     s._push_acked.pop(rec.client_id, None)
+        # Crash-recovery journal: the round is now fully pushed — one
+        # atomic journal write makes it the restart point, so a SIGKILL
+        # from here on replays at most the next (in-flight) round.
+        s._journal_round(iteration)
         return acked
+
+    def _wait_for_pollable(self, iteration: int) -> list:
+        """No pollable client right now: convert probation backoffs and
+        the post-recovery reconnect grace into wall-clock waits (no
+        rounds burned) and return the next pollable roster — empty when
+        the federation is genuinely over (or stopping). Covers the
+        recovered-fleet shape where every reconnected member finished in
+        seconds while a restored member's watchdog has not even fired
+        yet: the run must wait out the grace, not end without it."""
+        s = self.server
+        while not s._stopping.is_set():
+            pending = s.federation.pending_suspects(iteration)
+            if not pending and not s._awaiting_reconnect_grace():
+                return []
+            if pending:
+                # Earliest scheduled probation retry, as wall-clock (one
+                # backoff tick per round it is denominated in).
+                gap = min(x.next_retry_round for x in pending) - iteration
+                wait_s = s.round_backoff_s * max(1, gap)
+            else:
+                wait_s = s.round_backoff_s
+            if s._stopping.wait(wait_s):
+                return []
+            active = s.federation.active_clients()
+            if active:
+                return active
+        return []
 
     def _maybe_checkpoint(self, iteration: int) -> None:
         s = self.server
@@ -529,19 +565,12 @@ class SyncEngine(RoundEngine):
                 break
             active = s.federation.active_clients(iteration)
             if not active:
-                pending = s.federation.pending_suspects(iteration)
-                if not pending:
-                    break
-                # Every pollable client is inside its probation backoff
-                # window, so no round can advance the round clock the
-                # backoff is denominated in. Convert the gap to the
-                # earliest scheduled retry into wall-clock (one backoff
-                # tick per round), wait it out, then poll the suspects
-                # early — instead of burning one max_iters round per tick.
-                gap = min(x.next_retry_round for x in pending) - iteration
-                if s._stopping.wait(s.round_backoff_s * max(1, gap)):
-                    break
-                active = s.federation.active_clients()
+                # Every pollable client is in probation backoff, still
+                # reconnecting after a server recovery, or gone: wait in
+                # wall-clock (never burning max_iters rounds) and poll
+                # whoever comes back early; an empty roster after the
+                # waits is the end of the federation.
+                active = self._wait_for_pollable(iteration)
                 if not active:
                     break
 
@@ -587,9 +616,12 @@ class SyncEngine(RoundEngine):
                 if not replies:
                     # A fully failed round ends the federation only when
                     # nobody is left to come back (everyone dropped or
-                    # finished); otherwise wait out a backoff tick and let
-                    # probation re-poll.
-                    if not s.federation.active_clients():
+                    # finished, nobody mid-reconnect); otherwise wait out
+                    # a backoff tick and let probation re-poll.
+                    if (
+                        not s.federation.active_clients()
+                        and not s._awaiting_reconnect_grace()
+                    ):
                         break
                     s._stopping.wait(s.round_backoff_s)
                     continue
@@ -883,10 +915,16 @@ class AsyncEngine(RoundEngine):
                     )
                     continue
                 pending = s.federation.pending_suspects(iteration)
-                if not pending:
+                if not pending and not s._awaiting_reconnect_grace():
                     break
-                gap = min(x.next_retry_round for x in pending) - iteration
-                if s._stopping.wait(s.round_backoff_s * max(1, gap)):
+                if pending:
+                    gap = (
+                        min(x.next_retry_round for x in pending) - iteration
+                    )
+                    wait_s = s.round_backoff_s * max(1, gap)
+                else:
+                    wait_s = s.round_backoff_s  # reconnect grace tick
+                if s._stopping.wait(wait_s):
                     break
                 continue
             # 2. fold completed polls into the buffer.

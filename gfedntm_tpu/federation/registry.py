@@ -53,6 +53,28 @@ class ClientRecord:
     # failures, "poisoned" for updates the admission gate rejected,
     # "divergence" for a quarantine after a global-model rollback.
     suspect_reason: str = ""
+    # Durable-session credential (README "Crash recovery & sessions"):
+    # minted by the server in its GetGlobalSetup reply, persisted with
+    # every round checkpoint/journal membership snapshot. A client
+    # re-presenting it in ReadyForTraining is the SAME live process
+    # reconnecting — its server-side state (straggler EWMA, push-ack
+    # posture) survives; a token-less or mismatched rejoin is a fresh
+    # process and starts clean.
+    session_token: str = ""
+    # True between the token mint (GetGlobalSetup) and the client's first
+    # ReadyForTraining — distinguishes the initial ready of a new session
+    # from a genuine live-process reconnect (see Federation.classify_join).
+    session_fresh: bool = False
+    # Set when the server recovered from a crash while this member held
+    # live wire-codec session state the new process does not: its first
+    # token reconnect is answered Ack code 3 ("reset your codec
+    # sessions") so both ends restart from self-contained bundles.
+    needs_codec_reset: bool = False
+    # Restored from a recovery snapshot but not yet reconnected: the
+    # round loop holds the federation open for these members for a
+    # bounded grace window instead of declaring the run finished the
+    # moment every already-reconnected member completes.
+    awaiting_reconnect: bool = False
 
 
 @dataclass
@@ -91,6 +113,78 @@ class Federation:
                 timeout=timeout,
             )
 
+    def set_session_token(self, client_id: int, token: str) -> ClientRecord:
+        """Store a freshly-minted session token for a client (creating
+        its record if this is the first contact). Minting marks the
+        session fresh and clears any pending codec-reset order — a
+        process that just passed through GetGlobalSetup has no stale
+        session state to reset."""
+        with self._cond:
+            rec = self._clients.setdefault(client_id, ClientRecord(client_id))
+            rec.session_token = token
+            rec.session_fresh = True
+            rec.needs_codec_reset = False
+            return rec
+
+    def classify_join(self, client_id: int, token: str) -> str:
+        """Classify one ReadyForTraining: ``"new"`` (token-less, unknown,
+        or mismatched — a fresh process), ``"first"`` (the initial ready
+        of a just-minted session), or ``"restore"`` (a live process
+        re-presenting its credential after a connection loss)."""
+        with self._lock:
+            rec = self._clients.get(client_id)
+            if rec is None or not token or rec.session_token != token:
+                return "new"
+            if rec.session_fresh:
+                rec.session_fresh = False
+                return "first"
+            return "restore"
+
+    def consume_codec_reset(self, client_id: int) -> bool:
+        """Read-and-clear the member's pending codec-reset order (set by
+        server recovery for members that held live codec sessions)."""
+        with self._lock:
+            rec = self._clients.get(client_id)
+            if rec is None or not rec.needs_codec_reset:
+                return False
+            rec.needs_codec_reset = False
+            return True
+
+    def restore_member(
+        self, client_id: int, nr_samples: float = 0.0,
+        session_token: str = "", finished: bool = False,
+        current_mb: int = 0, current_epoch: int = 0,
+        needs_codec_reset: bool = False,
+    ) -> ClientRecord:
+        """Rebuild one membership record from a checkpoint/journal
+        snapshot on server recovery. The record is NOT ready for
+        training — the client must reconnect (presenting its restored
+        session token) before it is polled again."""
+        with self._cond:
+            rec = self._clients.setdefault(client_id, ClientRecord(client_id))
+            rec.nr_samples = float(nr_samples)
+            rec.session_token = session_token
+            rec.session_fresh = False
+            rec.needs_codec_reset = bool(needs_codec_reset)
+            rec.finished = bool(finished)
+            rec.current_mb = int(current_mb)
+            rec.current_epoch = int(current_epoch)
+            rec.ready_for_training = False
+            rec.awaiting_reconnect = not finished
+            # `finished` alone keeps the member out of every poll; status
+            # stays ACTIVE (a checkpointed finisher is not a drop).
+            rec.status = ACTIVE
+            self._cond.notify_all()
+            return rec
+
+    def awaiting_reconnect(self) -> list[ClientRecord]:
+        """Restored unfinished members that have not reconnected yet."""
+        with self._lock:
+            return [
+                c for c in self.get_clients()
+                if c.awaiting_reconnect and not c.finished
+            ]
+
     # ---- training phase ----------------------------------------------------
     def connect_ready(self, client_id: int, address: str) -> ClientRecord:
         """Also the rejoin path: a client that was dropped mid-training
@@ -101,8 +195,10 @@ class Federation:
             rec.address = address
             rec.ready_for_training = True
             rec.finished = False
+            rec.awaiting_reconnect = False
             # A (re)joining client starts with a clean probation slate — a
-            # fresh process is a fresh liveness history.
+            # fresh process is a fresh liveness history (and a reconnecting
+            # live process has, by reconnecting, just proven liveness).
             rec.status = ACTIVE
             rec.consecutive_failures = 0
             rec.next_retry_round = 0

@@ -86,6 +86,12 @@ class RetryPolicy:
     max_delay_s: float = 2.0
     seed: int | None = None
     metrics: Any = None
+    # Idempotent mode (README "Crash recovery & sessions"): the guarded
+    # RPCs carry sequence numbers that make a duplicate delivery safe
+    # (the peer answers a replay from its cache), so DEADLINE_EXCEEDED —
+    # "the call may have executed" — becomes retryable too. Leave False
+    # for RPCs without replay protection.
+    idempotent: bool = False
     sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
 
     def __post_init__(self):
@@ -104,6 +110,16 @@ class RetryPolicy:
             )
             yield prev
 
+    def retryable(self, exc: BaseException) -> bool:
+        """Transient errors always; a deadline expiry additionally when
+        the policy guards idempotent (sequence-numbered) RPCs."""
+        if is_transient(exc):
+            return True
+        return (
+            self.idempotent
+            and error_code(exc) is grpc.StatusCode.DEADLINE_EXCEEDED
+        )
+
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         """Invoke ``fn``, retrying transient failures up to ``max_attempts``
         total attempts. Permanent errors and exhausted budgets re-raise the
@@ -114,8 +130,8 @@ class RetryPolicy:
             try:
                 result = fn(*args, **kwargs)
             except Exception as exc:
-                if not is_transient(exc) or attempt >= self.max_attempts:
-                    if reg is not None and is_transient(exc):
+                if not self.retryable(exc) or attempt >= self.max_attempts:
+                    if reg is not None and self.retryable(exc):
                         reg.counter("retry_giveups").inc()
                     raise
                 if reg is not None:
@@ -148,7 +164,9 @@ class InjectedRpcError(grpc.RpcError):
 
 
 #: FaultSpec kinds that act BEFORE the call (fail/slow the RPC itself) vs
-#: AFTER it (mutate the reply payload in place).
+#: AFTER it (mutate the reply payload in place). "partition" is a
+#: before-kind with its own wall-clock-window lifecycle (see
+#: FaultInjector.before_call).
 _BEFORE_KINDS = frozenset({"error", "delay"})
 _AFTER_KINDS = frozenset({"corrupt"})
 
@@ -163,11 +181,15 @@ class FaultSpec:
     reply's tensor payload per ``payload`` — ``"nan"`` (every float value
     becomes NaN), ``"scale:<x>"`` (values multiplied by ``x``, e.g. an
     adversarially boosted update), or ``"random"`` (values replaced with
-    seeded noise). ``peer=""`` matches any peer. ``skip`` lets that many
-    matching calls pass untouched before the fault arms (e.g. poison round
-    4, not round 0). ``probability < 1`` fires probabilistically from the
-    injector's seeded RNG (still deterministic for a fixed seed and call
-    order).
+    seeded noise); ``"partition"`` blackholes the matched peer for a
+    wall-clock window — EVERY matching call fails ``UNAVAILABLE`` for
+    ``delay_s`` seconds from the first matching call (the window arms on
+    first contact), the network-partition persona. ``peer=""`` matches
+    any peer; ``method="*"`` matches any method (a partition severs the
+    whole link, not one RPC). ``skip`` lets that many matching calls pass
+    untouched before the fault arms (e.g. poison round 4, not round 0).
+    ``probability < 1`` fires probabilistically from the injector's
+    seeded RNG (still deterministic for a fixed seed and call order).
     """
 
     method: str
@@ -181,10 +203,20 @@ class FaultSpec:
     skip: int = 0
 
     def __post_init__(self):
-        if self.kind not in ("error", "drop", "delay", "corrupt"):
+        if self.kind not in ("error", "drop", "delay", "corrupt",
+                             "partition"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "drop":
             self.kind, self.code = "error", grpc.StatusCode.UNAVAILABLE
+        if self.kind == "partition":
+            if self.delay_s <= 0:
+                raise ValueError(
+                    "partition fault needs delay_s > 0 (the blackhole "
+                    "window in seconds)"
+                )
+            # The window is wall-clock, not call-count: armed_at is set by
+            # the first matching call.
+            self.armed_at: float | None = None
         if self.kind == "corrupt":
             if not (
                 self.payload in ("nan", "random")
@@ -250,7 +282,7 @@ class FaultInjector:
         spec = next(
             (
                 s for s in self._specs
-                if s.times > 0 and s.method == method
+                if s.times > 0 and s.method in ("*", method)
                 and s.peer in ("", peer) and s.kind in kinds
             ),
             None,
@@ -272,11 +304,55 @@ class FaultInjector:
             self.metrics.registry.counter("faults_injected").inc()
         return spec
 
+    def _check_partition(self, method: str, peer: str) -> FaultSpec | None:
+        """Partition lifecycle (must be called under the lock): the first
+        matching call arms the wall-clock window; every matching call
+        inside it is blackholed; the first matching call past it heals
+        the link and retires the spec. Unlike count-based faults, a
+        partition fails EVERY call in its window — retry storms included —
+        which is exactly what a severed link does."""
+        spec = next(
+            (
+                s for s in self._specs
+                if s.kind == "partition" and s.method in ("*", method)
+                and s.peer in ("", peer)
+            ),
+            None,
+        )
+        if spec is None:
+            return None
+        if spec.skip > 0:
+            spec.skip -= 1
+            return None
+        now = time.monotonic()
+        if spec.armed_at is None:
+            spec.armed_at = now
+            if self.metrics is not None:
+                self.metrics.registry.counter("partitions_injected").inc()
+                self.metrics.log(
+                    "partition_injected", peer=peer, method=method,
+                    window_s=spec.delay_s,
+                )
+        if now - spec.armed_at <= spec.delay_s:
+            self.fired.append((method, peer, spec.kind))
+            if self.metrics is not None:
+                self.metrics.registry.counter("faults_injected").inc()
+            return spec
+        self._specs.remove(spec)  # window elapsed: the link heals
+        return None
+
     def before_call(self, service: str, method: str, request: Any = None,
                     peer: str = "") -> None:
         """Consult the script for one call; raises/sleeps per the matched
         spec, or returns immediately when nothing matches."""
         with self._lock:
+            spec = self._check_partition(method, peer)
+            if spec is not None:
+                raise InjectedRpcError(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"injected partition for {service}/{method} "
+                    f"(peer={peer!r}, window={spec.delay_s:g}s)",
+                )
             spec = self._consume(method, peer, _BEFORE_KINDS)
         if spec is None:
             return

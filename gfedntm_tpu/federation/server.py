@@ -47,10 +47,14 @@ sleep × N clients per step plus 2N fresh channels, SURVEY.md §3.3):
 
 from __future__ import annotations
 
+import dataclasses
+import itertools
 import json
 import logging
 import math
 import threading
+import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -157,6 +161,8 @@ class FederatedServer:
         async_buffer: int | None = None,
         staleness_alpha: float = 0.5,
         pacing_seed: int = 0,
+        journal_every: int = 1,
+        reconnect_grace_s: float = 120.0,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -275,12 +281,52 @@ class FederatedServer:
         # delta-encode against a broadcast the fresh process never held.
         self._push_lock = threading.Lock()
         self._push_acked: dict[int, int] = {}  # guarded-by: _push_lock
-        # Set by a divergence rollback: the NEXT push carries
-        # Aggregate.reset_session so every recipient drops its wire-codec
-        # session state (delta refs + error-feedback residuals) before
-        # applying — no mass from the discarded trajectory survives
-        # client-side.
+        # Set by a divergence rollback (and by crash recovery): the NEXT
+        # push carries Aggregate.reset_session so every recipient drops
+        # its wire-codec session state (delta refs + error-feedback
+        # residuals) before applying — no mass from the discarded
+        # trajectory (or from a dead server pairing) survives client-side.
         self._session_reset_pending = False
+
+        # Idempotent-RPC plane (README "Crash recovery & sessions"):
+        # every TrainStep delivery carries a server-minted sequence
+        # number, monotonic ACROSS restarts (wall-clock epoch base) —
+        # clients answer a replayed seq from their cache, and
+        # `_reply_seen` (last seen reply seq per client; written by the
+        # training loop, cleared by join-time servicer threads — CPython
+        # dict ops are atomic and a lost clear only widens the replay
+        # guard) drops duplicate StepReplies before they can
+        # double-count a client in the average or corrupt delta-codec
+        # ack state. Client stubs therefore run an idempotent retry
+        # policy: DEADLINE_EXCEEDED — "the call may have executed" —
+        # becomes safely retryable.
+        self._seq_epoch = int(time.time()) << 20
+        self._seq_counter = itertools.count(1)
+        self._reply_seen: dict[int, int] = {}
+        self.client_retry_policy = dataclasses.replace(
+            self.retry_policy, idempotent=True
+        )
+
+        # Crash-recovery plane: a per-pushed-round journal (atomic npz +
+        # JSON under save_dir/checkpoints) lets a SIGKILLed server
+        # restarted with NO flags resume from the last fully-pushed
+        # round — `journal_every` rounds of work at risk (default 1; 0
+        # disables journaling and auto-recovery).
+        self.journal_every = int(journal_every)
+        self._round_journal = None
+        self._recovered_from: int | None = None
+        self._recovered_source: str | None = None
+        # After recovery the original min_clients bar may be unreachable
+        # (some members died for good): training restarts once
+        # quorum_fraction of the restored unfinished membership is back.
+        self._resume_ready_needed: int | None = None
+        # Restored members that have not reconnected yet hold the round
+        # loop open for this long after training resumes: a recovered
+        # fleet whose fast members finish in seconds must not declare
+        # the federation over before slower members' watchdogs have even
+        # fired. Bounded — a member gone for good cannot stall forever.
+        self.reconnect_grace_s = float(reconnect_grace_s)
+        self._recovery_deadline: float | None = None
 
         # Clients whose compile-dominated first poll has been seen (and
         # excluded from the poll-latency/straggler stats).
@@ -471,6 +517,16 @@ class FederatedServer:
                 else {"policy": self.pacing.spec_id}
             ),
             "clients": self.federation.membership_snapshot(),
+            # Crash-survival plane (README "Crash recovery & sessions"):
+            # where (and from what) this process recovered, journal
+            # cadence, and the durable-session/idempotency counters.
+            "recovery": {
+                "recovered_from": self._recovered_from,
+                "source": self._recovered_source,
+                "journal_every": self.journal_every,
+                "session_restores": count("session_restores"),
+                "rpcs_deduplicated": count("rpcs_deduplicated"),
+            },
             "compression": {
                 "ratio_sent": gauge("compression_ratio_sent"),
                 "ratio_recv": gauge("compression_ratio_recv"),
@@ -535,12 +591,41 @@ class FederatedServer:
     def GetGlobalSetup(self, request: pb.JoinRequest, context) -> pb.GlobalSetup:
         """Blocks for vocabulary quorum, then returns the agreed vocabulary +
         replicated initial model/optimizer state
-        (``sendGlobalDicAndInitialNN``, ``server.py:212-331``)."""
+        (``sendGlobalDicAndInitialNN``, ``server.py:212-331``), plus a
+        freshly minted durable-session token (README "Crash recovery &
+        sessions")."""
         self.federation.wait_vocab_quorum()
         with self._setup_lock:
             if self._setup_reply is None:
                 self._setup_reply = self._build_setup_reply()
-        return self._setup_reply
+            base = self._setup_reply
+        return self._mint_session(int(request.client_id), base)
+
+    def _mint_session(
+        self, client_id: int, base: pb.GlobalSetup
+    ) -> pb.GlobalSetup:
+        """Per-client GlobalSetup: the shared consensus reply plus a fresh
+        session token. Passing through GetGlobalSetup is what defines a
+        client as a NEW process, so every piece of server-side state
+        describing the OLD process is discarded here — push-ack/codec
+        posture, reply-seq replay guard, poll warm-up, straggler and
+        contribution EWMAs. ReadyForTraining presenting a still-current
+        token is then, by construction, a live-process reconnect and
+        keeps all of it."""
+        if client_id <= 0:
+            return base
+        token = uuid.uuid4().hex
+        self.federation.set_session_token(client_id, token)
+        with self._push_lock:
+            self._push_acked.pop(client_id, None)
+        self._reply_seen.pop(client_id, None)
+        self._poll_warmed.discard(client_id)
+        self.straggler.forget(client_id)
+        self.contributions.forget(client_id)
+        reply = pb.GlobalSetup()
+        reply.CopyFrom(base)
+        reply.session_token = token
+        return reply
 
     def _build_setup_reply(self) -> pb.GlobalSetup:
         from gfedntm_tpu.data.vocab import union_vocabularies
@@ -616,29 +701,39 @@ class FederatedServer:
             )
         return self._ckpt
 
+    def _membership_state(self) -> list[dict[str, Any]]:
+        """JSON-able membership snapshot persisted with checkpoints and
+        the round journal — session tokens included, so a restarted
+        server can re-admit live-process reconnects."""
+        return [
+            {
+                "client_id": c.client_id,
+                "nr_samples": c.nr_samples,
+                "current_mb": c.current_mb,
+                "current_epoch": c.current_epoch,
+                "finished": bool(c.finished),
+                "status": c.status,
+                "session_token": c.session_token,
+            }
+            for c in self.federation.get_clients()
+        ]
+
+    def _state_extra(self) -> dict[str, Any]:
+        return {
+            "family": self.family,
+            "aggregator": self.aggregator.name,
+            "wire_codec": self.wire_codec.codec_id,
+        }
+
     def _save_round_checkpoint(self) -> None:
         """Persist round state (never lets a checkpoint failure kill
         training — the checkpoint is the recovery path, not the workload)."""
         try:
-            membership = [
-                {
-                    "client_id": c.client_id,
-                    "nr_samples": c.nr_samples,
-                    "current_mb": c.current_mb,
-                    "current_epoch": c.current_epoch,
-                    "finished": bool(c.finished),
-                    "status": c.status,
-                }
-                for c in self.federation.get_clients()
-            ]
             self._checkpointer().save_round(
-                self.global_iterations, self.last_average, membership,
+                self.global_iterations, self.last_average,
+                self._membership_state(),
                 vocab=list(self.global_vocab.tokens),
-                extra={
-                    "family": self.family,
-                    "aggregator": self.aggregator.name,
-                    "wire_codec": self.wire_codec.codec_id,
-                },
+                extra=self._state_extra(),
                 aggregator_state=self.aggregator.state_dict(),
             )
         except Exception:
@@ -650,13 +745,86 @@ class FederatedServer:
             self.metrics.registry.counter("checkpoints_saved").inc()
             self.metrics.log("checkpoint", round=self.global_iterations)
 
+    # ---- crash-recovery journal (README "Crash recovery & sessions") -------
+    def _journal(self):
+        if self._round_journal is None:
+            if self.save_dir is None:
+                raise ValueError("the round journal requires save_dir")
+            import os
+
+            from gfedntm_tpu.train.checkpoint import RoundJournal
+
+            self._round_journal = RoundJournal(
+                os.path.join(self.save_dir, "checkpoints")
+            )
+        return self._round_journal
+
+    def _journal_round(self, iteration: int) -> None:
+        """Journal one fully-pushed round (called by the engines after the
+        push completes). Like checkpointing, a journal failure is loud but
+        never kills training — it only widens the recovery replay."""
+        if (
+            self.journal_every <= 0 or self.save_dir is None
+            or self.last_average is None
+            or iteration % self.journal_every != 0
+        ):
+            return
+        try:
+            self._journal().record(
+                iteration, self.last_average, self._membership_state(),
+                vocab=list(self.global_vocab.tokens),
+                extra=self._state_extra(),
+                aggregator_state=self.aggregator.state_dict(),
+            )
+        except Exception:
+            self.logger.exception(
+                "round journal write at %d failed", iteration
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("journal_errors").inc()
+
+    def _mark_journal_finished(self) -> None:
+        """Stamp the journal after a normal shutdown so the next server
+        start's auto-recovery probe does not resurrect a finished run."""
+        if self.journal_every <= 0 or self.save_dir is None:
+            return
+        try:
+            self._journal().mark_finished()
+        except Exception:
+            self.logger.exception("marking the round journal finished failed")
+            if self.metrics is not None:
+                self.metrics.registry.counter("journal_errors").inc()
+
+    def _load_journal_state(self) -> "dict[str, Any] | None":
+        """The round journal's recovery state, or ``None`` when absent,
+        disabled, or marked finished. A corrupt journal is LOUD
+        (``checkpoint_invalid`` event) but degrades to the orbax
+        checkpoint rather than blocking recovery."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        if self.journal_every <= 0 or self.save_dir is None:
+            return None
+        try:
+            return self._journal().load()
+        except CheckpointIntegrityError as err:
+            self.logger.error(
+                "round journal unusable (%s); falling back to the latest "
+                "checkpoint", err,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("checkpoint_invalid").inc()
+                self.metrics.log("checkpoint_invalid", reason=str(err))
+            return None
+
     def restore_from_checkpoint(self) -> int:
-        """Rebuild vocabulary, template, ``last_average``, and the round
-        counter from the latest round checkpoint under ``save_dir``; the
-        restored average is applied onto the template so rejoining clients
-        replicate the TRAINED state, not a fresh init. Call before
-        :meth:`start`. Returns the restored round; raises
-        ``FileNotFoundError`` when there is nothing to resume and
+        """Rebuild vocabulary, template, ``last_average``, the round
+        counter, and the (not-yet-ready) membership from the newest of
+        the round journal and the latest orbax checkpoint under
+        ``save_dir``; the restored average is applied onto the template
+        so rejoining clients replicate the TRAINED state, not a fresh
+        init. Call before :meth:`start`. Returns the resume round (the
+        round the loop continues FROM); raises ``FileNotFoundError`` when
+        there is nothing to resume and
         :class:`~gfedntm_tpu.train.checkpoint.CheckpointIntegrityError`
         (after a ``checkpoint_invalid`` telemetry event) when what exists
         is corrupt — a broken ``--resume`` must say what is broken and how
@@ -664,30 +832,87 @@ class FederatedServer:
         from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
 
         ckpt = self._checkpointer()
+        jstate = self._load_journal_state()
         try:
             meta = ckpt.load_meta()
-            if meta is None or ckpt.latest_round() is None:
-                raise FileNotFoundError(
-                    f"no federation checkpoint under {ckpt.directory}"
-                )
-            self.global_vocab = Vocabulary(tuple(meta["vocab"]))
-            self.template = build_template_model(
-                self.family, len(self.global_vocab), self.model_kwargs
-            )
-            template = self._shared_template()
-            self._expected_keys = frozenset(template)
-            self._expected_shapes = {k: v.shape for k, v in template.items()}
-            self.update_gate.set_template(template)
-            round_idx, average = ckpt.restore_round(template)
+            ckpt_round = ckpt.latest_round() if meta is not None else None
         except CheckpointIntegrityError as err:
-            self.logger.error("cannot resume: %s", err)
-            if self.metrics is not None:
-                self.metrics.registry.counter("checkpoint_invalid").inc()
-                self.metrics.log("checkpoint_invalid", reason=str(err))
-            raise
+            if jstate is None:
+                self.logger.error("cannot resume: %s", err)
+                if self.metrics is not None:
+                    self.metrics.registry.counter("checkpoint_invalid").inc()
+                    self.metrics.log("checkpoint_invalid", reason=str(err))
+                raise
+            self.logger.error(
+                "checkpoint unusable (%s); recovering from the round "
+                "journal alone", err,
+            )
+            meta, ckpt_round = None, None
+        # The journal records the last fully-PUSHED round R (resume at
+        # R+1); the checkpoint sidecar records the resume round directly.
+        # Prefer whichever is further along — a fresh journal beats a
+        # stale periodic checkpoint, and a guardian-withheld journal gap
+        # falls back to the rollback-quality checkpoint.
+        use_journal = jstate is not None and (
+            ckpt_round is None
+            or int(jstate["round"]) + 1 >= int(ckpt_round)
+        )
+        if not use_journal and (meta is None or ckpt_round is None):
+            raise FileNotFoundError(
+                f"no federation checkpoint or round journal under "
+                f"{ckpt.directory}"
+            )
+        source = jstate if use_journal else meta
+        vocab = source.get("vocab")
+        if not vocab:
+            raise CheckpointIntegrityError(
+                "recovery state has no consensus vocabulary; delete "
+                f"{ckpt.directory} to start the federation fresh"
+            )
+        self.global_vocab = Vocabulary(tuple(vocab))
+        self.template = build_template_model(
+            self.family, len(self.global_vocab), self.model_kwargs
+        )
+        template = self._shared_template()
+        self._expected_keys = frozenset(template)
+        self._expected_shapes = {k: v.shape for k, v in template.items()}
+        self.update_gate.set_template(template)
+        if use_journal:
+            missing = [
+                k for k in jstate["average_keys"] if k not in template
+            ]
+            if missing:
+                raise ValueError(
+                    f"journal avg keys not in template (model config "
+                    f"changed since the journal?): {missing[:3]}"
+                )
+            round_idx = int(jstate["round"]) + 1
+            average = {
+                k: np.asarray(jstate["average"][k], dtype=v.dtype)
+                for k, v in template.items() if k in jstate["average"]
+            }
+            self._restore_journal_aggregator(jstate)
+        else:
+            try:
+                round_idx, average = ckpt.restore_round(template)
+            except CheckpointIntegrityError as err:
+                self.logger.error("cannot resume: %s", err)
+                if self.metrics is not None:
+                    self.metrics.registry.counter("checkpoint_invalid").inc()
+                    self.metrics.log("checkpoint_invalid", reason=str(err))
+                raise
+            self._restore_aggregator_state(ckpt, meta, round_idx)
         self.last_average = average
         self.global_iterations = int(round_idx)
-        self._restore_aggregator_state(ckpt, meta, round_idx)
+        self._restore_membership(source.get("membership") or ())
+        # Recovered-server wire posture: this process holds no codec
+        # session state and no push acks — the next push is
+        # self-contained and orders a fleet-wide session reset, and
+        # token reconnects of members that held live sessions get the
+        # per-client reset order (Ack code 3) at readmission.
+        self._session_reset_pending = not self.wire_codec.identity
+        self._recovered_from = int(round_idx)
+        self._recovered_source = "journal" if use_journal else "checkpoint"
 
         from gfedntm_tpu.federated.stepper import FederatedStepper
 
@@ -697,11 +922,101 @@ class FederatedServer:
         with self._setup_lock:
             self._setup_reply = self._setup_reply_from_template()
         self.logger.info(
-            "resumed federation from round %d (%d checkpointed members)",
-            round_idx, len(meta.get("membership", ())),
+            "resumed federation from round %d via the %s (%d restored "
+            "members)", round_idx,
+            "round journal" if use_journal else "checkpoint",
+            len(source.get("membership", ())),
         )
         if self.metrics is not None:
             self.metrics.log("resume", step=round_idx)
+        return round_idx
+
+    def _restore_journal_aggregator(self, jstate: dict) -> None:
+        """Reload journaled server-optimizer slots (same name-mismatch
+        stance as :meth:`_restore_aggregator_state`)."""
+        saved_name = jstate.get("aggregator")
+        arrays = jstate.get("aggregator_state") or {}
+        if not arrays:
+            return
+        if saved_name is not None and saved_name != self.aggregator.name:
+            self.logger.warning(
+                "journal was written by aggregator %r but this server "
+                "runs %r; server-optimizer state starts fresh",
+                saved_name, self.aggregator.name,
+            )
+            return
+        self.aggregator.load_state_dict(arrays)
+
+    def _restore_membership(self, membership) -> None:
+        """Repopulate the registry from a recovery snapshot: members keep
+        their identity, FedAvg weight, progress, and session tokens, but
+        none are training-ready until they reconnect. The training
+        restart bar becomes ``quorum_fraction`` of the restored
+        unfinished membership (capped by ``min_clients``) — a member that
+        died for good must not stall recovery forever."""
+        unfinished = 0
+        for m in membership:
+            try:
+                client_id = int(m["client_id"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            finished = bool(m.get("finished"))
+            self.federation.restore_member(
+                client_id,
+                nr_samples=float(m.get("nr_samples") or 0.0),
+                session_token=str(m.get("session_token") or ""),
+                finished=finished,
+                current_mb=int(m.get("current_mb") or 0),
+                current_epoch=int(m.get("current_epoch") or 0),
+                needs_codec_reset=not self.wire_codec.identity,
+            )
+            unfinished += not finished
+        if unfinished:
+            self._resume_ready_needed = max(
+                1, math.ceil(self.quorum_fraction * unfinished)
+            )
+
+    def maybe_autorecover(self) -> "int | None":
+        """Zero-flag crash recovery: when ``save_dir`` holds a round
+        journal (or checkpoint) from an interrupted run, restore it and
+        return the resume round; return ``None`` when there is nothing to
+        recover (fresh start) or the previous run finished cleanly.
+        Corrupt state still raises — silently discarding a recovery
+        record an operator may be counting on is worse than stopping."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        if self.save_dir is None or self.journal_every <= 0:
+            # No journal ⇒ no auto-recovery (the documented contract of
+            # --journal_every 0): without the journal's finished stamp a
+            # cleanly-completed run's checkpoints would be resurrected on
+            # every restart. Explicit --resume still restores them.
+            return None
+        try:
+            finished = bool(
+                (self._journal().load_meta() or {}).get("finished")
+            )
+        except CheckpointIntegrityError:
+            finished = False
+        if finished:
+            self.logger.info(
+                "previous federation under %s finished cleanly; "
+                "starting fresh", self.save_dir,
+            )
+            return None
+        try:
+            round_idx = self.restore_from_checkpoint()
+        except FileNotFoundError:
+            return None
+        self.logger.warning(
+            "auto-recovered an interrupted federation: resuming from "
+            "round %d (re-admitting session-token reconnects)", round_idx,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("server_recoveries").inc()
+            self.metrics.log(
+                "server_recovered", round=round_idx,
+                source=self._recovered_source or "checkpoint",
+            )
         return round_idx
 
     def _restore_aggregator_state(self, ckpt, meta: dict, round_idx) -> None:
@@ -770,15 +1085,55 @@ class FederatedServer:
                     f"{client_codec!r}"
                 ),
             )
+        # Durable sessions (README "Crash recovery & sessions"): a ready
+        # presenting a still-current session token is a live process
+        # reconnecting after a connection loss — its server-side state
+        # (straggler EWMA, push-ack/codec posture, poll warm-up,
+        # reply-seq guard) describes THIS process and survives. A
+        # token-less/mismatched ready, or the first ready of a
+        # just-minted session, is a fresh process and starts clean (the
+        # mint already discarded the old process's state).
+        kind = self.federation.classify_join(
+            request.client_id, request.session_token
+        )
         self.federation.connect_ready(request.client_id, request.address)
-        # A (re)joining client is a fresh process with no broadcast
-        # reference — it must not count as having acked the last push, or
-        # the next push could be delta-encoded against state it never held.
-        # Its straggler history is a different process's too.
-        with self._push_lock:
-            self._push_acked.pop(request.client_id, None)
-        self.straggler.forget(request.client_id)
-        self.contributions.forget(request.client_id)
+        ack_code, ack_detail = 0, "ready recorded"
+        if kind == "restore":
+            self.logger.info(
+                "client %d reconnected with its session token",
+                request.client_id,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("session_restores").inc()
+                self.metrics.log(
+                    "session_restored", client=request.client_id,
+                )
+            if (
+                self.federation.consume_codec_reset(request.client_id)
+                and not self.wire_codec.identity
+            ):
+                # This server recovered from a crash and holds none of
+                # the codec session state the reconnecting client still
+                # carries: order a client-side reset so the next
+                # exchanged bundles are self-contained on both ends.
+                ack_code = 3
+                ack_detail = (
+                    "session restored by a recovered server; reset "
+                    "wire-codec sessions"
+                )
+        elif kind == "new":
+            # A (re)joining client is a fresh process with no broadcast
+            # reference — it must not count as having acked the last
+            # push, or the next push could be delta-encoded against
+            # state it never held. Its straggler history is a different
+            # process's too. ("first" readies were already cleaned by
+            # the token mint in GetGlobalSetup.)
+            with self._push_lock:
+                self._push_acked.pop(request.client_id, None)
+            self._reply_seen.pop(request.client_id, None)
+            self._poll_warmed.discard(request.client_id)
+            self.straggler.forget(request.client_id)
+            self.contributions.forget(request.client_id)
         # Re-check after registering: if the training loop began shutting
         # down concurrently, this client may have missed the stop-broadcast
         # snapshot — tell it to finalize on its own. (If it made the
@@ -787,20 +1142,27 @@ class FederatedServer:
         if self._stopping.is_set() or self.training_done.is_set():
             return pb.Ack(code=1, detail="federation already finished")
         with self._train_lock:
+            # After crash recovery the original min_clients bar may be
+            # unreachable (members can be gone for good): the restored
+            # run restarts once quorum_fraction of the restored
+            # unfinished membership is back, whichever bar is lower.
+            needed = self.federation.min_clients
+            if self._resume_ready_needed is not None:
+                needed = min(needed, self._resume_ready_needed)
             if (
                 self._train_thread is None
                 and sum(
                     c.ready_for_training
                     for c in self.federation.get_clients()
                 )
-                >= self.federation.min_clients
+                >= needed
             ):
                 self._train_thread = threading.Thread(
                     target=self._run_training, name="federated-training",
                     daemon=True,
                 )
                 self._train_thread.start()
-        return pb.Ack(code=0, detail="ready recorded")
+        return pb.Ack(code=ack_code, detail=ack_detail)
 
     # ---- phase-2 training loop (server.py:408-553) -------------------------
     def _stub_for(self, stubs: dict, rec) -> rpc.ServiceStub | None:
@@ -816,10 +1178,14 @@ class FederatedServer:
             if entry is not None:
                 entry[1].close()
             channel = rpc.make_channel(rec.address)
+            # Training RPCs are idempotent (seq-numbered TrainStep, round-
+            # deduplicated ApplyAggregate), so the per-client stubs run
+            # the idempotent retry twin: a timed-out-but-delivered call
+            # is safely retried and answered from the client's cache.
             stub = rpc.ServiceStub(
                 channel, "gfedntm.FederationClient",
                 metrics=self.metrics, peer=f"client{rec.client_id}",
-                retry_policy=self.retry_policy,
+                retry_policy=self.client_retry_policy,
                 fault_injector=self.fault_injector,
             )
             entry = (rec.address, channel, stub)
@@ -930,6 +1296,26 @@ class FederatedServer:
                 ),
             )
 
+    def _awaiting_reconnect_grace(self) -> bool:
+        """True while the post-recovery grace window is open AND some
+        restored member has not reconnected — the round engines keep the
+        federation alive (wall-clock waits, no rounds burned) instead of
+        ending it without the stragglers."""
+        if self._recovery_deadline is None:
+            return False
+        if time.monotonic() >= self._recovery_deadline:
+            return False
+        return bool(self.federation.awaiting_reconnect())
+
+    def _next_step_seq(self) -> int:
+        """Fresh TrainStep delivery sequence number: monotonic within the
+        process (itertools.count — atomic under the GIL, the pool's poll
+        threads draw concurrently) and ACROSS restarts (wall-clock epoch
+        base), so a restarted server's polls can never collide with seqs
+        the dead process issued — a collision would make clients answer
+        fresh polls from their replay caches."""
+        return self._seq_epoch + next(self._seq_counter)
+
     def _current_global(self) -> dict[str, np.ndarray]:
         """The parameters every client stepped from this round: the last
         broadcast average, or the template init before round 0 — the
@@ -1038,6 +1424,26 @@ class FederatedServer:
         losses: dict[int, float] = {}
         candidates: list[tuple[int, float, dict[str, np.ndarray]]] = []
         for rec, reply in replies:
+            # Idempotent-RPC guard: a replayed StepReply (a delivery the
+            # client answered from its replay cache, or any duplicate of
+            # a seq this loop already consumed) must not enter the
+            # average twice — one step, one vote.
+            seq = int(reply.seq)
+            if seq and self._reply_seen.get(rec.client_id, 0) >= seq:
+                self.logger.warning(
+                    "round %d: dropping replayed StepReply from client "
+                    "%d (seq %d already seen)",
+                    iteration, rec.client_id, seq,
+                )
+                if m is not None:
+                    m.registry.counter("rpcs_deduplicated").inc()
+                    m.log(
+                        "rpc_deduplicated", client=rec.client_id,
+                        method="TrainStep", seq=seq, round=iteration,
+                    )
+                continue
+            if seq:
+                self._reply_seen[rec.client_id] = seq
             try:
                 if self.wire_codec.identity:
                     snap = codec.bundle_to_flatdict(reply.shared, metrics=m)
@@ -1415,6 +1821,13 @@ class FederatedServer:
         self._stopping.wait(self.round_backoff_s)
 
     def _run_training(self) -> None:
+        # Recovery grace clock starts when training actually resumes (the
+        # resume-ready quorum was just met) — not at restore time, which
+        # may long predate the first reconnect.
+        if self.federation.awaiting_reconnect():
+            self._recovery_deadline = (
+                time.monotonic() + self.reconnect_grace_s
+            )
         if self.metrics is not None:
             # One trace identity per training run: every round span inherits
             # it (via the logger) and every poll/push advertises it, so the
@@ -1479,6 +1892,7 @@ class FederatedServer:
             if not self._aborted.is_set():
                 self._stop_broadcast(stubs)
                 self._finalize()
+                self._mark_journal_finished()
             pool.shutdown(wait=False)
             for _addr, channel, _stub in stubs.values():
                 channel.close()

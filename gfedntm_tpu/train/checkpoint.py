@@ -32,6 +32,75 @@ class CheckpointIntegrityError(RuntimeError):
     ``KeyError`` traceback surface mid ``--resume``."""
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync the directory entry so a rename survives a power cut — on
+    filesystems without O_DIRECTORY fsync (or exotic mounts) this is
+    best-effort, the data-file fsync below is the hard guarantee."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-safe file replacement: write a temp sibling, fsync it, then
+    ``os.replace`` over the target and fsync the directory. A kill at ANY
+    point leaves either the old complete file or the new complete file —
+    never the truncated half-write PR 5's ``CheckpointIntegrityError``
+    detects after the fact. The temp name is pid-suffixed so two processes
+    racing the same target cannot corrupt each other's staging file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # The staging file must not accumulate on failure; the original
+        # target is untouched either way.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    atomic_write_bytes(path, json.dumps(obj).encode("utf-8"))
+
+
+def _load_sidecar_meta(path: str, what: str, hint: str) -> dict[str, Any] | None:
+    """Shared loader for the JSON halves of federation recovery state
+    (checkpoint sidecar + round journal): ``None`` when absent; corrupt
+    JSON or missing required keys (``round``, ``average_keys``) raise
+    :class:`CheckpointIntegrityError` carrying ``what``/``hint`` — one
+    integrity contract, two callers."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        try:
+            meta = json.load(fh)
+        except json.JSONDecodeError as err:
+            raise CheckpointIntegrityError(
+                f"{what} {path} is truncated or corrupt ({err}); {hint}"
+            ) from err
+    missing = [k for k in ("round", "average_keys") if k not in meta]
+    if missing:
+        raise CheckpointIntegrityError(
+            f"{what} {path} is missing required keys {missing}; {hint}"
+        )
+    return meta
+
+
 class CheckpointManager:
     """Thin orbax wrapper: numbered step checkpoints under one directory."""
 
@@ -132,12 +201,10 @@ class FederationCheckpointer:
         # between the two writes is detected at restore instead of pairing
         # round-R parameters with round-R' moments.
         if aggregator_state:
-            tmp = self.aggregator_path + ".tmp.npz"
-            with open(tmp, "wb") as fh:
-                np.savez(
-                    fh, __round__=np.int64(round_idx), **aggregator_state
-                )
-            os.replace(tmp, self.aggregator_path)
+            atomic_write_bytes(
+                self.aggregator_path,
+                _npz_bytes(aggregator_state, round_idx),
+            )
         elif os.path.exists(self.aggregator_path):
             # Stateless aggregator now: a stale state file from an earlier
             # configuration must not survive to poison a later resume.
@@ -150,10 +217,7 @@ class FederationCheckpointer:
         }
         if vocab is not None:
             meta["vocab"] = list(vocab)
-        tmp = self.meta_path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh)
-        os.replace(tmp, self.meta_path)
+        atomic_write_json(self.meta_path, meta)
 
     def load_aggregator_state(
         self,
@@ -180,27 +244,11 @@ class FederationCheckpointer:
         exists but cannot be parsed (truncated write, disk corruption) or
         lacks its required keys raises :class:`CheckpointIntegrityError`
         with a recovery hint rather than a raw traceback."""
-        if not os.path.exists(self.meta_path):
-            return None
-        with open(self.meta_path) as fh:
-            try:
-                meta = json.load(fh)
-            except json.JSONDecodeError as err:
-                raise CheckpointIntegrityError(
-                    f"federation sidecar {self.meta_path} is truncated or "
-                    f"corrupt ({err}); restore it from a backup, or delete "
-                    f"the checkpoint directory {self.directory} to start "
-                    "the federation fresh"
-                ) from err
-        missing = [k for k in ("round", "average_keys") if k not in meta]
-        if missing:
-            raise CheckpointIntegrityError(
-                f"federation sidecar {self.meta_path} is missing required "
-                f"keys {missing}; it was not written by this server "
-                f"version — delete the checkpoint directory "
-                f"{self.directory} to start fresh"
-            )
-        return meta
+        return _load_sidecar_meta(
+            self.meta_path, "federation sidecar",
+            f"restore it from a backup, or delete the checkpoint "
+            f"directory {self.directory} to start the federation fresh",
+        )
 
     def restore_round(
         self, template: dict[str, np.ndarray], step: int | None = None
@@ -258,3 +306,152 @@ class FederationCheckpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def _npz_bytes(arrays: dict[str, np.ndarray], round_idx: int) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, __round__=np.int64(round_idx), **arrays)
+    return buf.getvalue()
+
+
+#: npz key prefix separating journaled aggregator slots from average keys.
+_AGG_PREFIX = "__agg__/"
+
+
+class RoundJournal:
+    """Per-round crash-recovery journal for the federation server.
+
+    The orbax :class:`FederationCheckpointer` is the *rollback-quality*
+    store: guardian-gated, written every ``checkpoint_every`` rounds, the
+    target a divergence rollback restores. This journal is the *crash
+    recovery* store: one cheap atomic write per pushed round (a flat npz
+    of the broadcast average + aggregator slots, and a JSON record of the
+    round, key order, membership — session tokens included — and
+    consensus vocabulary), so a SIGKILLed server restarted with NO
+    operator flags resumes from the last fully-pushed round and replays
+    at most the one round that was in flight at the kill.
+
+    Both files go through :func:`atomic_write_bytes` (temp + fsync +
+    ``os.replace`` + directory fsync): a kill mid-write can never produce
+    a truncated journal. The npz is written first, the JSON second; the
+    JSON's ``round`` must match the npz's ``__round__`` tag, so a kill
+    between the two writes is detected at load (the stale JSON describes
+    the previous round whose npz was just overwritten) and reported as
+    :class:`CheckpointIntegrityError` — the caller degrades to the orbax
+    checkpoint.
+    """
+
+    STATE_NAME = "journal_state.npz"
+    META_NAME = "journal.json"
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.state_path = os.path.join(self.directory, self.STATE_NAME)
+        self.meta_path = os.path.join(self.directory, self.META_NAME)
+
+    def record(
+        self,
+        round_idx: int,
+        average: dict[str, np.ndarray],
+        membership: list[dict[str, Any]],
+        vocab: list[str] | None = None,
+        extra: dict[str, Any] | None = None,
+        aggregator_state: dict[str, np.ndarray] | None = None,
+    ) -> None:
+        """Journal one fully-pushed round (arrays first, meta second)."""
+        keys = sorted(average)
+        arrays = {k: np.asarray(average[k]) for k in keys}
+        for name, arr in (aggregator_state or {}).items():
+            arrays[_AGG_PREFIX + name] = np.asarray(arr)
+        atomic_write_bytes(self.state_path, _npz_bytes(arrays, round_idx))
+        meta = {
+            "round": int(round_idx),
+            "average_keys": keys,
+            "membership": membership,
+            **(extra or {}),
+        }
+        if vocab is not None:
+            meta["vocab"] = list(vocab)
+        atomic_write_json(self.meta_path, meta)
+
+    def mark_finished(self) -> None:
+        """Stamp the journal after a normal stop broadcast: a finished
+        federation must not be resurrected by the next server start's
+        auto-recovery probe."""
+        meta = None
+        try:
+            meta = self.load_meta()
+        except CheckpointIntegrityError:
+            meta = None
+        if meta is None:
+            meta = {"round": -1, "average_keys": [], "membership": []}
+        meta["finished"] = True
+        atomic_write_json(self.meta_path, meta)
+
+    def load_meta(self) -> dict[str, Any] | None:
+        """The journal's JSON record, or ``None`` when absent; corrupt or
+        key-incomplete JSON raises :class:`CheckpointIntegrityError` with
+        a recovery hint (same contract as the checkpoint sidecar)."""
+        return _load_sidecar_meta(
+            self.meta_path, "round journal",
+            "delete it to fall back to the latest orbax checkpoint",
+        )
+
+    def load(self) -> "dict[str, Any] | None":
+        """Load the journaled round: a dict with ``round``, ``average``,
+        ``aggregator_state``, ``membership``, ``vocab``, and every extra
+        key the writer recorded — or ``None`` when no journal exists (or
+        it is marked finished). Integrity failures (corrupt JSON/npz, or
+        a round tag disagreement from a kill between the two writes)
+        raise :class:`CheckpointIntegrityError`."""
+        meta = self.load_meta()
+        if meta is None or meta.get("finished"):
+            return None
+        if not os.path.exists(self.state_path):
+            raise CheckpointIntegrityError(
+                f"round journal {self.meta_path} describes round "
+                f"{meta['round']} but {self.state_path} is missing; "
+                "delete the journal to fall back to the latest checkpoint"
+            )
+        try:
+            with np.load(self.state_path) as data:
+                state_round = int(data["__round__"])
+                arrays = {
+                    k: np.asarray(data[k])
+                    for k in data.files if k != "__round__"
+                }
+        except (OSError, ValueError, KeyError, EOFError) as err:
+            raise CheckpointIntegrityError(
+                f"round journal state {self.state_path} is corrupt "
+                f"({err}); delete the journal to fall back to the latest "
+                "checkpoint"
+            ) from err
+        if state_round != int(meta["round"]):
+            raise CheckpointIntegrityError(
+                f"round journal halves disagree under {self.directory}: "
+                f"meta describes round {meta['round']} but the state file "
+                f"is round {state_round} (kill between the two writes); "
+                "delete the journal to fall back to the latest checkpoint"
+            )
+        average: dict[str, np.ndarray] = {}
+        agg_state: dict[str, np.ndarray] = {}
+        for key, arr in arrays.items():
+            if key.startswith(_AGG_PREFIX):
+                agg_state[key[len(_AGG_PREFIX):]] = arr
+            else:
+                average[key] = arr
+        missing = [k for k in meta["average_keys"] if k not in average]
+        if missing:
+            raise CheckpointIntegrityError(
+                f"round journal state {self.state_path} lacks average "
+                f"keys {missing[:3]} its meta declares; delete the "
+                "journal to fall back to the latest checkpoint"
+            )
+        out = dict(meta)
+        out["round"] = state_round
+        out["average"] = average
+        out["aggregator_state"] = agg_state
+        return out

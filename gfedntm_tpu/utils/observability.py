@@ -89,6 +89,13 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "quorum_skip": frozenset({"round", "got", "needed"}),
     "checkpoint": frozenset({"round"}),
     "watchdog_fired": frozenset({"client", "idle_s"}),
+    # crash-survival plane (durable sessions / idempotent RPCs / server
+    # auto-recovery / partition chaos; README "Crash recovery & sessions")
+    "client_reconnected": frozenset({"client", "attempts"}),
+    "session_restored": frozenset({"client"}),
+    "rpc_deduplicated": frozenset({"client", "method"}),
+    "server_recovered": frozenset({"round", "source"}),
+    "partition_injected": frozenset({"peer", "window_s"}),
     # data-plane defense (update admission gate / divergence guardian;
     # see README "Robust aggregation & divergence recovery")
     "update_rejected": frozenset({"client", "round", "reason"}),

@@ -51,7 +51,19 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_trace_plane.py tests/test_ops_endpoint.py \
     tests/test_data_plane.py tests/test_device_agg.py \
     tests/test_metrics.py tests/test_quality_plane.py \
-    tests/test_analysis.py tests/test_pacing.py >/dev/null || exit 1
+    tests/test_analysis.py tests/test_pacing.py \
+    tests/test_survival.py tests/chaos/test_process_chaos.py \
+    >/dev/null || exit 1
+
+if [ "${CHAOS:-0}" = "1" ]; then
+    # Process-level chaos suite (README "Crash recovery & sessions"):
+    # spawns the real CLI as subprocesses and SIGKILLs the server
+    # mid-round / clients mid-step. Slow-marked, excluded from tier-1;
+    # opt in with CHAOS=1.
+    echo "== process-level chaos suite (CHAOS=1) =="
+    env JAX_PLATFORMS=cpu python -m pytest tests/chaos -q -m slow \
+        -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+fi
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,198 @@
+"""Process-level chaos harness: spawn the REAL CLI as subprocesses.
+
+Everything in `tests/test_survival.py` kills servers in-process (fast,
+deterministic, tier-1); this harness is the last mile of honesty — the
+server and every client are separate `python -m gfedntm_tpu.cli`
+processes, and the kills are actual `SIGKILL`s, so recovery is proven
+against real process death: no shared interpreter, no shared jax
+runtime, no in-memory state accidentally surviving the "crash".
+
+Used by `tests/chaos/test_process_chaos.py` (slow-marked; run via
+`CHAOS=1 scripts/check.sh`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+)
+
+
+def make_archive(path: str, n_nodes: int = 4, seed: int = 7) -> None:
+    """A small synthetic multi-node corpus archive for the CLI's
+    ``--data_type synthetic`` path."""
+    from gfedntm_tpu.data.synthetic import (
+        generate_synthetic_corpus,
+        save_reference_npz,
+    )
+
+    corpus = generate_synthetic_corpus(
+        vocab_size=60, n_topics=4, n_docs=40, nwords=(20, 40),
+        n_nodes=n_nodes, frozen_topics=2, seed=seed,
+    )
+    save_reference_npz(corpus, path)
+
+
+def _spawn(argv: list[str], log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    log = open(log_path, "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "gfedntm_tpu.cli", *argv],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+
+def spawn_server(save_dir: str, port: int, archive: str,
+                 extra: list[str] = (), n_clients: int = 4,
+                 max_iters: int = 400,
+                 num_epochs: int = 4) -> subprocess.Popen:
+    """The federation server role (``--id 0``), zero recovery flags — a
+    respawn with the SAME argv must auto-recover on its own.
+    ``num_epochs`` paces the run length: kills are timed against the
+    round journal, so the federation must comfortably outlive the
+    orchestration latency (subprocess spawn + jax import ~tens of
+    seconds) or the run ends before the chaos lands."""
+    argv = [
+        "--id", "0", "--source", archive,
+        "--min_clients_federation", str(n_clients),
+        "--max_iters", str(max_iters),
+        "--listen_port", str(port), "--save_dir", save_dir,
+        "--n_components", "3", "--num_epochs", str(num_epochs),
+        "--batch_size", "8",
+        "--seed", "0", "--checkpoint_every", "0", "--verbose",
+        *extra,
+    ]
+    return _spawn(argv, os.path.join(save_dir, "server_stdout.log"))
+
+
+def spawn_client(client_id: int, save_dir: str, port: int, archive: str,
+                 extra: list[str] = (),
+                 num_epochs: int = 4) -> subprocess.Popen:
+    argv = [
+        "--id", str(client_id), "--source", archive,
+        "--server_address", f"localhost:{port}",
+        "--save_dir", save_dir,
+        "--n_components", "3", "--num_epochs", str(num_epochs),
+        "--batch_size", "8",
+        "--seed", "0",
+        # Fast dead-server detection + a patient reconnect window: the
+        # respawned server needs time to import + recover.
+        "--liveness_timeout", "30", "--reconnect_window", "300",
+        "--verbose",
+        *extra,
+    ]
+    os.makedirs(save_dir, exist_ok=True)
+    return _spawn(
+        argv, os.path.join(save_dir, f"client{client_id}_stdout.log")
+    )
+
+
+def wait_for_port(port: int, timeout: float = 180.0) -> None:
+    """Block until the server process actually listens: the CLI spends
+    tens of seconds importing jax/orbax before binding, and a client
+    spawned too early would exhaust its join retries against a
+    connection-refused socket (operators start the server first for the
+    same reason)."""
+    import socket
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.5)
+    raise AssertionError(
+        f"server never listened on port {port} within {timeout:.0f}s"
+    )
+
+
+def sigkill(proc: subprocess.Popen) -> None:
+    """The real thing — no cleanup handlers run, no sockets linger by
+    agreement, nothing graceful."""
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+
+def wait_for(predicate, timeout: float, what: str, poll_s: float = 0.5):
+    """Poll ``predicate`` until truthy; raise with ``what`` on timeout."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+def journal_round(save_dir: str):
+    """The journal's last fully-pushed round, or None before the first
+    write (ignores in-flight torn reads — this polls a live server)."""
+    path = os.path.join(save_dir, "checkpoints", "journal.json")
+    try:
+        with open(path) as fh:
+            return json.load(fh).get("round")
+    except (OSError, ValueError):
+        return None
+
+
+def read_events(metrics_path: str, event: str) -> list[dict]:
+    if not os.path.exists(metrics_path):
+        return []
+    out = []
+    with open(metrics_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of a killed process
+            if rec.get("event") == event:
+                out.append(rec)
+    return out
+
+
+def final_counter(metrics_path: str, name: str) -> float:
+    """The counter's value in the LAST metrics snapshot (0 if absent)."""
+    value = 0.0
+    with open(metrics_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "metrics_snapshot":
+                metric = rec["metrics"].get(name)
+                if metric is not None:
+                    value = float(metric["value"])
+    return value
+
+
+def load_server_betas(save_dir: str) -> np.ndarray:
+    with np.load(os.path.join(save_dir, "server_model.npz")) as data:
+        return np.asarray(data["betas"])
+
+
+def drain(procs: list[subprocess.Popen], timeout: float) -> list[int]:
+    """Wait for every process to exit; SIGKILL stragglers (test failure
+    surfaces via the returned codes)."""
+    deadline = time.time() + timeout
+    codes = []
+    for proc in procs:
+        remaining = max(1.0, deadline - time.time())
+        try:
+            codes.append(proc.wait(timeout=remaining))
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            codes.append(-9)
+    return codes

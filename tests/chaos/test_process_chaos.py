@@ -1,0 +1,175 @@
+"""Process-level chaos: the acceptance scenarios, with REAL processes
+and REAL SIGKILLs (slow-marked; `CHAOS=1 scripts/check.sh`).
+
+1. SIGKILL the server mid-round → a respawn with the IDENTICAL argv
+   (zero operator flags) auto-resumes from the round journal, ≥3 of 4
+   clients reconnect via their session tokens, and the run reaches
+   finite betas matching a no-crash baseline within tolerance.
+2. SIGKILL one client mid-step → the round completes via quorum, the
+   replacement process rejoins cleanly (fresh session, push-ack/codec
+   state deduplicated server-side), and `codec_ref_miss == 0` under the
+   delta wire codec.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tests.chaos import harness
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_baseline(tmp_path, archive, n_clients=4):
+    """A no-crash federation over the same archive/seeds: the betas the
+    crashed-and-recovered run must stay close to."""
+    port = _free_port()
+    save_dir = str(tmp_path / "baseline")
+    os.makedirs(save_dir, exist_ok=True)
+    server = harness.spawn_server(save_dir, port, archive,
+                                  n_clients=n_clients)
+    harness.wait_for_port(port)
+    clients = [
+        harness.spawn_client(i + 1, str(tmp_path / f"base_c{i + 1}"),
+                             port, archive)
+        for i in range(n_clients)
+    ]
+    codes = harness.drain([server, *clients], timeout=600)
+    assert codes == [0] * (n_clients + 1), f"baseline exit codes {codes}"
+    return harness.load_server_betas(save_dir)
+
+
+def test_server_sigkill_zero_flag_autorecovery(tmp_path):
+    archive = str(tmp_path / "corpus.npz")
+    harness.make_archive(archive, n_nodes=4)
+    baseline = _run_baseline(tmp_path, archive)
+
+    port = _free_port()
+    save_dir = str(tmp_path / "crash")
+    os.makedirs(save_dir, exist_ok=True)
+    server1 = harness.spawn_server(save_dir, port, archive)
+    harness.wait_for_port(port)
+    clients = [
+        harness.spawn_client(i + 1, str(tmp_path / f"crash_c{i + 1}"),
+                             port, archive)
+        for i in range(4)
+    ]
+    try:
+        # mid-round: past the first journaled rounds, well before the end
+        harness.wait_for(
+            lambda: (harness.journal_round(save_dir) or -1) >= 2,
+            timeout=420, what="round 2 in the journal",
+        )
+        harness.sigkill(server1)
+        time.sleep(2.0)
+
+        # the replacement: IDENTICAL argv — recovery must be zero-flag
+        server2 = harness.spawn_server(save_dir, port, archive)
+        codes = harness.drain([server2, *clients], timeout=600)
+    finally:
+        harness.drain([server1, *clients], timeout=10)
+    assert codes[0] == 0, "recovered server did not exit cleanly"
+    assert codes[1:].count(0) == 4, f"client exit codes {codes[1:]}"
+
+    metrics = os.path.join(save_dir, "metrics.jsonl")
+    recovered = harness.read_events(metrics, "server_recovered")
+    assert recovered and recovered[-1]["source"] == "journal"
+    assert recovered[-1]["round"] >= 2
+    restores = {
+        e["client"] for e in harness.read_events(metrics, "session_restored")
+    }
+    assert len(restores) >= 3, f"only {sorted(restores)} reconnected"
+    reconnects = {
+        e["client"]
+        for i in range(4)
+        for e in harness.read_events(
+            os.path.join(str(tmp_path / f"crash_c{i + 1}"),
+                         f"client{i + 1}", "metrics.jsonl"),
+            "client_reconnected",
+        )
+    }
+    assert len(reconnects) >= 3
+
+    betas = harness.load_server_betas(save_dir)
+    assert np.isfinite(betas).all()
+    assert betas.shape == baseline.shape
+    # within tolerance of the no-crash baseline: topic-set similarity
+    # (Bhattacharyya match per topic, max = n_topics) — robust to the
+    # replayed round's extra local steps, sensitive to a wrong restore
+    from gfedntm_tpu.eval.metrics import topic_similarity_score
+
+    tss = topic_similarity_score(betas, baseline)
+    assert tss >= 0.75 * baseline.shape[0], (
+        f"recovered betas diverged from baseline (tss={tss:.2f} of "
+        f"{baseline.shape[0]})"
+    )
+
+
+def test_client_sigkill_quorum_completes_and_dedup(tmp_path):
+    archive = str(tmp_path / "corpus.npz")
+    harness.make_archive(archive, n_nodes=3)
+    port = _free_port()
+    save_dir = str(tmp_path / "server")
+    os.makedirs(save_dir, exist_ok=True)
+    extra = ["--wire_codec", "delta", "--quorum_fraction", "0.5"]
+    # A longer run than the server-kill scenario: the replacement client
+    # pays a fresh ~30 s interpreter+jax start-up and must still land
+    # INSIDE the running federation to prove the mid-run rejoin.
+    epochs = 24
+    server = harness.spawn_server(save_dir, port, archive, extra=extra,
+                                  n_clients=3, num_epochs=epochs)
+    harness.wait_for_port(port)
+    clients = [
+        harness.spawn_client(i + 1, str(tmp_path / f"c{i + 1}"), port,
+                             archive, extra=["--wire_codec", "delta"],
+                             num_epochs=epochs)
+        for i in range(3)
+    ]
+    victim_dir = str(tmp_path / "c3_respawn")
+    try:
+        harness.wait_for(
+            lambda: (harness.journal_round(save_dir) or -1) >= 2,
+            timeout=420, what="round 2 in the journal",
+        )
+        harness.sigkill(clients[2])  # mid-step, no goodbye
+        # rounds keep completing via quorum while the seat is empty
+        seen = harness.journal_round(save_dir)
+        harness.wait_for(
+            lambda: (harness.journal_round(save_dir) or -1) >= seen + 2,
+            timeout=420, what="two quorum rounds past the client kill",
+        )
+        # the replacement process: same identity, fresh everything
+        replacement = harness.spawn_client(3, victim_dir, port, archive,
+                                           extra=["--wire_codec", "delta"],
+                                           num_epochs=epochs)
+        codes = harness.drain(
+            [server, clients[0], clients[1], replacement], timeout=600
+        )
+    finally:
+        harness.drain([server, *clients], timeout=10)
+    assert codes[0] == 0, "server did not exit cleanly"
+    assert codes[1] == 0 and codes[2] == 0, f"survivor codes {codes[1:3]}"
+    assert codes[3] == 0, "replacement client did not exit cleanly"
+
+    metrics = os.path.join(save_dir, "metrics.jsonl")
+    # the acceptance invariants: nothing double-counted, and the delta
+    # codec's reference discipline survived the churn end to end
+    assert harness.final_counter(metrics, "codec_ref_miss") == 0
+    assert harness.final_counter(metrics, "rpcs_deduplicated") == 0
+    # the dead process's seat was handed over: the replacement joined as
+    # a FRESH session (mint via GetGlobalSetup), not a token restore
+    assert harness.read_events(metrics, "session_restored") == []
+    betas = harness.load_server_betas(save_dir)
+    assert np.isfinite(betas).all()

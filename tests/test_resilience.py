@@ -807,23 +807,24 @@ def test_all_suspect_backoff_repolls_early_without_burning_rounds(tmp_path):
 
 @pytest.mark.chaos
 def test_server_crash_checkpoint_resume(tmp_path):
-    """Acceptance scenario: a hard-killed server's round state survives via
-    the periodic checkpoint; abandoned clients self-finalize on their
-    liveness watchdogs; a fresh server process resumes from the
-    checkpointed round (NOT round 0) and rejoining clients train to
-    completion."""
+    """Acceptance scenario (legacy recovery path — journal and session
+    reconnect disabled, see tests/test_survival.py for the survivable
+    flow): a hard-killed server's round state survives via the periodic
+    checkpoint; abandoned clients self-finalize on their liveness
+    watchdogs; a fresh server process resumes from the checkpointed
+    round (NOT round 0) and rejoining clients train to completion."""
     metrics1 = MetricsLogger(str(tmp_path / "run1.jsonl"), validate=True)
     server1 = FederatedServer(
         min_clients=2, family="avitm", model_kwargs=MODEL_KWARGS,
         max_iters=60, save_dir=str(tmp_path / "server"), metrics=metrics1,
-        checkpoint_every=2,
+        checkpoint_every=2, journal_every=0,
     )
     addr1 = server1.start("[::]:0")
     gen1 = [
         Client(client_id=c + 1, corpus=corpus, server_address=addr1,
                max_features=45, save_dir=str(tmp_path / f"g1c{c + 1}"),
                metrics=metrics1, liveness_timeout=120.0,
-               watchdog_poll_s=0.1)
+               watchdog_poll_s=0.1, reconnect_window=0.0)
         for c, corpus in enumerate(_corpora(2, docs=40, seed=2))
     ]
     threads = [threading.Thread(target=c.run, daemon=True) for c in gen1]
@@ -854,7 +855,7 @@ def test_server_crash_checkpoint_resume(tmp_path):
     server2 = FederatedServer(
         min_clients=2, family="avitm", model_kwargs=MODEL_KWARGS,
         max_iters=60, save_dir=str(tmp_path / "server"), metrics=metrics2,
-        checkpoint_every=2,
+        checkpoint_every=2, journal_every=0,
     )
     resumed_round = server2.restore_from_checkpoint()
     assert resumed_round >= 2 and resumed_round % 2 == 0

@@ -1,0 +1,848 @@
+"""Crash-survival suite (tier-1): durable client sessions, idempotent
+RPCs, the per-round recovery journal, server auto-recovery, the adaptive
+liveness window, and the partition fault persona.
+
+The `chaos` tests here run real gRPC federations in-process and kill the
+server with `abort()` (the SIGKILL-equivalent: no stop broadcast, no
+finalize) — the true process-level kills live in `tests/chaos/`
+(slow-marked, run via `CHAOS=1 scripts/check.sh`).
+"""
+
+import os
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation import codec
+from gfedntm_tpu.federation.client import Client, FederatedClientServicer
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import ACTIVE, ClientRecord, Federation
+from gfedntm_tpu.federation.resilience import (
+    FaultInjector,
+    FaultSpec,
+    InjectedRpcError,
+    RetryPolicy,
+)
+from gfedntm_tpu.federation.server import FederatedServer, build_template_model
+from gfedntm_tpu.train.checkpoint import (
+    CheckpointIntegrityError,
+    RoundJournal,
+    atomic_write_bytes,
+    atomic_write_json,
+)
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _corpora(n_clients, docs, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(45)]
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for _ in range(n_clients)
+    ]
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---- atomic writes (satellite: kill mid-write can't truncate) ---------------
+
+class TestAtomicWrites:
+    def test_roundtrip_and_no_staging_residue(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        atomic_write_json(path, {"round": 3})
+        atomic_write_json(path, {"round": 4})
+        import json
+
+        assert json.load(open(path)) == {"round": 4}
+        assert os.listdir(tmp_path) == ["state.json"]  # no .tmp leftovers
+
+    def test_failed_replace_leaves_target_intact(self, tmp_path, monkeypatch):
+        """A kill between the staging write and the rename (simulated by a
+        failing os.replace) must leave the previous COMPLETE file — the
+        truncated-JSON state PR 5's CheckpointIntegrityError detects can
+        no longer be produced by the writer."""
+        path = str(tmp_path / "meta.json")
+        atomic_write_json(path, {"round": 1, "ok": True})
+
+        def boom(src, dst):
+            raise OSError("killed mid-rename")
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            atomic_write_bytes(path, b'{"round": 2, "trunc')
+        monkeypatch.undo()
+        import json
+
+        assert json.load(open(path)) == {"round": 1, "ok": True}
+        assert os.listdir(tmp_path) == ["meta.json"]  # staging cleaned up
+
+    def test_checkpoint_sidecar_partial_write_regression(self, tmp_path):
+        """A sidecar produced by the atomic writer is all-or-nothing; a
+        hand-truncated one (the pre-atomic failure mode) still surfaces
+        loudly as CheckpointIntegrityError at load."""
+        from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+        ckpt = FederationCheckpointer(str(tmp_path / "ck"))
+        avg = {"w": np.ones((2, 2), np.float32)}
+        ckpt.save_round(2, avg, [{"client_id": 1}], vocab=["a"])
+        assert ckpt.load_meta()["round"] == 2
+        with open(ckpt.meta_path, "w") as fh:
+            fh.write('{"round": 2, "average_')  # simulated partial write
+        with pytest.raises(CheckpointIntegrityError):
+            ckpt.load_meta()
+
+
+# ---- round journal ----------------------------------------------------------
+
+class TestRoundJournal:
+    AVG = {"p/beta": np.arange(6, dtype=np.float32).reshape(2, 3)}
+
+    def test_record_load_roundtrip_with_aggregator_state(self, tmp_path):
+        j = RoundJournal(str(tmp_path))
+        assert j.load() is None
+        j.record(
+            5, self.AVG, [{"client_id": 1, "session_token": "tok"}],
+            vocab=["a", "b"], extra={"family": "avitm", "aggregator": "x"},
+            aggregator_state={"m": np.full(3, 2.0)},
+        )
+        state = j.load()
+        assert state["round"] == 5 and state["family"] == "avitm"
+        np.testing.assert_array_equal(state["average"]["p/beta"], self.AVG["p/beta"])
+        np.testing.assert_array_equal(state["aggregator_state"]["m"], np.full(3, 2.0))
+        assert state["membership"][0]["session_token"] == "tok"
+
+    def test_corrupt_meta_is_loud(self, tmp_path):
+        j = RoundJournal(str(tmp_path))
+        j.record(1, self.AVG, [])
+        with open(j.meta_path, "w") as fh:
+            fh.write('{"round": 1, "aver')
+        with pytest.raises(CheckpointIntegrityError):
+            j.load()
+
+    def test_halves_disagreeing_detected(self, tmp_path):
+        """A kill between the npz and JSON writes leaves the meta one
+        round behind the state file — detected, never mispaired."""
+        j = RoundJournal(str(tmp_path))
+        j.record(3, self.AVG, [])
+        atomic_write_json(
+            j.meta_path,
+            {"round": 2, "average_keys": sorted(self.AVG), "membership": []},
+        )
+        with pytest.raises(CheckpointIntegrityError):
+            j.load()
+
+    def test_missing_state_file_is_loud(self, tmp_path):
+        j = RoundJournal(str(tmp_path))
+        j.record(1, self.AVG, [])
+        os.unlink(j.state_path)
+        with pytest.raises(CheckpointIntegrityError):
+            j.load()
+
+    def test_finished_marker_suppresses_load(self, tmp_path):
+        j = RoundJournal(str(tmp_path))
+        j.record(7, self.AVG, [])
+        j.mark_finished()
+        assert j.load() is None
+        assert j.load_meta()["finished"] is True
+
+
+# ---- session registry -------------------------------------------------------
+
+class TestSessionRegistry:
+    def test_join_classification_lifecycle(self):
+        fed = Federation(min_clients=1)
+        assert fed.classify_join(1, "") == "new"
+        assert fed.classify_join(1, "tok") == "new"  # unknown client
+        fed.set_session_token(1, "tok")
+        assert fed.classify_join(1, "other") == "new"  # mismatch
+        assert fed.classify_join(1, "tok") == "first"  # initial ready
+        assert fed.classify_join(1, "tok") == "restore"  # reconnect
+        assert fed.classify_join(1, "tok") == "restore"
+        # re-mint (fresh process through GetGlobalSetup) resets the cycle
+        fed.set_session_token(1, "tok2")
+        assert fed.classify_join(1, "tok") == "new"
+        assert fed.classify_join(1, "tok2") == "first"
+
+    def test_codec_reset_is_consumed_once(self):
+        fed = Federation(min_clients=1)
+        fed.restore_member(1, session_token="t", needs_codec_reset=True)
+        assert fed.consume_codec_reset(1) is True
+        assert fed.consume_codec_reset(1) is False
+        # minting clears any pending reset: a fresh process has no
+        # session state to reset
+        fed.restore_member(2, session_token="u", needs_codec_reset=True)
+        fed.set_session_token(2, "u2")
+        assert fed.consume_codec_reset(2) is False
+
+    def test_restore_member_not_ready_until_reconnect(self):
+        fed = Federation(min_clients=2)
+        rec = fed.restore_member(
+            1, nr_samples=40.0, session_token="t", current_mb=9,
+            current_epoch=1,
+        )
+        assert not rec.ready_for_training and rec.status == ACTIVE
+        assert fed.active_clients() == []
+        fed.connect_ready(1, "localhost:1234")
+        assert [c.client_id for c in fed.active_clients()] == [1]
+        assert fed.get_clients()[0].nr_samples == 40.0
+
+    def test_restored_finisher_stays_finished(self):
+        fed = Federation(min_clients=1)
+        rec = fed.restore_member(3, finished=True, session_token="t")
+        assert rec.finished and fed.active_clients() == []
+
+
+# ---- server session handling (no network) -----------------------------------
+
+def _server(**kw):
+    base = dict(min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS)
+    base.update(kw)
+    return FederatedServer(**base)
+
+
+class TestServerSessions:
+    def test_mint_discards_old_process_state(self):
+        server = _server()
+        server.federation.connect_vocab(1, ("a",), 10.0)
+        server._push_acked[1] = 4
+        server._reply_seen[1] = 99
+        server._poll_warmed.add(1)
+        reply = server._mint_session(1, pb.GlobalSetup(codec_id="none"))
+        assert reply.session_token
+        assert 1 not in server._push_acked
+        assert 1 not in server._reply_seen
+        assert 1 not in server._poll_warmed
+        # distinct tokens per mint, registry holds the latest
+        again = server._mint_session(1, pb.GlobalSetup())
+        assert again.session_token != reply.session_token
+        assert server.federation.get_clients()[0].session_token == (
+            again.session_token
+        )
+
+    def test_ready_with_token_restores_posture(self):
+        m = MetricsLogger(validate=True)
+        server = _server(min_clients=2, metrics=m)
+        setup = server._mint_session(1, pb.GlobalSetup())
+        token = setup.session_token
+        # first ready of the fresh session: no restore accounting
+        server.ReadyForTraining(
+            pb.JoinRequest(client_id=1, address="localhost:1",
+                           session_token=token), None,
+        )
+        assert m.registry.counter("session_restores").value == 0
+        # a poll delivered a push meanwhile; then the connection dies and
+        # the same live process reconnects: the ack survives
+        server._push_acked[1] = 7
+        server._poll_warmed.add(1)
+        ack = server.ReadyForTraining(
+            pb.JoinRequest(client_id=1, address="localhost:1",
+                           session_token=token), None,
+        )
+        assert ack.code == 0
+        assert server._push_acked.get(1) == 7
+        assert 1 in server._poll_warmed
+        assert m.registry.counter("session_restores").value == 1
+        assert m.events("session_restored")[0]["client"] == 1
+
+    def test_ready_without_token_clears_posture(self):
+        server = _server(min_clients=2)
+        server._mint_session(1, pb.GlobalSetup())
+        server._push_acked[1] = 7
+        server._poll_warmed.add(1)
+        server._reply_seen[1] = 12
+        server.ReadyForTraining(
+            pb.JoinRequest(client_id=1, address="localhost:2"), None,
+        )
+        assert 1 not in server._push_acked
+        assert 1 not in server._poll_warmed
+        assert 1 not in server._reply_seen
+
+    def test_recovered_server_orders_codec_reset_once(self):
+        server = _server(min_clients=2, wire_codec="delta")
+        server.federation.restore_member(
+            1, session_token="tok", needs_codec_reset=True,
+        )
+        ack = server.ReadyForTraining(
+            pb.JoinRequest(client_id=1, address="localhost:1",
+                           codec_id="delta", session_token="tok"), None,
+        )
+        assert ack.code == 3  # reset your codec sessions
+        ack2 = server.ReadyForTraining(
+            pb.JoinRequest(client_id=1, address="localhost:1",
+                           codec_id="delta", session_token="tok"), None,
+        )
+        assert ack2.code == 0  # consumed: ordered exactly once
+
+    def test_step_seqs_are_monotonic(self):
+        server = _server()
+        seqs = [server._next_step_seq() for _ in range(100)]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 100
+
+    def test_journal_every_zero_disables_autorecovery(self, tmp_path):
+        """--journal_every 0 disables the journal AND auto-recovery (the
+        documented contract): without the journal's finished stamp, a
+        cleanly-completed run's checkpoints would otherwise be
+        resurrected on every restart. Explicit --resume still restores
+        them."""
+        from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+        ckpt_dir = str(tmp_path / "checkpoints")
+        template = build_template_model("avitm", 30, MODEL_KWARGS)
+        server0 = _server(save_dir=str(tmp_path))
+        server0.template = template
+        avg = {k: np.asarray(v)
+               for k, v in server0._shared_template().items()}
+        FederationCheckpointer(ckpt_dir).save_round(
+            4, avg, [{"client_id": 1, "nr_samples": 8.0}],
+            vocab=[f"t{i}" for i in range(30)],
+            extra={"family": "avitm", "aggregator": "fedavg",
+                   "wire_codec": "none"},
+        )
+        server = _server(save_dir=str(tmp_path), journal_every=0)
+        assert server.maybe_autorecover() is None
+        resumed = _server(save_dir=str(tmp_path), journal_every=0)
+        assert resumed.restore_from_checkpoint() == 4  # --resume still works
+
+
+# ---- idempotent RPCs: client servicer ---------------------------------------
+
+class _CountingStepper:
+    """Minimal FederatedStepper stand-in counting mutations."""
+
+    def __init__(self):
+        self.steps = 0
+        self.applies = 0
+        self.loss = 1.0
+        self._last_batch_size = 8.0
+        self.current_mb = 0
+        self.current_epoch = 0
+        self.finished = False
+        self.steps_remaining = 1000
+
+    def train_mb_delta(self, snapshot=True):
+        self.steps += 1
+        self.current_mb += 1
+        return {"w": np.full((2,), float(self.steps), np.float32)}
+
+    def advance_local(self):
+        pass
+
+    def delta_update_fit(self, average):
+        self.applies += 1
+
+        class _S:
+            epoch_ended = False
+            finished = False
+            current_epoch = 0
+
+        return _S()
+
+
+def _servicer(metrics=None):
+    import logging
+
+    stepper = _CountingStepper()
+    return stepper, FederatedClientServicer(
+        client_id=1, stepper=stepper, on_stop=lambda: None,
+        logger=logging.getLogger("test"), metrics=metrics,
+    )
+
+
+class TestIdempotentServicer:
+    def test_replayed_trainstep_answered_from_cache(self):
+        m = MetricsLogger(validate=True)
+        stepper, servicer = _servicer(metrics=m)
+        first = servicer.TrainStep(
+            pb.StepRequest(global_iter=0, local_steps=1, seq=101), None,
+        )
+        assert stepper.steps == 1 and first.seq == 101
+        replay = servicer.TrainStep(
+            pb.StepRequest(global_iter=0, local_steps=1, seq=101), None,
+        )
+        assert stepper.steps == 1  # did NOT run more local steps
+        assert replay.SerializeToString() == first.SerializeToString()
+        assert m.registry.counter("rpcs_deduplicated").value == 1
+        assert m.events("rpc_deduplicated")[0]["method"] == "TrainStep"
+        # a FRESH seq advances training again
+        nxt = servicer.TrainStep(
+            pb.StepRequest(global_iter=1, local_steps=1, seq=102), None,
+        )
+        assert stepper.steps == 2 and nxt.seq == 102
+
+    def test_seqless_requests_never_cached(self):
+        stepper, servicer = _servicer()
+        servicer.TrainStep(pb.StepRequest(global_iter=0, local_steps=1), None)
+        servicer.TrainStep(pb.StepRequest(global_iter=0, local_steps=1), None)
+        assert stepper.steps == 2  # legacy servers keep legacy semantics
+
+    def test_replayed_push_ignored_reset_exempt(self):
+        m = MetricsLogger(validate=True)
+        stepper, servicer = _servicer(metrics=m)
+        bundle = codec.flatdict_to_bundle({"w": np.zeros(2, np.float32)})
+        servicer.ApplyAggregate(pb.Aggregate(shared=bundle, round=0), None)
+        assert stepper.applies == 1
+        # replay of round 0: ignored
+        servicer.ApplyAggregate(pb.Aggregate(shared=bundle, round=0), None)
+        assert stepper.applies == 1
+        assert m.registry.counter("rpcs_deduplicated").value == 1
+        # next round applies; then a reset_session replay of the SAME
+        # round applies too (rollback/recovery re-broadcasts re-deliver)
+        servicer.ApplyAggregate(pb.Aggregate(shared=bundle, round=1), None)
+        assert stepper.applies == 2
+        servicer.ApplyAggregate(
+            pb.Aggregate(shared=bundle, round=1, reset_session=True), None,
+        )
+        assert stepper.applies == 3
+
+
+# ---- idempotent RPCs: server-side reply dedup -------------------------------
+
+class TestServerReplyDedup:
+    def test_duplicate_step_reply_dropped_from_average(self):
+        m = MetricsLogger(validate=True)
+        server = _server(metrics=m, sanitize=False)
+        server.global_vocab = None
+        server.template = build_template_model("avitm", 30, MODEL_KWARGS)
+        snap = {
+            k: np.asarray(v)
+            for k, v in server._shared_template().items()
+        }
+        rec = ClientRecord(client_id=1, nr_samples=10.0)
+        reply = pb.StepReply(
+            client_id=1, shared=codec.flatdict_to_bundle(snap),
+            loss=1.0, nr_samples=8.0, seq=500,
+        )
+        out = server._collect_snapshots([(rec, reply), (rec, reply)], 0)
+        assert len(out) == 1  # one step, one vote
+        assert m.registry.counter("rpcs_deduplicated").value == 1
+        # the SAME seq later (e.g. a ghost retry) is still deduplicated
+        out2 = server._collect_snapshots([(rec, reply)], 1)
+        assert len(out2) == 0
+        # a fresh seq is admitted again
+        fresh = pb.StepReply(
+            client_id=1, shared=codec.flatdict_to_bundle(snap),
+            loss=1.0, nr_samples=8.0, seq=501,
+        )
+        assert len(server._collect_snapshots([(rec, fresh)], 2)) == 1
+
+
+# ---- idempotent retry policy ------------------------------------------------
+
+class TestIdempotentRetry:
+    def test_deadline_retry_requires_idempotent_mode(self):
+        exc = InjectedRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, "slow")
+        assert not RetryPolicy().retryable(exc)
+        assert RetryPolicy(idempotent=True).retryable(exc)
+        # non-gRPC permanents stay permanent either way
+        assert not RetryPolicy(idempotent=True).retryable(ValueError("x"))
+
+    def test_deadline_exceeded_retried_when_idempotent(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise InjectedRpcError(
+                    grpc.StatusCode.DEADLINE_EXCEEDED, "slow"
+                )
+            return "ok"
+
+        p = RetryPolicy(max_attempts=3, idempotent=True, seed=0,
+                        sleep=lambda _s: None)
+        assert p.call(flaky) == "ok" and calls["n"] == 2
+
+    def test_server_client_stubs_get_idempotent_twin(self):
+        base = RetryPolicy(max_attempts=5, seed=3)
+        server = _server(retry_policy=base)
+        assert server.retry_policy.idempotent is False
+        assert server.client_retry_policy.idempotent is True
+        assert server.client_retry_policy.max_attempts == 5
+
+
+# ---- partition fault persona ------------------------------------------------
+
+class TestPartitionFault:
+    def test_blackholes_peer_for_window_then_heals(self):
+        m = MetricsLogger(validate=True)
+        inj = FaultInjector(seed=0, metrics=m)
+        inj.script("*", kind="partition", delay_s=0.15, peer="client2")
+        for _ in range(3):  # every call in the window fails, any method
+            with pytest.raises(InjectedRpcError):
+                inj.before_call("svc", "TrainStep", peer="client2")
+        with pytest.raises(InjectedRpcError):
+            inj.before_call("svc", "ApplyAggregate", peer="client2")
+        inj.before_call("svc", "TrainStep", peer="client1")  # unaffected
+        time.sleep(0.2)
+        inj.before_call("svc", "TrainStep", peer="client2")  # healed
+        assert m.events("partition_injected")[0]["peer"] == "client2"
+        assert m.registry.counter("partitions_injected").value == 1
+        assert all(k == "partition" for _m, _p, k in inj.fired)
+
+    def test_partition_needs_positive_window(self):
+        with pytest.raises(ValueError):
+            FaultSpec(method="*", kind="partition")
+
+
+# ---- adaptive liveness window -----------------------------------------------
+
+class TestAdaptiveWatchdog:
+    def _client(self, **kw):
+        base = dict(
+            client_id=1, corpus=RawCorpus(documents=["a b"]),
+            server_address="localhost:1", liveness_timeout=300.0,
+        )
+        base.update(kw)
+        return Client(**base)
+
+    def test_cold_start_uses_fixed_formula(self):
+        c = self._client()
+        assert c._watchdog_window() == 300.0
+        c._note_local_steps(150)  # 120+2E deadline scale
+        assert c._watchdog_window() == pytest.approx(300.0 * 3.5)
+
+    def test_observed_cadence_shrinks_window_when_reconnect_cheap(self):
+        c = self._client(reconnect_window=120.0)
+        c.session_token = "tok"
+        for _ in range(5):  # ~0.1 s inter-poll gaps
+            c._last_activity = time.monotonic() - 0.1
+            c._rpc_begin()
+            c._rpc_end()
+        w = c._watchdog_window()
+        assert 5.0 <= w <= 11.0  # margin + headroom x ewma, floored
+        assert w < 300.0  # dead server detected in seconds, not minutes
+
+    def test_slow_server_only_widens_destructive_window(self):
+        """The premature-finalize fix: a server legitimately pacing
+        slower than the configured window must not read as dead when
+        firing means self-finalize (no reconnect available)."""
+        c = self._client(liveness_timeout=30.0, reconnect_window=0.0)
+        c._last_activity = time.monotonic() - 60.0
+        c._rpc_begin()  # one observed 60 s gap
+        c._rpc_end()
+        assert c._watchdog_window() > 300.0  # widened well past fixed 30
+        # with reconnect available the window is capped at the
+        # operator's own bound instead
+        c2 = self._client(liveness_timeout=30.0, reconnect_window=120.0)
+        c2.session_token = "tok"
+        c2._last_activity = time.monotonic() - 60.0
+        c2._rpc_begin()
+        c2._rpc_end()
+        assert c2._watchdog_window() == pytest.approx(30.0)
+
+    def test_finished_client_never_reconnects(self):
+        """An early finisher waiting for the fleet-wide stop broadcast
+        sees the server go legitimately quiet (finished members are not
+        polled): probing ReadyForTraining then would re-enroll it as
+        unfinished server-side and flap it through pointless extra polls
+        — reconnect is off, and the window reverts to the conservative
+        widen-only branch."""
+        c = self._client(liveness_timeout=30.0, reconnect_window=120.0)
+        c.session_token = "tok"
+
+        class _DoneStepper:
+            finished = True
+
+        c.stepper = _DoneStepper()
+        assert not c._reconnect_available()
+        c._last_activity = time.monotonic() - 60.0
+        c._rpc_begin()
+        c._rpc_end()
+        assert c._watchdog_window() > 300.0  # widen-only, not capped at 30
+        c.stepper.finished = False
+        assert c._reconnect_available()
+
+
+# ---- CLI flags --------------------------------------------------------------
+
+def test_parser_survival_flags():
+    p = build_parser()
+    args = p.parse_args([])
+    assert args.reconnect_window == 180.0
+    assert args.journal_every == 1
+    assert args.no_autorecover is False
+    assert args.chaos is None
+    args = p.parse_args(
+        ["--reconnect_window", "0", "--journal_every", "5",
+         "--no_autorecover", "--chaos", "[]"]
+    )
+    assert args.reconnect_window == 0.0 and args.journal_every == 5
+    assert args.no_autorecover and args.chaos == "[]"
+
+
+# ---- chaos e2e: in-process kills over real gRPC -----------------------------
+
+def _run_clients(clients):
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _await_round(server, round_idx, timeout=240.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline and server.global_iterations < round_idx:
+        time.sleep(0.05)
+    assert server.global_iterations >= round_idx, (
+        f"never reached round {round_idx}"
+    )
+
+
+def _abort_and_join(server):
+    """In-process SIGKILL stand-in: abort, then wait for the abandoned
+    training thread to actually exit — a REAL kill takes the thread with
+    the process, but in-process it would otherwise race the replacement
+    server's recovery reads with its final journal write."""
+    server.abort()
+    t = server._train_thread
+    if t is not None:
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "aborted training thread never exited"
+
+
+@pytest.mark.chaos
+def test_server_kill_autorecovery_with_session_reconnect(tmp_path):
+    """The tentpole flow end to end (in-process): a hard-killed server is
+    replaced by a fresh process with ZERO operator flags — it auto-resumes
+    from the round journal, re-admits both clients via their session
+    tokens (codec reset ordered per member, delta codec stays
+    consistent: codec_ref_miss == 0), and the federation trains to finite
+    betas."""
+    port = _free_port()
+    srv_dir = str(tmp_path / "server")
+    kwargs = dict(MODEL_KWARGS, num_epochs=3)
+    m1 = MetricsLogger(str(tmp_path / "run1.jsonl"), validate=True)
+    server1 = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=kwargs, max_iters=80,
+        save_dir=srv_dir, metrics=m1, checkpoint_every=0,
+        wire_codec="delta",
+    )
+    server1.start(f"[::]:{port}")
+    mc = MetricsLogger(validate=True)
+    clients = [
+        Client(client_id=c + 1, corpus=corpus,
+               server_address=f"localhost:{port}", max_features=45,
+               save_dir=str(tmp_path / f"c{c + 1}"), metrics=mc,
+               liveness_timeout=60.0, watchdog_poll_s=0.1,
+               reconnect_window=120.0, wire_codec="delta")
+        for c, corpus in enumerate(_corpora(2, docs=40, seed=3))
+    ]
+    threads = _run_clients(clients)
+    _await_round(server1, 4)
+    _abort_and_join(server1)  # SIGKILL-equivalent: no broadcast/finalize
+    killed_at = server1.global_iterations
+    m1.close()
+
+    # a replacement process: same construction, NO resume flag
+    m2 = MetricsLogger(str(tmp_path / "run2.jsonl"), validate=True)
+    server2 = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=kwargs, max_iters=80,
+        save_dir=srv_dir, metrics=m2, checkpoint_every=0,
+        wire_codec="delta",
+    )
+    resumed = server2.maybe_autorecover()
+    assert resumed is not None and resumed >= killed_at - 1
+    assert server2._recovered_source == "journal"
+    server2.start(f"[::]:{port}")
+    try:
+        assert server2.wait_done(timeout=600), "recovered run did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server2.stop()
+        for c in clients:
+            c.shutdown()
+        m2.close()
+        mc.close()
+
+    for c in clients:
+        assert c.stopped.is_set() and c.stepper.finished
+    assert np.isfinite(server2.global_betas).all()
+    assert server2.global_iterations > resumed
+    # both clients came back as the SAME live processes
+    assert m2.registry.counter("session_restores").value == 2
+    assert mc.registry.counter("client_reconnections").value == 2
+    for ev in mc.events("client_reconnected"):
+        assert ev["attempts"] >= 1
+    # delta-codec posture healed by the per-member reset order: no
+    # undecodable uplinks anywhere in the recovered run
+    assert m2.registry.counter("codec_ref_miss").value == 0
+    assert mc.registry.counter("codec_ref_miss").value == 0
+    # no double-counted replies either side of the kill
+    assert m2.registry.counter("rpcs_deduplicated").value == 0
+    # and the finished run does not resurrect
+    server3 = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=kwargs, max_iters=80,
+        save_dir=srv_dir, checkpoint_every=0, wire_codec="delta",
+    )
+    assert server3.maybe_autorecover() is None
+
+
+@pytest.mark.chaos
+def test_autorecovery_composes_with_cohort_pacing(tmp_path):
+    """Satellite: --resume/auto-recovery x cohort pacing. The restored
+    `_push_acked` round tags start empty, the rotating cohort gets
+    self-contained pushes, and the delta codec stays consistent
+    (codec_ref_miss == 0) through the restart."""
+    port = _free_port()
+    srv_dir = str(tmp_path / "server")
+    kwargs = dict(MODEL_KWARGS, num_epochs=3)
+    m1 = MetricsLogger(validate=True)
+    mk = dict(
+        min_clients=3, family="avitm", model_kwargs=kwargs, max_iters=80,
+        save_dir=srv_dir, checkpoint_every=0, wire_codec="delta",
+        pacing_policy="cohort", cohort_size=2, pacing_seed=5,
+    )
+    server1 = FederatedServer(metrics=m1, **mk)
+    server1.start(f"[::]:{port}")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus,
+               server_address=f"localhost:{port}", max_features=45,
+               save_dir=str(tmp_path / f"c{c + 1}"),
+               liveness_timeout=60.0, watchdog_poll_s=0.1,
+               reconnect_window=120.0, wire_codec="delta")
+        for c, corpus in enumerate(_corpora(3, docs=40, seed=4))
+    ]
+    threads = _run_clients(clients)
+    _await_round(server1, 4)
+    _abort_and_join(server1)
+
+    m2 = MetricsLogger(validate=True)
+    server2 = FederatedServer(metrics=m2, **mk)
+    resumed = server2.maybe_autorecover()
+    assert resumed is not None and resumed >= 3
+    server2.start(f"[::]:{port}")
+    try:
+        assert server2.wait_done(timeout=600), "cohort recovery stalled"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server2.stop()
+        for c in clients:
+            c.shutdown()
+
+    for c in clients:
+        assert c.stopped.is_set() and c.stepper.finished
+    assert np.isfinite(server2.global_betas).all()
+    assert m2.registry.counter("codec_ref_miss").value == 0
+    assert m2.registry.counter("session_restores").value >= 2
+    # cohort sampling actually ran after the restart
+    assert m2.events("cohort_sampled")
+
+
+@pytest.mark.chaos
+def test_autorecovery_composes_with_async_pacing(tmp_path):
+    """Satellite: auto-recovery x buffered-async pacing. Buffered
+    `base_round` tags older than the restart are reconciled — the clamped
+    staleness never goes negative or explodes — and the recovered run
+    drains to finite betas."""
+    port = _free_port()
+    srv_dir = str(tmp_path / "server")
+    kwargs = dict(MODEL_KWARGS, num_epochs=3)
+    mk = dict(
+        min_clients=3, family="avitm", model_kwargs=kwargs, max_iters=120,
+        save_dir=srv_dir, checkpoint_every=0,
+        pacing_policy="async", async_buffer=2, staleness_alpha=0.5,
+    )
+    m1 = MetricsLogger(validate=True)
+    server1 = FederatedServer(metrics=m1, **mk)
+    server1.start(f"[::]:{port}")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus,
+               server_address=f"localhost:{port}", max_features=45,
+               save_dir=str(tmp_path / f"c{c + 1}"),
+               liveness_timeout=60.0, watchdog_poll_s=0.1,
+               reconnect_window=120.0)
+        for c, corpus in enumerate(_corpora(3, docs=40, seed=6))
+    ]
+    threads = _run_clients(clients)
+    _await_round(server1, 4)
+    _abort_and_join(server1)
+
+    m2 = MetricsLogger(validate=True)
+    server2 = FederatedServer(metrics=m2, **mk)
+    resumed = server2.maybe_autorecover()
+    assert resumed is not None and resumed >= 3
+    server2.start(f"[::]:{port}")
+    try:
+        assert server2.wait_done(timeout=600), "async recovery stalled"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server2.stop()
+        for c in clients:
+            c.shutdown()
+
+    for c in clients:
+        assert c.stopped.is_set() and c.stepper.finished
+    assert np.isfinite(server2.global_betas).all()
+    assert server2.global_iterations > resumed
+    # stale buffered updates spanning the restart were discounted, not
+    # rejected: every surviving client kept contributing
+    for ev in m2.events("update_stale_discounted"):
+        assert ev["staleness"] >= 0 and 0 < ev["factor"] <= 1.0
+
+
+@pytest.mark.chaos
+def test_partition_persona_survivors_converge(tmp_path):
+    """A partitioned client (every RPC to it blackholed for a window)
+    rides probation through the outage, recovers when the window lifts,
+    and the federation converges with ALL clients contributing finite
+    state — the process-level partition story, in-process."""
+    m = MetricsLogger(validate=True)
+    inj = FaultInjector(seed=0, metrics=m)
+    inj.script("*", kind="partition", peer="client2", delay_s=2.0)
+    server = FederatedServer(
+        min_clients=3, family="avitm", model_kwargs=MODEL_KWARGS,
+        max_iters=80, save_dir=str(tmp_path / "server"), metrics=m,
+        checkpoint_every=0, fault_injector=inj,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                 max_delay_s=0.05, seed=1),
+        probation_rounds=10, round_backoff_s=0.1,
+    )
+    addr = server.start("[::]:0")
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"c{c + 1}"))
+        for c, corpus in enumerate(_corpora(3, docs=40, seed=8))
+    ]
+    threads = _run_clients(clients)
+    try:
+        assert server.wait_done(timeout=600), "partition run stalled"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+
+    for c in clients:
+        assert c.stopped.is_set() and c.stepper.finished
+        assert np.isfinite(c.results["betas"]).all()
+    assert np.isfinite(server.global_betas).all()
+    ev = m.events("partition_injected")
+    assert ev and ev[0]["peer"] == "client2"
+    # the partitioned client went suspect during the window and was
+    # polled back in afterwards — it trained to completion like its peers
+    recs = {r.client_id: r for r in server.federation.get_clients()}
+    assert recs[2].finished
+    assert clients[1].stepper.current_epoch == MODEL_KWARGS["num_epochs"]
+    assert m.registry.counter("client_drops").value == 0
