@@ -8,10 +8,10 @@ PRs 1/5/7 caught by hand:
   registered in ``observability.EVENT_SCHEMAS`` — an unregistered event
   passes silently in un-validated production loggers and explodes the
   first time a test constructs ``MetricsLogger(validate=True)``;
-- reverse-lint: every DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS entry
-  keeps BOTH a schema registration and at least one emission site — a
-  refactor that disconnects the admission-gate/guardian/quality
-  telemetry must not pass silently;
+- reverse-lint: every DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS +
+  SCALEOUT_EVENTS entry keeps BOTH a schema registration and at least
+  one emission site — a refactor that disconnects the admission-gate/
+  guardian/quality/scale-plane telemetry must not pass silently;
 - every ``observability.TRACE_PLANE_SPANS`` name keeps a ``span(...)``
   call site — the ``trace`` CLI merges and parents by these names;
 - scanner self-checks: zero ``.log(``/``span(`` sites at all means the
@@ -83,6 +83,7 @@ class TelemetryContractRule(Rule):
             DATA_PLANE_EVENTS,
             EVENT_SCHEMAS,
             MODEL_QUALITY_EVENTS,
+            SCALEOUT_EVENTS,
             TRACE_PLANE_SPANS,
         )
 
@@ -91,6 +92,7 @@ class TelemetryContractRule(Rule):
             "required": {
                 "DATA_PLANE_EVENTS": tuple(DATA_PLANE_EVENTS),
                 "MODEL_QUALITY_EVENTS": tuple(MODEL_QUALITY_EVENTS),
+                "SCALEOUT_EVENTS": tuple(SCALEOUT_EVENTS),
             },
             "spans": tuple(TRACE_PLANE_SPANS),
             "schema_module": SCHEMA_MODULE,

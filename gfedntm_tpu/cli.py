@@ -59,6 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--id", type=int, default=None,
                    help="node id (0 = server, >=1 = client; omit to simulate)")
+    p.add_argument("--role", choices=("auto", "server", "client", "relay"),
+                   default="auto",
+                   help="process role (default auto: derived from --id). "
+                        "'relay' runs a mid-tier aggregator (README "
+                        "\"Hierarchical federation & wire efficiency\"): "
+                        "it terminates --min_clients_federation members "
+                        "with the full admission gate, pre-reduces them "
+                        "into one pseudo-update, and joins the upstream "
+                        "server at --server_address as ordinary client "
+                        "--id")
     p.add_argument("--source", type=str, default=None,
                    help="data path (.npz synthetic archive or .parquet)")
     p.add_argument("--data_type", choices=("synthetic", "real"),
@@ -76,7 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server_address", type=str, default="localhost:50051")
     p.add_argument("--listen_port", type=int, default=None,
                    help="serving port (default: 50051 for the server, "
-                        "50051+id for clients — the reference scheme)")
+                        "50051+id for clients — the reference scheme — "
+                        "and 51051+id for relays, a distinct base so a "
+                        "relay and a same-id member on one host don't "
+                        "collide)")
     p.add_argument("--save_dir", type=str, default="output")
     p.add_argument("--n_clients", type=int, default=None,
                    help="simulate mode: partition a single corpus into N "
@@ -144,7 +157,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "all-clients barrier, default), cohort[:K] "
                         "(seeded K-of-N sampling with unbiased "
                         "reweighting), async[:B] (FedBuff-style buffered "
-                        "aggregation with staleness discounting)")
+                        "aggregation with staleness discounting), "
+                        "push[:B] (client-initiated rounds: clients "
+                        "stream PushUpdate when local steps finish; "
+                        "server work is O(updates received))")
     p.add_argument("--cohort_size", type=int, default=None,
                    help="server mode: K for --pacing cohort (alternative "
                         "to the inline cohort:<K> form)")
@@ -207,6 +223,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "before the server rolls the global model back to "
                         "the last good checkpoint; a non-finite aggregate "
                         "rolls back immediately (0 disables the guardian)")
+    p.add_argument("--codec_ref_cache_max", type=int, default=64,
+                   help="server mode: hard cap on the wire-codec "
+                        "reference caches (uplink broadcast views, "
+                        "downlink canonical views). The rotation-aware "
+                        "auto-size ~4N/K is unbounded in N at fixed K; "
+                        "past the cap a long-unsampled client degrades "
+                        "to a self-contained push / loud "
+                        "ReferenceMismatch heal instead of growing "
+                        "server memory")
     p.add_argument("--wire_codec", type=str, default=None,
                    help="wire-compression spec, '+'-joined stages of "
                         "'delta', 'topk:<frac>', 'fp16'/'bf16' (e.g. "
@@ -401,6 +426,7 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         outlier_mad_k=getattr(args, "outlier_mad_k", 4.0),
         divergence_patience=getattr(args, "divergence_patience", 3),
         wire_codec=getattr(args, "wire_codec", None) or "none",
+        codec_ref_cache_max=getattr(args, "codec_ref_cache_max", 64),
         pacing_policy=getattr(args, "pacing", "sync"),
         cohort_size=getattr(args, "cohort_size", None),
         async_buffer=getattr(args, "async_buffer", None),
@@ -455,6 +481,11 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
     from gfedntm_tpu.data.synthetic import load_reference_npz
     from gfedntm_tpu.federation.client import Client
 
+    if args.id is None or args.id < 1:
+        raise SystemExit(
+            "--role client needs --id >= 1 (client ids start at 1; "
+            "0 is the server)"
+        )
     if args.source is None:
         raise SystemExit(
             "--source required (synthetic .npz archive or .parquet corpus)"
@@ -495,6 +526,49 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
     )
     client.run()
     client.shutdown()
+    metrics.close()
+    return 0
+
+
+def run_relay(args: argparse.Namespace, cfg: GfedConfig) -> int:
+    """``--role relay``: mid-tier aggregator — terminates
+    ``--min_clients_federation`` members, pre-reduces their admitted
+    updates into one pseudo-update, and joins the upstream server as
+    client ``--id`` (README "Hierarchical federation & wire
+    efficiency")."""
+    from gfedntm_tpu.federation.relay import RelayNode
+    from gfedntm_tpu.utils.observability import MetricsLogger
+
+    if args.id is None or args.id < 1:
+        raise SystemExit(
+            "--role relay needs --id >= 1 (the relay's upstream client "
+            "identity)"
+        )
+    save_dir = os.path.join(args.save_dir, f"relay{args.id}")
+    metrics = MetricsLogger(
+        os.path.join(save_dir, "metrics.jsonl"), node=f"relay{args.id}"
+    )
+    # Distinct default base from the client scheme (50051+id): a relay
+    # and its shard's member ids share the 1..N space, so relay 1 and
+    # client 1 on one host would otherwise race for the same port.
+    port = (
+        args.listen_port if args.listen_port is not None else 51051 + args.id
+    )
+    relay = RelayNode(
+        relay_id=args.id,
+        upstream_address=args.server_address,
+        min_members=args.min_clients_federation,
+        listen_address=f"[::]:{port}",
+        metrics=metrics,
+        outlier_mad_k=getattr(args, "outlier_mad_k", 4.0),
+        max_update_norm=getattr(args, "max_update_norm", None),
+        probation_rounds=getattr(args, "probation_rounds", 3),
+        wire_codec=getattr(args, "wire_codec", None) or "auto",
+    )
+    relay.start()
+    logging.info("relay %d waiting for its shard + upstream", args.id)
+    relay.wait_done()
+    relay.shutdown()
     metrics.close()
     return 0
 
@@ -621,30 +695,67 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
 
 # ---- telemetry report (`summarize` subcommand) ------------------------------
 
+def _read_node_records(
+    paths: list[str],
+) -> "tuple[dict[str, list[dict[str, Any]]], str]":
+    """Read several per-node metrics.jsonl streams keyed by node name
+    (the ``node`` field each logger stamps, falling back to the parent
+    directory name) — shared by the summarize/report wire-tier view.
+    Each stream is read exactly once; also returns the FIRST path's node
+    name so callers can pull its records back out as the primary
+    stream."""
+    from gfedntm_tpu.utils.observability import read_metrics
+
+    node_records: dict[str, list[dict[str, Any]]] = {}
+    first_node = ""
+    for i, path in enumerate(paths):
+        try:
+            records = read_metrics(path)
+        except FileNotFoundError:
+            raise SystemExit(f"no such metrics file: {path}")
+        node = _node_name_for(path, records)
+        if i == 0:
+            first_node = node
+        node_records.setdefault(node, []).extend(records)
+    return node_records, first_node
+
+
 def run_summarize(argv: list[str]) -> int:
-    """``summarize <metrics.jsonl>``: render a run report from the telemetry
-    stream (phase breakdown, p50/p95/p99 step time, bytes per round,
-    slowest client); ``--json <path>`` also writes the aggregate dict."""
+    """``summarize <metrics.jsonl>...``: render a run report from the
+    telemetry stream (phase breakdown, p50/p95/p99 step time, bytes per
+    round, slowest client); ``--json <path>`` also writes the aggregate
+    dict. Extra paths (relay/client streams of a hierarchical run) add a
+    per-tier wire-accounting table — bytes and compression ratio per
+    relay vs root, reproducible from JSONL alone."""
     p = argparse.ArgumentParser(
         prog="gfedntm-tpu summarize",
-        description="Render a run report from a telemetry metrics.jsonl.",
+        description="Render a run report from telemetry metrics.jsonl "
+                    "streams (first = the primary report; all streams "
+                    "feed the per-tier wire table).",
     )
-    p.add_argument("path", help="path to a run's metrics.jsonl")
+    p.add_argument("paths", nargs="+",
+                   help="per-node metrics.jsonl files (server first, "
+                        "then relays/clients for per-tier wire "
+                        "accounting)")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the aggregated summary dict as JSON")
     args = p.parse_args(argv)
 
     from gfedntm_tpu.utils.observability import (
+        collect_wire_tiers,
         format_report,
-        read_metrics,
+        format_wire_tiers,
         summarize_metrics,
     )
 
-    try:
-        records = read_metrics(args.path)
-    except FileNotFoundError:
-        raise SystemExit(f"no such metrics file: {args.path}")
-    summary = summarize_metrics(records)
+    # One read per stream: the primary report comes from the FIRST
+    # path's records, pulled back out of the same node map the tier
+    # table uses (re-reading a large server stream would double the
+    # cost).
+    node_records, first_node = _read_node_records(args.paths)
+    summary = summarize_metrics(node_records.get(first_node, []))
+    tiers = collect_wire_tiers(node_records)
+    summary["wire_tiers"] = tiers
     if args.json_out:
         os.makedirs(
             os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
@@ -652,6 +763,8 @@ def run_summarize(argv: list[str]) -> int:
         with open(args.json_out, "w") as fh:
             json.dump(summary, fh, indent=1, default=float)
     print(format_report(summary))
+    print()
+    print(format_wire_tiers(tiers))
     return 0
 
 
@@ -671,7 +784,10 @@ def run_report(argv: list[str]) -> int:
                     "metrics.jsonl (requires the run to have used "
                     "--quality_every > 0).",
     )
-    p.add_argument("path", help="path to a run's metrics.jsonl")
+    p.add_argument("paths", nargs="+", metavar="path",
+                   help="metrics.jsonl streams (quality events come from "
+                        "the server's; extra relay/client streams feed "
+                        "the per-tier wire table)")
     p.add_argument("--json", dest="json_out", default=None,
                    help="also write the aggregated quality dict as JSON")
     p.add_argument("--assert-monotone-coherence", dest="monotone_tol",
@@ -682,16 +798,17 @@ def run_report(argv: list[str]) -> int:
 
     from gfedntm_tpu.utils.observability import (
         check_monotone_coherence,
+        collect_wire_tiers,
         format_quality_report,
-        read_metrics,
+        format_wire_tiers,
         summarize_model_quality,
     )
 
-    try:
-        records = read_metrics(args.path)
-    except FileNotFoundError:
-        raise SystemExit(f"no such metrics file: {args.path}")
+    node_records, _first = _read_node_records(args.paths)
+    records = [r for recs in node_records.values() for r in recs]
     summary = summarize_model_quality(records)
+    tiers = collect_wire_tiers(node_records)
+    summary["wire_tiers"] = tiers
     if args.json_out:
         os.makedirs(
             os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
@@ -699,6 +816,9 @@ def run_report(argv: list[str]) -> int:
         with open(args.json_out, "w") as fh:
             json.dump(summary, fh, indent=1, default=float)
     print(format_quality_report(summary))
+    if len(args.paths) > 1:
+        print()
+        print(format_wire_tiers(tiers))
     if args.monotone_tol is not None:
         violations = check_monotone_coherence(summary, args.monotone_tol)
         if violations:
@@ -745,20 +865,9 @@ def run_trace(argv: list[str]) -> int:
                         "node owning the 'round' spans)")
     args = p.parse_args(argv)
 
-    from gfedntm_tpu.utils.observability import (
-        merge_chrome_trace,
-        read_metrics,
-    )
+    from gfedntm_tpu.utils.observability import merge_chrome_trace
 
-    node_records: dict[str, list[dict[str, Any]]] = {}
-    for path in args.paths:
-        try:
-            records = read_metrics(path)
-        except FileNotFoundError:
-            raise SystemExit(f"no such metrics file: {path}")
-        node_records.setdefault(_node_name_for(path, records), []).extend(
-            records
-        )
+    node_records, _first = _read_node_records(args.paths)
     try:
         trace = merge_chrome_trace(node_records, reference=args.reference)
     except ValueError as err:
@@ -798,11 +907,14 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(name)s [%(threadName)s] %(levelname)s: %(message)s",
     )
     cfg = load_config(args)
-    if args.id is None:
-        return run_simulate(args, cfg)
-    if args.id == 0:
+    role = getattr(args, "role", "auto")
+    if role == "relay":
+        return run_relay(args, cfg)
+    if role == "server" or (role == "auto" and args.id == 0):
         return run_server(args, cfg)
-    return run_client(args, cfg)
+    if role == "client" or (role == "auto" and args.id is not None):
+        return run_client(args, cfg)
+    return run_simulate(args, cfg)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ runs in the default configuration.
 from __future__ import annotations
 
 import collections
+import heapq
 import logging
 import threading
 from typing import Any, Mapping, Sequence
@@ -544,6 +545,32 @@ class ContributionTracker:
                     }
                     for cid in sorted(self._cos, key=str)
                 },
+                "pairwise_cos_mean": self._pair_mean,
+                "pairwise_cos_min": self._pair_min,
+            }
+
+    def summary(self, top_k: int = 5) -> dict[str, Any]:
+        """Bounded view for the default ``/status`` scrape: the ``top_k``
+        least-aligned contributors (the ones an operator actually looks
+        for) plus the total, without materializing 10⁴ per-client EWMA
+        dicts the way :meth:`status` does (ISSUE 11 satellite)."""
+        with self._lock:
+            worst = heapq.nsmallest(
+                top_k, self._cos.items(),
+                key=lambda kv: (
+                    kv[1] if kv[1] is not None else 1.0, str(kv[0])
+                ),
+            )
+            return {
+                "clients": {
+                    str(cid): {
+                        "cos_ewma": cos,
+                        "share_ewma": self._share.get(cid),
+                        "rounds": self._rounds.get(cid, 0),
+                    }
+                    for cid, cos in worst
+                },
+                "clients_total": len(self._cos),
                 "pairwise_cos_mean": self._pair_mean,
                 "pairwise_cos_min": self._pair_min,
             }

@@ -11,6 +11,7 @@ protocol; this module only adds the wire.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
@@ -250,6 +251,17 @@ class FederatedClientServicer:
                     self.downlink.reset()
                 if self.uplink is not None:
                     self.uplink.reset()
+                if not len(request.shared.tensors):
+                    # Bare reset order (a recovered push server with
+                    # nothing aggregated yet): the sessions are dropped —
+                    # the next uplink encodes self-contained — but there
+                    # is no state to apply and no round was delivered, so
+                    # neither the stepper nor _applied_round moves.
+                    return pb.AggregateReply(
+                        client_id=self.client_id,
+                        finished=self.stepper.finished,
+                        current_epoch=self.stepper.current_epoch,
+                    )
             if self.downlink is not None:
                 try:
                     average = self.downlink.decode(
@@ -281,6 +293,49 @@ class FederatedClientServicer:
                 client_id=self.client_id, finished=status.finished,
                 current_epoch=status.current_epoch,
             )
+
+    # ---- push pacing (README "Hierarchical federation & wire efficiency") --
+    def local_round(self, local_steps: int) -> pb.StepReply:
+        """One client-clocked local round for push pacing: run the E
+        local steps and return the StepReply to stream upstream — the
+        same snapshot/encode path as a server poll, minus the seq replay
+        machinery (a client-initiated push carries no server-minted
+        seq)."""
+        self.on_activity()
+        try:
+            reply = self._train_step(pb.StepRequest(
+                global_iter=self._applied_round + 1,
+                local_steps=local_steps, seq=0,
+            ))
+            # The schedule only advances AFTER the push round completes
+            # (finish_push_round), so `stepper.finished` is one step
+            # stale here: on the FINAL scheduled step it still reads
+            # False and the server would never learn this client is
+            # done. steps_remaining counts the pending step, so <= 1
+            # means this exchanged step is the last scheduled one.
+            if self.stepper.steps_remaining <= 1:
+                reply.finished = True
+            return reply
+        finally:
+            self.on_done()
+
+    def finish_push_round(self, agg: "pb.Aggregate | None") -> None:
+        """Complete one push-paced round with the PushUpdate reply:
+        apply the returned aggregate when it carries a new broadcast (or
+        a session-reset order), otherwise advance past the exchanged
+        step locally — the free-running FedBuff client trains on its own
+        state until fresher global state arrives. Exactly one schedule
+        advance happens either way (the one-aggregate-per-exchanged-step
+        stepper contract)."""
+        with self._lock:
+            if agg is not None and not agg.stop and (
+                agg.reset_session or len(agg.shared.tensors)
+            ):
+                self._apply_aggregate(agg)
+            if self.stepper._pending_step:
+                # Empty marker, or a replayed round the dedup guard
+                # dropped: no aggregate consumed the pending step.
+                self.stepper.advance_local()
 
 
 class Client:
@@ -370,6 +425,17 @@ class Client:
         self._codec: WireCodec | None = None
         self._uplink: UplinkEncoder | None = None
         self._downlink: DownlinkDecoder | None = None
+
+        # Pacing advertised by the server's GlobalSetup: push-paced
+        # clients stream PushUpdate rounds of `_push_local_steps` on
+        # their own clock instead of awaiting polls. Each push carries a
+        # client-minted seq so a stub-level retry of a delivered-but-
+        # reply-lost push cannot buffer (and average) the update twice;
+        # a HOLD re-presentation reuses the seq on purpose (the held
+        # push was never buffered).
+        self._pacing_id = "sync"
+        self._push_local_steps = 1
+        self._push_seq = itertools.count(1)
 
         self.stepper: FederatedStepper | None = None
         self.global_vocab: Vocabulary | None = None
@@ -477,6 +543,13 @@ class Client:
         forever)."""
         self.join_federation()
         self.serve_training()
+        if self._pacing_id.startswith("push") and not self.stopped.is_set():
+            # Push pacing: this client clocks its own rounds — stream
+            # updates until finished (or told to stop), then fall into
+            # the ordinary stop-broadcast wait below.
+            self._run_push_loop()
+            if self.stopped.is_set():
+                return
         if self.liveness_timeout <= 0:
             # Watchdog disabled: a single blocking wait, not a poll loop.
             self.stopped.wait()
@@ -497,6 +570,100 @@ class Client:
                     continue  # reconnected (or stop arrived meanwhile)
             if self._watchdog_finalize():
                 break
+
+    def _run_push_loop(self) -> None:
+        """Push pacing (README "Hierarchical federation & wire
+        efficiency"): run local rounds on this client's own clock and
+        stream each one upstream as a ``PushUpdate``, applying whatever
+        fresher broadcast the reply carries. The loop ends when local
+        training finishes (the final push carries ``finished=True``), a
+        ``stop`` reply arrives, or the server stays unreachable past the
+        reconnect window."""
+        reply: pb.StepReply | None = None
+        retries = 0
+        while not self.stopped.is_set():
+            if reply is None:
+                if self.stepper.finished:
+                    return
+                reply = self._servicer.local_round(self._push_local_steps)
+                reply.session_token = self.session_token
+                reply.seq = next(self._push_seq)
+                retries = 0
+            agg = None
+            try:
+                agg = self._federation_stub.PushUpdate(reply)
+            except Exception as exc:
+                self.logger.warning(
+                    "client %d: PushUpdate failed (%s)",
+                    self.client_id, exc,
+                )
+                # The stub already retried transient failures with
+                # backoff; a persistent one means the server is gone —
+                # the durable-session reconnect path probes for a
+                # recovered process (Ack 3 resets codec sessions), and
+                # an exhausted window self-finalizes.
+                if not (
+                    self._reconnect_available()
+                    and self._reconnect_loop(0.0)
+                ):
+                    self._on_stop()
+                    return
+                if retries < 3:
+                    # Reconnected: re-present the held update instead of
+                    # discarding it — the client-minted seq makes the
+                    # re-send idempotent (a delivered-but-reply-lost push
+                    # is deduped server-side and still answered), and the
+                    # FINAL round has no successor to supersede it, so
+                    # dropping it would leave the server waiting out idle
+                    # probation for a client that silently finalized. If
+                    # the reconnect ordered a codec reset the stale delta
+                    # encoding is excluded at drain as a loud
+                    # codec_ref_miss while the progress/finished flags
+                    # still land.
+                    retries += 1
+                    continue
+                # Retries exhausted (server answers joins but not
+                # pushes): fall through and advance — the next round's
+                # update supersedes the abandoned one.
+            if (
+                agg is not None and not agg.stop and agg.round < 0
+                and not len(agg.shared.tensors)
+            ):
+                # HOLD: the federation has not started aggregating yet —
+                # re-present this same round later rather than burning
+                # the local epoch budget before anyone can average it.
+                self._touch()
+                self.stopped.wait(0.5)
+                continue
+            # Exactly one schedule advance per pushed round, whether or
+            # not the reply carried fresh state (a failed push advances
+            # too: the next round's update supersedes the lost one).
+            self._servicer.finish_push_round(agg)
+            was_final = bool(reply.finished)
+            reply = None  # consumed — next iteration runs a fresh round
+            self._touch()
+            if self.metrics is not None:
+                if agg is not None:
+                    self.metrics.registry.counter("client_pushes").inc()
+                else:
+                    # Retries exhausted: the update was abandoned, not
+                    # delivered — counting it as a push would make
+                    # client_pushes match the server's received count
+                    # while rounds silently go missing.
+                    self.metrics.registry.counter(
+                        "client_pushes_abandoned"
+                    ).inc()
+            if agg is not None and agg.stop:
+                self.logger.info(
+                    "client %d: server answered a push with stop; "
+                    "finalizing", self.client_id,
+                )
+                self._on_stop()
+                return
+            if was_final:
+                # The final local round was just pushed; wait for the
+                # fleet-wide stop broadcast like any early finisher.
+                return
 
     def _reconnect_loop(self, idle: float) -> bool:
         """RECONNECTING: the server went quiet past the liveness window —
@@ -665,6 +832,8 @@ class Client:
             # ReadyForTraining; a reconnect re-presenting it is re-admitted
             # as this same live process.
             self.session_token = setup.session_token or ""
+            self._pacing_id = setup.pacing_id or "sync"
+            self._push_local_steps = max(1, int(setup.local_steps or 1))
             self.global_vocab = Vocabulary(tuple(setup.vocab))
             self._negotiate_codec(setup.codec_id or "none")
             hyper = json.loads(setup.hyperparams_json)

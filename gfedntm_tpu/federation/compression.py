@@ -29,8 +29,32 @@ bundle carries ``ref_round`` = 1 + the round whose broadcast it is relative
 to. Decoders that do not hold that exact reference **fail loudly**
 (:class:`ReferenceMismatch`) instead of mis-decoding; the server keeps a
 small cache of recent broadcast views so a client that missed one push
-still decodes, and only delta-encodes a push when every recipient of the
-previous one acked it.
+still decodes.
+
+Per-recipient push encoding (README "Hierarchical federation & wire
+efficiency"): the downlink maintains one **canonical view chain** —
+``view_i = view_{i-1} + recon(compress(avg_i - view_{i-1} + residual))``,
+exactly the PR 3 consecutive-round delta stream — and every recipient of a
+push converges onto the round's canonical view regardless of how far
+behind it was:
+
+- a recipient holding the immediately-previous view gets the canonical
+  chain bundle (computed once per round, shared);
+- a recipient holding an older cached view gets an exact **catch-up**
+  bundle: the entries where the canonical view changed since its round,
+  shipped as *assignment* records (``sparse_set``: uint32 indices + values
+  at the logical dtype) so the reconstruction is bit-exact — additive
+  float deltas would drift by an ulp and silently corrupt the uplink
+  reference chain;
+- a recipient with no usable reference (fresh join, or its view was
+  evicted from the bounded cache) gets a self-contained view bundle (raw
+  records of the canonical view) — degraded compression, never an error.
+
+Both reference caches (uplink broadcast views, downlink canonical views)
+are bounded LRU keyed by round; evictions are instrumented
+(``codec_refs_evicted`` counter, eviction-age gauge, ``codec_ref_evicted``
+events) and degrade to self-contained pushes / loud
+:class:`ReferenceMismatch` heals, never to mis-decodes.
 
 Integer/bool tensors and zero-size arrays always ride raw records — the
 lossy stages are float-only by construction.
@@ -139,6 +163,25 @@ def make_codec(spec: "str | WireCodec | None") -> WireCodec:
 def _compressible(arr: np.ndarray) -> bool:
     """Lossy/delta stages apply to non-empty float tensors only."""
     return arr.dtype.kind == "f" and arr.size > 0
+
+
+def _note_eviction(
+    metrics, direction: str, evicted_round: int, now_round: int
+) -> None:
+    """Reference-cache eviction telemetry (ISSUE 11 satellite): cumulative
+    eviction counter, the age (in rounds) of the view just evicted — a
+    rising age means the cache is cycling faster than the fleet rotates —
+    and one JSONL event per eviction (bounded at one per push round)."""
+    age = max(0, int(now_round) - int(evicted_round))
+    if metrics is not None:
+        metrics.registry.counter("codec_refs_evicted").inc()
+        metrics.registry.gauge(
+            f"codec_ref_evicted_age_rounds/{direction}"
+        ).set(age)
+        metrics.log(
+            "codec_ref_evicted", direction=direction,
+            round=int(evicted_round), age=age,
+        )
 
 
 def _note_wire(metrics, op: str, raw_bytes: int, wire_bytes: int) -> None:
@@ -305,6 +348,24 @@ class _Session:
         for rec in bundle.tensors:
             if rec.codec in ("", "raw"):
                 arr = codec.record_to_array(rec)
+            elif rec.codec == "sparse_set":
+                # Catch-up assignment record (per-recipient push encoding):
+                # copy the reference tensor and OVERWRITE the listed
+                # entries with the shipped values — bit-exact convergence
+                # onto the canonical view (an additive float delta would
+                # round). Only legal inside a delta bundle.
+                if not delta_bundle:
+                    raise CodecError(
+                        f"sparse_set record {rec.name!r} outside a delta "
+                        "bundle"
+                    )
+                base = reference.get(rec.name)
+                if base is None:
+                    raise ReferenceMismatch(
+                        f"catch-up bundle tensor {rec.name!r} has no "
+                        "reference entry"
+                    )
+                arr = self._apply_sparse_set(rec, np.asarray(base))
             elif rec.codec in ("dense", "topk"):
                 arr = self._decode_values(rec)
                 if delta_bundle:
@@ -326,6 +387,40 @@ class _Session:
                 f"wire_decode_s/{self.role or 'wire'}"
             ).observe(time.perf_counter() - t0)
             _note_wire(self.metrics, "recv", raw_bytes, bundle.ByteSize())
+        return out
+
+    @staticmethod
+    def _apply_sparse_set(rec: pb.TensorRecord, base: np.ndarray) -> np.ndarray:
+        """Decode one ``sparse_set`` record onto its reference tensor."""
+        if rec.dtype not in codec.ALLOWED_DTYPES:
+            raise CodecError(f"dtype {rec.dtype!r} not allowed on the wire")
+        if rec.wire_dtype:
+            raise CodecError(
+                f"sparse_set record {rec.name!r} must ship logical-dtype "
+                "values (exact reconstruction)"
+            )
+        values = np.frombuffer(rec.data, dtype=codec.np_dtype(rec.dtype))
+        idx = np.frombuffer(rec.aux, dtype=np.uint32)
+        if idx.size != values.size:
+            raise CodecError(
+                f"sparse_set record {rec.name!r}: {idx.size} indices for "
+                f"{values.size} values"
+            )
+        shape = tuple(rec.shape)
+        if tuple(base.shape) != shape:
+            raise CodecError(
+                f"sparse_set record {rec.name!r}: reference shape "
+                f"{tuple(base.shape)} != record shape {shape}"
+            )
+        numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if idx.size and int(idx.max()) >= numel:
+            raise CodecError(
+                f"sparse_set record {rec.name!r}: index {int(idx.max())} "
+                f"out of range for {numel} elements"
+            )
+        out = np.array(base, dtype=codec.np_dtype(rec.dtype), copy=True)
+        flat = out.reshape(-1)
+        flat[idx] = values
         return out
 
     @staticmethod
@@ -411,7 +506,14 @@ class UplinkDecoder(_Session):
             return
         self._refs[int(round_idx)] = dict(client_view)
         while len(self._refs) > self.max_refs:
-            self._refs.popitem(last=False)
+            evicted_round, _view = self._refs.popitem(last=False)
+            # Bounded-cache eviction (ISSUE 11 satellite): an uplink that
+            # still deltas against this round will raise a loud
+            # ReferenceMismatch (codec_ref_miss) and heal on its next
+            # push — degraded, never a mis-decode.
+            _note_eviction(
+                self.metrics, "uplink", evicted_round, round_idx
+            )
 
     def reset(self) -> None:
         """Drop the whole broadcast-view cache (divergence rollback): an
@@ -435,22 +537,47 @@ class UplinkDecoder(_Session):
 
 
 class DownlinkEncoder(_Session):
-    """Server side of the Aggregate push path. Deltas against the previous
-    *broadcast view* — but only when the caller says every recipient holds
-    it (``allow_delta``; the server tracks push acks). Carries the broadcast
-    error-feedback residual so lossy pushes never lose mass permanently."""
+    """Server side of the Aggregate push path.
+
+    Maintains the **canonical view chain**: each :meth:`advance` encodes
+    the round's aggregate as a delta against the previous canonical view
+    (the EF residual carries any lossy-stage mass forward), caches the
+    reconstruction view in a bounded round-keyed LRU, and
+    :meth:`bundle_for` then serves *per-recipient* bundles — the shared
+    canonical chain bundle for up-to-date recipients, exact catch-up
+    bundles for recipients holding an older cached view, and a
+    self-contained view bundle when no usable reference exists (README
+    "Hierarchical federation & wire efficiency"). The legacy
+    :meth:`encode` (fleet-consensus ``allow_delta``) remains for
+    single-stream callers."""
 
     def __init__(self, codec_: WireCodec, metrics=None,
-                 role: str = "downlink"):
+                 role: str = "downlink", max_views: int = 8):
         super().__init__(codec_, metrics=metrics, role=role)
         self._last_view: dict[str, np.ndarray] | None = None
         self._last_round = -1
+        self.max_views = int(max_views)
+        # Canonical client views by round (bounded LRU) + this round's
+        # chain bundle. The view dicts are shared by reference with the
+        # uplink decoder's cache — one copy of each round's tensors.
+        self._views: "OrderedDict[int, dict[str, np.ndarray]]" = OrderedDict()
+        self._canonical: pb.TensorBundle | None = None
+        # Served-bundle memo for the CURRENT round, keyed by acked_round
+        # (-1 = the self-contained view bundle). bundle_for runs under
+        # the server's codec lock with one call per concurrent pusher —
+        # without this, N stale recipients cost N identical O(model)
+        # encodes serialized on that lock.
+        self._served: dict[int, pb.TensorBundle] = {}
 
     def reset(self) -> None:
-        """Forget the last broadcast view (divergence rollback): the next
-        push is encoded self-contained regardless of ``allow_delta``."""
+        """Forget the last broadcast view AND the whole canonical view
+        cache (divergence rollback): the next push is encoded
+        self-contained regardless of what any recipient claims to hold."""
         self._last_view = None
         self._last_round = -1
+        self._views.clear()
+        self._canonical = None
+        self._served.clear()
         super().reset()
 
     @property
@@ -474,9 +601,137 @@ class DownlinkEncoder(_Session):
         reference = self._last_view if allow_delta else None
         ref_round = self._last_round if allow_delta else -1
         bundle, view = self._encode(average, reference, ref_round)
-        self._last_view = view
-        self._last_round = int(round_idx)
+        self._note_view(view, int(round_idx))
+        self._canonical = bundle
         return bundle, view
+
+    def advance(
+        self, average: Mapping[str, np.ndarray], round_idx: int
+    ) -> tuple[pb.TensorBundle, dict[str, np.ndarray]]:
+        """Advance the canonical view chain one round: encode ``average``
+        as a delta against the previous canonical view whenever one exists
+        (self-contained otherwise — first round, or after :meth:`reset`),
+        cache the reconstruction view, and return ``(chain_bundle, view)``.
+        Call once per pushed round, then :meth:`bundle_for` per
+        recipient."""
+        bundle, view = self._encode(
+            average, self._last_view, self._last_round
+        )
+        self._note_view(view, int(round_idx))
+        self._canonical = bundle
+        return bundle, view
+
+    def _note_view(self, view: dict[str, np.ndarray], round_idx: int) -> None:
+        self._last_view = view
+        self._last_round = round_idx
+        self._served.clear()  # memoized bundles describe the prior round
+        if not self.codec.delta:
+            return
+        self._views[round_idx] = view
+        while len(self._views) > max(1, self.max_views):
+            evicted_round, _view = self._views.popitem(last=False)
+            # A recipient still holding this round falls back to a
+            # self-contained view bundle on its next push (degraded
+            # compression, not an error).
+            _note_eviction(
+                self.metrics, "downlink", evicted_round, round_idx
+            )
+
+    def bundle_for(self, acked_round: "int | None") -> pb.TensorBundle:
+        """The push bundle for one recipient, keyed by the round of the
+        last broadcast that recipient acked (``None`` = no reference).
+        Must follow an :meth:`advance` for the current round.
+
+        - the chain bundle is self-contained → everyone shares it;
+        - ``acked_round`` is the chain bundle's own reference → the shared
+          chain bundle;
+        - ``acked_round`` still cached → an exact catch-up bundle onto the
+          canonical view;
+        - otherwise (never acked, or evicted) → a self-contained view
+          bundle."""
+        if self._canonical is None or self._last_view is None:
+            raise CodecError("bundle_for before the first advance()")
+        chain_ref = int(self._canonical.ref_round) - 1  # -1 = self-contained
+        if chain_ref < 0:
+            return self._canonical
+        if acked_round is not None and int(acked_round) == chain_ref:
+            return self._canonical
+        if acked_round is not None and int(acked_round) in self._views:
+            key = int(acked_round)
+            if key not in self._served:
+                self._served[key] = self._catchup_bundle(key)
+            return self._served[key]
+        if -1 not in self._served:
+            self._served[-1] = self._view_bundle()
+        return self._served[-1]
+
+    def _catchup_bundle(self, acked_round: int) -> pb.TensorBundle:
+        """Exact catch-up onto the canonical view for a recipient holding
+        the cached view of ``acked_round``: per float tensor, the entries
+        that changed since then as ``sparse_set`` assignment records
+        (uint32 indices + logical-dtype values — bit-exact, see
+        :meth:`_Session._apply_sparse_set`), falling back to a raw dense
+        record when the change is too dense for sparse framing to win."""
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        old = self._views[acked_round]
+        records = []
+        raw_bytes = 0
+        for name in sorted(self._last_view):
+            arr = np.asarray(self._last_view[name])
+            raw_bytes += arr.nbytes
+            base = old.get(name)
+            if (
+                not _compressible(arr) or base is None
+                or np.asarray(base).shape != arr.shape
+            ):
+                records.append(codec.array_to_record(name, arr))
+                continue
+            flat = np.ascontiguousarray(arr).reshape(-1)
+            base_flat = np.ascontiguousarray(np.asarray(base)).reshape(-1)
+            idx = np.flatnonzero(flat != base_flat)
+            sparse_bytes = idx.size * (4 + arr.dtype.itemsize)
+            if sparse_bytes >= flat.size * arr.dtype.itemsize:
+                records.append(codec.array_to_record(name, arr))
+                continue
+            idx32 = idx.astype(np.uint32)
+            records.append(pb.TensorRecord(
+                name=name, shape=list(arr.shape), dtype=arr.dtype.name,
+                codec="sparse_set", data=flat[idx].tobytes(),
+                aux=idx32.tobytes(),
+            ))
+        bundle = pb.TensorBundle(
+            tensors=records, ref_round=acked_round + 1
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("codec_catchup_pushes").inc()
+            self.metrics.registry.histogram(
+                f"wire_encode_s/{self.role or 'wire'}"
+            ).observe(time.perf_counter() - t0)
+            _note_wire(self.metrics, "sent", raw_bytes, bundle.ByteSize())
+        return bundle
+
+    def _view_bundle(self) -> pb.TensorBundle:
+        """Self-contained raw encoding of the canonical view — the bounded
+        fallback when a recipient has no usable reference. Raw records are
+        exact by construction, so the recipient still converges onto the
+        canonical view and its future uplinks decode against the shared
+        round-keyed cache."""
+        t0 = time.perf_counter() if self.metrics is not None else 0.0
+        records = [
+            codec.array_to_record(name, np.asarray(self._last_view[name]))
+            for name in sorted(self._last_view)
+        ]
+        bundle = pb.TensorBundle(tensors=records, ref_round=0)
+        if self.metrics is not None:
+            raw = sum(
+                np.asarray(v).nbytes for v in self._last_view.values()
+            )
+            self.metrics.registry.counter("codec_selfcontained_pushes").inc()
+            self.metrics.registry.histogram(
+                f"wire_encode_s/{self.role or 'wire'}"
+            ).observe(time.perf_counter() - t0)
+            _note_wire(self.metrics, "sent", raw, bundle.ByteSize())
+        return bundle
 
 
 class DownlinkDecoder(_Session):
@@ -513,3 +768,54 @@ class DownlinkDecoder(_Session):
             self._ref = dict(out)
             self._ref_round = int(round_idx)
         return out
+
+
+def encode_push_for_recipients(
+    downlink_enc: "DownlinkEncoder | None",
+    uplink_dec: "UplinkDecoder | None",
+    average: "Mapping[str, np.ndarray]",
+    round_idx: int,
+    recipients: "list[int]",
+    acked: "Mapping[int, int]",
+    reset: bool,
+    metrics: Any = None,
+) -> "dict[int, pb.Aggregate]":
+    """One round's push encoded **per recipient** (README "Hierarchical
+    federation & wire efficiency"): advance the canonical view chain
+    once, then serve each recipient the bundle matched to its own
+    last-acked reference — the shared chain bundle when up to date, a
+    catch-up bundle for an older cached view, a self-contained view
+    bundle when it holds nothing usable. Recipients sharing a reference
+    share one encoded bundle, so encode cost is O(distinct references),
+    not O(cohort). ``downlink_enc=None`` is the identity-codec path: one
+    raw bundle for everyone.
+
+    This is the ONE implementation of the reference/reset rules, shared
+    by ``FederatedServer._encode_push`` and
+    ``RelayNode._fanout_aggregate`` — the two tiers must not drift. The
+    caller holds whatever lock guards the codec sessions."""
+    if downlink_enc is None:
+        agg = pb.Aggregate(
+            shared=codec.flatdict_to_bundle(average, metrics=metrics),
+            round=round_idx, reset_session=reset,
+        )
+        return {cid: agg for cid in recipients}
+    _bundle, view = downlink_enc.advance(average, round_idx=round_idx)
+    if uplink_dec is not None:
+        uplink_dec.note_push(round_idx, view)
+    out: dict[int, pb.Aggregate] = {}
+    by_ref: "dict[int | None, pb.Aggregate]" = {}
+    for cid in recipients:
+        # A session reset deliberately severs every reference chain: the
+        # recipient drops its codec state before applying, so its bundle
+        # must not assume one.
+        ref = None if reset else acked.get(cid)
+        agg = by_ref.get(ref)
+        if agg is None:
+            agg = pb.Aggregate(
+                shared=downlink_enc.bundle_for(ref),
+                round=round_idx, reset_session=reset,
+            )
+            by_ref[ref] = agg
+        out[cid] = agg
+    return out

@@ -39,6 +39,17 @@ factors the server's round *control plane* out of
   broadcast-round tag pushes carry). Updates are drained in client-id
   order so the aggregation arithmetic is deterministic given the same
   buffered set.
+- ``push:<B>`` — client-initiated rounds (README "Hierarchical
+  federation & wire efficiency"): the server never polls. Clients
+  stream ``PushUpdate`` RPCs when their local steps finish
+  (authenticated by the PR 10 durable-session token); the servicer
+  buffers them and the engine drains/aggregates exactly like FedBuff
+  (same staleness discounts, same deterministic drain order), but no
+  broadcast fan-out follows — each client picks the freshest round up
+  in its next PushUpdate *reply*, per-recipient delta-encoded against
+  whatever it reports holding. Server work per aggregation is
+  O(updates received), independent of the population size: no poll
+  threads, no per-cohort RPC fan-out, no deadline bookkeeping.
 
 The engines drive the server's existing *data plane* unchanged —
 :meth:`~gfedntm_tpu.federation.server.FederatedServer._collect_snapshots`
@@ -85,6 +96,7 @@ __all__ = [
     "SyncEngine",
     "CohortEngine",
     "AsyncEngine",
+    "PushEngine",
 ]
 
 #: Adaptive poll-deadline constants: never below the floor (an EWMA of
@@ -108,9 +120,9 @@ def fallback_deadline(local_steps: int) -> float:
 class PacingSpec:
     """Parsed pacing configuration (see :func:`parse_pacing`)."""
 
-    policy: str  # "sync" | "cohort" | "async"
+    policy: str  # "sync" | "cohort" | "async" | "push"
     cohort_size: int = 0  # cohort: K clients sampled per round
-    buffer_size: int = 0  # async: admitted updates per aggregation
+    buffer_size: int = 0  # async/push: admitted updates per aggregation
     staleness_alpha: float = 0.5
     seed: int = 0
 
@@ -119,8 +131,8 @@ class PacingSpec:
         """Canonical spec string (CLI / ``/status`` / telemetry form)."""
         if self.policy == "cohort":
             return f"cohort:{self.cohort_size}"
-        if self.policy == "async":
-            return f"async:{self.buffer_size}"
+        if self.policy in ("async", "push"):
+            return f"{self.policy}:{self.buffer_size}"
         return "sync"
 
 
@@ -133,17 +145,17 @@ def parse_pacing(
     seed: int = 0,
 ) -> PacingSpec:
     """Parse a pacing spec: ``sync`` (default), ``cohort[:K]``,
-    ``async[:B]``. The K/B may come inline (``cohort:8``) or from the
-    dedicated knobs (``--cohort_size`` / ``--async_buffer``); inline
-    wins when both are given and disagree loudly otherwise."""
+    ``async[:B]``, ``push[:B]``. The K/B may come inline (``cohort:8``)
+    or from the dedicated knobs (``--cohort_size`` / ``--async_buffer``);
+    inline wins when both are given and disagree loudly otherwise."""
     if isinstance(spec, PacingSpec):
         return spec
     raw = (spec or "sync").strip().lower()
     name, _, arg = raw.partition(":")
-    if name not in ("sync", "cohort", "async"):
+    if name not in ("sync", "cohort", "async", "push"):
         raise ValueError(
-            f"unknown pacing policy {raw!r} (want sync, cohort[:K] or "
-            f"async[:B])"
+            f"unknown pacing policy {raw!r} (want sync, cohort[:K], "
+            f"async[:B] or push[:B])"
         )
     if staleness_alpha < 0:
         raise ValueError(
@@ -175,18 +187,18 @@ def parse_pacing(
     b = inline if inline is not None else async_buffer
     if b is None:
         raise ValueError(
-            "async pacing needs a buffer: --pacing async:<B> or "
+            f"{name} pacing needs a buffer: --pacing {name}:<B> or "
             "--async_buffer"
         )
     if inline is not None and async_buffer not in (None, inline):
         raise ValueError(
-            f"conflicting async buffers: pacing spec says {inline}, "
+            f"conflicting {name} buffers: pacing spec says {inline}, "
             f"--async_buffer says {async_buffer}"
         )
     if b < 1:
-        raise ValueError(f"async buffer must be >= 1, got {b}")
+        raise ValueError(f"{name} buffer must be >= 1, got {b}")
     return PacingSpec(
-        "async", buffer_size=int(b),
+        name, buffer_size=int(b),
         staleness_alpha=staleness_alpha, seed=seed,
     )
 
@@ -196,6 +208,8 @@ def make_engine(server: "FederatedServer", spec: PacingSpec) -> "RoundEngine":
         return CohortEngine(server, spec)
     if spec.policy == "async":
         return AsyncEngine(server, spec)
+    if spec.policy == "push":
+        return PushEngine(server, spec)
     return SyncEngine(server, spec)
 
 
@@ -395,13 +409,10 @@ class RoundEngine:
             return rec, None, time.perf_counter() - t0
 
     # ---- the guardian/quality/encode tail ----------------------------------
-    def _guard_quality_encode(
-        self, iteration: int, snapshots, average, replies
-    ):
-        """The post-aggregate pipeline every policy shares: divergence
-        guardian verdict (and rollback swap), model-quality plane, the
-        ``last_average`` install, and the wire-codec push encode —
-        verbatim from the historical sync loop."""
+    def _guard_quality(self, iteration: int, snapshots, average):
+        """Divergence guardian verdict (and rollback swap) + the
+        model-quality plane — the post-aggregate pipeline every policy
+        shares; returns the (possibly restored) average to install."""
         s = self.server
         accepted_average = average
         if s.guardian is not None:
@@ -415,18 +426,38 @@ class RoundEngine:
                 restored = s._divergence_rollback(iteration, verdict)
                 if restored is not None:
                     average = restored
-        average = s._quality_step(
+        return s._quality_step(
             iteration, snapshots, average, accepted_average
         )
+
+    def _guard_quality_encode(
+        self, iteration: int, snapshots, average, replies
+    ):
+        """Guardian/quality tail + the ``last_average`` install + the
+        per-recipient wire-codec push encode — verbatim from the
+        historical sync loop."""
+        s = self.server
+        average = self._guard_quality(iteration, snapshots, average)
         s.last_average = average
         return s._encode_push(average, iteration, replies)
 
-    def _push_round(self, stubs: dict, pool, agg, replies, rpc_kwargs,
+    @staticmethod
+    def push_bytes(aggs: "dict[int, Any]", replies: list) -> int:
+        """True wire cost of one round's per-recipient pushes (recipients
+        sharing a reference share one encoded bundle, but each delivery
+        still moves the bytes)."""
+        return sum(
+            aggs[rec.client_id].ByteSize() for rec, _reply in replies
+            if rec.client_id in aggs
+        )
+
+    def _push_round(self, stubs: dict, pool, aggs, replies, rpc_kwargs,
                     iteration: int):
-        """Concurrent push + progress bookkeeping; returns the acked
+        """Concurrent per-recipient push + progress bookkeeping
+        (``aggs``: client id → its encoded Aggregate); returns the acked
         client ids and records each acker's broadcast round (the
-        delta-reference bookkeeping the next push's ``allow_delta``
-        check reads)."""
+        delta-reference bookkeeping the next push's per-recipient
+        encoding reads)."""
         s = self.server
 
         def push(item):
@@ -434,7 +465,7 @@ class RoundEngine:
             addr = rec.address
             try:
                 ack = stubs[rec.client_id][2].ApplyAggregate(
-                    agg, **rpc_kwargs
+                    aggs[rec.client_id], **rpc_kwargs
                 )
                 s.federation.update_progress(
                     rec.client_id, reply.current_mb,
@@ -660,18 +691,18 @@ class SyncEngine(RoundEngine):
                         continue
                     self._note_admitted_weights()
                     average = self.combine(snapshots, iteration)
-                    agg = self._guard_quality_encode(
+                    aggs = self._guard_quality_encode(
                         iteration, snapshots, average, replies
                     )
 
                 # 3. concurrent push + progress bookkeeping.
                 with span(m, "push", parent=round_sp, clients=len(replies)):
                     self._push_round(
-                        stubs, pool, agg, replies, rpc_kwargs, iteration
+                        stubs, pool, aggs, replies, rpc_kwargs, iteration
                     )
                 if m is not None:
                     round_sp.annotate(
-                        bytes_pushed=agg.ByteSize() * len(replies)
+                        bytes_pushed=self.push_bytes(aggs, replies)
                     )
             s.global_iterations = iteration + 1
             self._maybe_checkpoint(iteration)
@@ -1008,7 +1039,7 @@ class AsyncEngine(RoundEngine):
                 average = s.aggregator.aggregate(
                     snapshots, current_global=s._current_global()
                 )
-                agg = self._guard_quality_encode(
+                aggs = self._guard_quality_encode(
                     iteration, snapshots, average, replies
                 )
             if m is not None:
@@ -1024,14 +1055,224 @@ class AsyncEngine(RoundEngine):
                 )
             with span(m, "push", parent=round_sp, clients=len(replies)):
                 self._push_round(
-                    stubs, pool, agg, replies, rpc_kwargs, iteration
+                    stubs, pool, aggs, replies, rpc_kwargs, iteration
                 )
             if m is not None:
                 round_sp.annotate(
-                    bytes_pushed=agg.ByteSize() * len(replies),
+                    bytes_pushed=self.push_bytes(aggs, replies),
                     clients=len(replies),
                 )
         s.global_iterations = iteration + 1
+        self._maybe_checkpoint(iteration)
+        if m is not None and iteration % 50 == 0:
+            m.snapshot_registry(rounds=iteration + 1)
+            m.log(
+                "federated_iteration", iteration=iteration,
+                mean_loss=float(
+                    np.mean([r.loss for _, r in replies])
+                ),
+            )
+        return iteration + 1, skips
+
+
+class PushEngine(AsyncEngine):
+    """Client-initiated push rounds (``--pacing push:<B>``; README
+    "Hierarchical federation & wire efficiency").
+
+    The polling direction inverts: the server never dispatches TrainStep.
+    Clients stream ``PushUpdate`` RPCs on their own clock (each carrying
+    one local round's update, authenticated by the durable-session
+    token); the servicer buffers them (:meth:`submit`) and this engine
+    drains/aggregates exactly like FedBuff — deterministic client-id
+    drain order, server-clamped staleness discounts, the full admission
+    gate — once ``B`` updates accumulate. No broadcast fan-out follows:
+    each contributor picks the freshest round up in its next PushUpdate
+    *reply*, per-recipient delta-encoded against whatever it reports
+    holding. Per-aggregation server work is therefore O(updates
+    received), with no poll threads and no per-cohort deadline
+    bookkeeping — the control-plane cost is flat in the population size.
+
+    A member that stops pushing altogether is struck through the same
+    probation machinery as a failed poll (:meth:`_strike_idle`), so a
+    crashed client cannot hold the federation open forever.
+    """
+
+    policy = "push"
+
+    #: A member is struck (probation) when silent for this many multiples
+    #: of the historical per-round deadline.
+    IDLE_DEADLINE_FACTOR = 4.0
+
+    def __init__(self, server: "FederatedServer", spec: PacingSpec):
+        super().__init__(server, spec)
+        # Wakes the engine the moment a push lands (vs. sleeping out a
+        # full backoff tick) — latency, not correctness.
+        self._wake = threading.Event()
+        # Wall-clock of each member's last accepted push; consulted by
+        # the idle-strike sweep. Written by gRPC threads via submit().
+        self._last_push: dict[int, float] = {}  # guarded-by: _lock
+        # Last idle-strike sweep (engine thread only): the sweep is
+        # throttled so the idle loop stays O(1) per tick, not O(N).
+        self._last_sweep = 0.0
+
+    def pool_workers(self, poll_workers: int) -> int:
+        # No polls: the executor only ever runs the final stop broadcast.
+        return max(1, min(int(poll_workers), 4))
+
+    def submit(self, rec, reply) -> int:
+        """Buffer one client-initiated update (called from PushUpdate
+        servicer threads); returns the new buffer depth."""
+        depth = self.buffer_append(rec, reply, 0.0)
+        with self._lock:
+            self._last_push[rec.client_id] = time.monotonic()
+        self._wake.set()
+        return depth
+
+    def status(self) -> "dict[str, Any]":
+        out = super().status()
+        out["push"] = True
+        return out
+
+    def _strike_idle(self, iteration: int) -> None:
+        """Probation sweep for members that stopped pushing: one strike
+        per elapsed idle window (the strike resets the member's clock, so
+        a genuinely dead client drops after ``probation_rounds`` windows
+        while a slow-but-alive one clears itself with its next push).
+
+        Throttled to a fraction of the idle window: the sweep walks the
+        whole registry (O(N)), and running it on every ``round_backoff_s``
+        tick would put an O(N) scan between aggregations whose advertised
+        cost is O(updates received) — at 10^4 members that IS the round
+        time. Sub-window sweep granularity buys nothing: a strike only
+        fires after a full multi-minute window elapses."""
+        s = self.server
+        window = self.IDLE_DEADLINE_FACTOR * fallback_deadline(s.local_steps)
+        now = time.monotonic()
+        if now - self._last_sweep < max(5.0, window / 8.0):
+            return
+        self._last_sweep = now
+        for rec in s.federation.active_clients(iteration):
+            # Check and reset under ONE lock hold: submit() stamps
+            # _last_push from gRPC threads, and a separate read-then-write
+            # would let a push landing in between be clobbered by the
+            # stale strike — permanently dropping a live client at low
+            # probation_rounds.
+            with self._lock:
+                last = self._last_push.setdefault(rec.client_id, now)
+                if now - last <= window:
+                    continue
+                self._last_push[rec.client_id] = now
+            s._note_client_failure(
+                rec, rec.address, iteration,
+                TimeoutError(
+                    f"no PushUpdate for {now - last:.0f}s "
+                    f"(window {window:.0f}s)"
+                ),
+                "PushUpdate",
+            )
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, stubs: dict, pool: ThreadPoolExecutor) -> None:
+        s = self.server
+        iteration = s.global_iterations
+        skips = 0
+        while (
+            iteration < s.max_iters
+            and skips < max(16, 4 * s.max_iters)
+            and not s._stopping.is_set()
+        ):
+            if s.profiler is not None:
+                s.profiler.observe(iteration)
+            # Clear BEFORE reading the buffer depth: any push landing
+            # after this point re-sets the event, so either the depth
+            # read below sees it or the wait returns immediately —
+            # clearing later (after the O(N) idle sweep) erased wakeups
+            # from pushes that filled the buffer in that window and slept
+            # a full backoff tick on a full buffer.
+            self._wake.clear()
+            with self._lock:
+                buffered = len(self._pending)
+            alive = s.federation.alive_count()
+            effective = max(1, min(self.spec.buffer_size, alive or 1))
+            if buffered >= effective:
+                iteration, skips = self._aggregate_push(iteration, skips)
+                continue
+            if alive == 0:
+                if buffered:
+                    # End-game partial drain: the last unfinished members
+                    # pushed and finished in the same breath.
+                    iteration, skips = self._aggregate_push(
+                        iteration, skips
+                    )
+                    continue
+                pending = s.federation.pending_suspects(iteration)
+                if not pending and not s._awaiting_reconnect_grace():
+                    break
+            self._strike_idle(iteration)
+            self._wake.wait(s.round_backoff_s)
+        self._final_checkpoint()
+
+    def _aggregate_push(
+        self, iteration: int, skips: int
+    ) -> "tuple[int, int]":
+        """One buffered aggregation, reply-delivered: drain, discount by
+        server-clamped staleness, gate, aggregate, guard — then advance
+        the canonical broadcast chain WITHOUT a fan-out (contributors
+        sync in their next PushUpdate replies) and journal the round."""
+        s = self.server
+        m = s.metrics
+        drained = self.buffer_drain()
+        if not drained:
+            return iteration, skips
+        self._note_cohort([rec for rec, _r, _l in drained])
+        with span(m, "round", round=iteration, pacing="push") as round_sp:
+            replies = [(rec, reply) for rec, reply, _lat in drained]
+            was_suspect = frozenset(
+                rec.client_id for rec, _r, _l in drained
+                if rec.status == SUSPECT
+            )
+            stale_map = self.clamped_staleness(replies, iteration)
+            discounts = self.discounts_for(drained, iteration, stale_map)
+            quorum = max(
+                1, math.ceil(s.quorum_fraction * len(drained))
+            )
+            with span(m, "average", parent=round_sp):
+                snapshots = s._collect_snapshots(
+                    replies, iteration, was_suspect,
+                    weight_scale=discounts,
+                    staleness=stale_map,
+                )
+                if len(snapshots) < quorum:
+                    s._skip_below_quorum(
+                        iteration, len(snapshots), len(drained), quorum,
+                        "admitted by the update gate",
+                    )
+                    return iteration, skips + 1
+                self._note_admitted_weights()
+                average = s.aggregator.aggregate(
+                    snapshots, current_global=s._current_global()
+                )
+                average = self._guard_quality(
+                    iteration, snapshots, average
+                )
+                s.last_average = average
+                s._advance_broadcast(average, iteration)
+            if m is not None:
+                stales = [
+                    stale_map[rec.client_id] for rec, _reply in replies
+                ]
+                round_sp.annotate(clients=len(replies))
+                m.log(
+                    "push_aggregated", round=iteration,
+                    buffered=len(drained), admitted=len(snapshots),
+                    stale_max=max(stales), stale_mean=float(
+                        sum(stales) / len(stales)
+                    ),
+                )
+        s.global_iterations = iteration + 1
+        # The round is complete the moment the chain advances — replies
+        # deliver it; journal now so a crash replays at most this round.
+        s._journal_round(iteration)
         self._maybe_checkpoint(iteration)
         if m is not None and iteration % 50 == 0:
             m.snapshot_registry(rounds=iteration + 1)

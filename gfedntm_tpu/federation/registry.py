@@ -294,6 +294,12 @@ class Federation:
             self._cond.notify_all()
 
     # ---- views -------------------------------------------------------------
+    def get(self, client_id: int) -> "ClientRecord | None":
+        """O(1) record lookup — the per-push hot path must not copy and
+        sort the whole registry to find one member."""
+        with self._lock:
+            return self._clients.get(client_id)
+
     def get_clients(self) -> list[ClientRecord]:
         with self._lock:
             return sorted(self._clients.values(), key=lambda c: c.client_id)
@@ -350,6 +356,42 @@ class Federation:
                 }
                 for c in self.get_clients()
             ]
+
+    def membership_summary(self, top_k: int = 5) -> dict:
+        """One-pass O(N) *summary* of the membership for the live ops
+        endpoint (ISSUE 11 satellite): counts per liveness state,
+        ready/finished totals, total weight, and the ``top_k`` members
+        with the worst consecutive-failure streaks — NOT the full
+        per-client roster, whose 10⁴-entry dict build stalls the ops
+        thread at scale (that view stays behind ``/status?full=1``)."""
+        with self._lock:
+            by_status: dict[str, int] = {}
+            ready = finished = 0
+            weight = 0.0
+            worst: list[tuple[int, int, str]] = []
+            for c in self._clients.values():
+                by_status[c.status] = by_status.get(c.status, 0) + 1
+                ready += bool(c.ready_for_training)
+                finished += bool(c.finished)
+                weight += c.nr_samples if c.ready_for_training else 0.0
+                if c.consecutive_failures > 0:
+                    worst.append((
+                        c.consecutive_failures, c.client_id,
+                        c.suspect_reason,
+                    ))
+            worst.sort(key=lambda t: (-t[0], t[1]))
+            return {
+                "total": len(self._clients),
+                "by_status": by_status,
+                "ready": ready,
+                "finished": finished,
+                "total_weight": weight,
+                "top_failing": [
+                    {"client_id": cid, "consecutive_failures": n,
+                     "reason": reason}
+                    for n, cid, reason in worst[:max(0, int(top_k))]
+                ],
+            }
 
     def alive_count(self) -> int:
         """Unfinished, training-ready clients — INCLUDING suspects inside

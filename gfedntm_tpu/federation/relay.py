@@ -1,0 +1,689 @@
+"""Hierarchical aggregation tier: the mid-level relay process.
+
+A :class:`RelayNode` terminates a *shard* of clients with the same
+servicer/gate/data-plane code the root server runs, pre-reduces their
+admitted updates into ONE pseudo-update with :func:`~gfedntm_tpu.
+federation.aggregation.weighted_mean` (summed sample weight), and forwards
+it upstream as an ordinary client — so the root's per-round work is
+O(relays), not O(clients), and each relay's is O(its shard). The EM view
+of FedAvg (PAPERS.md, arXiv 2111.10192) licenses the composition: the
+weighted mean of shard-weighted means with summed weights IS the flat
+population weighted mean, so a two-tier topology reproduces the flat
+FedAvg trajectory up to float re-association (tested to 1e-4).
+
+Protocol-wise the relay is both sides at once:
+
+- **downstream** it serves ``gfedntm.Federation`` to its members —
+  vocabulary intake, a GlobalSetup that mirrors the root's consensus
+  (with relay-minted member session tokens), readiness — and pushes
+  re-encoded aggregates;
+- **upstream** it serves ``gfedntm.FederationClient`` to the root: a
+  ``TrainStep`` fans out to the shard, gates the replies through a full
+  :class:`~gfedntm_tpu.federation.sanitize.UpdateGate` (a poisoner behind
+  a relay is screened AT the relay, before its mass can touch the root's
+  cohort statistics), and answers with the pre-reduced pseudo-update; an
+  ``ApplyAggregate`` is decoded once and re-broadcast to the shard with
+  the relay's own per-recipient downlink encoding.
+
+Trust note (README "Hierarchical federation & wire efficiency"): a relay
+sees its members' raw updates — place relays inside the trust domain of
+the clients they terminate (e.g. one relay per institution), exactly the
+boundary gFedNTM's private-corpus setting draws anyway.
+
+Wire sessions are per-hop: members ↔ relay and relay ↔ root each run
+their own negotiated codec sessions, so delta/topk compression applies on
+both tiers independently (per-tier accounting lands in each process's own
+``metrics.jsonl``; ``summarize`` merges them, README "Telemetry").
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from gfedntm_tpu.data.vocab import Vocabulary, union_vocabularies
+from gfedntm_tpu.federation import codec, rpc
+from gfedntm_tpu.federation.aggregation import weighted_mean
+from gfedntm_tpu.federation.compression import (
+    DownlinkDecoder,
+    DownlinkEncoder,
+    UplinkDecoder,
+    UplinkEncoder,
+    WireCodec,
+    encode_push_for_recipients,
+)
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
+from gfedntm_tpu.federation.resilience import RetryPolicy
+from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
+from gfedntm_tpu.federation.server import build_template_model
+from gfedntm_tpu.utils.observability import span
+
+
+class RelayNode:
+    """One mid-tier aggregator: terminates ``min_members`` clients and
+    joins the upstream federation as client ``relay_id``.
+
+    ``sanitize``/``outlier_mad_k``/``max_update_norm`` parameterize the
+    relay's OWN admission gate over its shard (the PR 5 defenses,
+    reused as-is); ``fault_injector`` scripts faults into the relay's
+    member stubs (chaos tests)."""
+
+    def __init__(
+        self,
+        relay_id: int,
+        upstream_address: str,
+        min_members: int,
+        listen_address: str = "[::]:0",
+        advertise_host: str = "localhost",
+        logger: logging.Logger | None = None,
+        metrics=None,
+        sanitize: bool = True,
+        outlier_mad_k: float = 4.0,
+        max_update_norm: float | None = None,
+        probation_rounds: int = 3,
+        poll_workers: int = 16,
+        setup_timeout: float = 3600.0,
+        retry_policy: RetryPolicy | None = None,
+        fault_injector=None,
+        wire_codec: str | None = "auto",
+    ):
+        assert relay_id > 0, "relay ids are upstream client ids (>= 1)"
+        self.relay_id = relay_id
+        self.upstream_address = upstream_address
+        self.listen_address = listen_address
+        self.advertise_host = advertise_host
+        self.logger = logger or logging.getLogger(f"Relay{relay_id}")
+        self.metrics = metrics
+        self.setup_timeout = float(setup_timeout)
+        self.poll_workers = int(poll_workers)
+        self.probation_rounds = int(probation_rounds)
+        self.retry_policy = retry_policy or RetryPolicy(metrics=metrics)
+        self.fault_injector = fault_injector
+        self.wire_codec_spec = wire_codec
+
+        self.federation = Federation(min_clients=min_members)
+        self.update_gate = UpdateGate(
+            check_finite=bool(sanitize),
+            mad_k=float(outlier_mad_k) if sanitize else 0.0,
+            max_update_norm=max_update_norm if sanitize else None,
+            metrics=metrics, logger=self.logger,
+        )
+
+        # Serializes the whole train/apply data plane (the root never
+        # overlaps calls to one client, but the lock makes it a fact).
+        self._lock = threading.RLock()
+        self._setup_lock = threading.Lock()
+        self._setup_ready = threading.Event()
+        self._setup_base: pb.GlobalSetup | None = None
+        self._ready_sent = False
+        self.session_token = ""
+        self.global_vocab: Vocabulary | None = None
+        self._template_flat: dict[str, np.ndarray] | None = None
+        self._current: dict[str, np.ndarray] | None = None
+        self._applied_round = -1
+        self._last_seq = 0
+        self._last_reply: pb.StepReply | None = None
+        # Member-side wire bookkeeping: the round each member last acked
+        # (the relay's own per-recipient downlink encoding reads it).
+        self._member_acked: dict[int, int] = {}  # guarded-by: _lock
+        self._member_seq = int(time.time()) << 20
+        self._seq_counter = itertools.count(1)
+
+        self._codec: WireCodec | None = None
+        self._uplink_up: UplinkEncoder | None = None      # relay -> root
+        self._downlink_up: DownlinkDecoder | None = None  # root -> relay
+        self._uplink_down: UplinkDecoder | None = None    # members -> relay
+        self._downlink_down: DownlinkEncoder | None = None  # relay -> members
+
+        self._grpc_server = None
+        self._member_stubs: dict[int, tuple] = {}
+        self._pool = ThreadPoolExecutor(max_workers=self.poll_workers)
+        self._advertised_address = ""
+        self.stopped = threading.Event()
+        self._finalized = False
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> str:
+        """Open the upstream channel and serve both protocol faces; returns
+        the relay's advertised address."""
+        channel = rpc.make_channel(self.upstream_address)
+        self._fed_stub = rpc.ServiceStub(
+            channel, "gfedntm.Federation",
+            metrics=self.metrics, peer="root",
+            retry_policy=self.retry_policy,
+        )
+        self._grpc_server = rpc.make_server(
+            max_workers=max(self.poll_workers,
+                            2 * self.federation.min_clients + 4)
+        )
+        rpc.add_service(
+            self._grpc_server, "gfedntm.Federation", self,
+            metrics=self.metrics,
+        )
+        rpc.add_service(
+            self._grpc_server, "gfedntm.FederationClient", self,
+            metrics=self.metrics,
+        )
+        port = self._grpc_server.add_insecure_port(self.listen_address)
+        self._grpc_server.start()
+        self._advertised_address = f"{self.advertise_host}:{port}"
+        self.logger.info(
+            "relay %d serving %d-member shard on %s (upstream %s)",
+            self.relay_id, self.federation.min_clients,
+            self._advertised_address, self.upstream_address,
+        )
+        return self._advertised_address
+
+    def wait_done(self, timeout: float | None = None) -> bool:
+        return self.stopped.wait(timeout)
+
+    def shutdown(self, grace: float = 0.5) -> None:
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace)
+        self._pool.shutdown(wait=False)
+        for _addr, channel, _stub in self._member_stubs.values():
+            channel.close()
+
+    # ---- downstream Federation service (members -> relay) ------------------
+    def OfferVocab(self, request: pb.VocabOffer, context) -> pb.Ack:
+        self.federation.connect_vocab(
+            request.client_id, tuple(request.tokens), request.nr_samples
+        )
+        self.logger.info(
+            "relay %d: member %d offered %d tokens (%.0f samples)",
+            self.relay_id, request.client_id, len(request.tokens),
+            request.nr_samples,
+        )
+        return pb.Ack(code=0, detail="vocab accepted by relay")
+
+    def GetGlobalSetup(self, request: pb.JoinRequest, context) -> pb.GlobalSetup:
+        """Block for the shard's vocabulary quorum, run the upstream join
+        exactly once (union vocabulary + summed weight offered as this
+        relay's own vocab), then mirror the root's consensus downstream
+        with a relay-minted member session token."""
+        self.federation.wait_vocab_quorum()
+        with self._setup_lock:
+            if self._setup_base is None:
+                self._setup_base = self._upstream_setup()
+                self._setup_ready.set()
+            base = self._setup_base
+        client_id = int(request.client_id)
+        if client_id <= 0:
+            return base
+        token = uuid.uuid4().hex
+        self.federation.set_session_token(client_id, token)
+        with self._lock:
+            self._member_acked.pop(client_id, None)
+        reply = pb.GlobalSetup()
+        reply.CopyFrom(base)
+        reply.session_token = token
+        return reply
+
+    def _upstream_setup(self) -> pb.GlobalSetup:
+        """The once-per-relay upstream join: offer the shard's union
+        vocabulary under the relay's identity, block on the root's
+        consensus, negotiate the per-hop codec sessions, and build the
+        downstream GlobalSetup base (same consensus, relay-paced)."""
+        members = [
+            c for c in self.federation.get_clients() if c.vocab_sent
+        ]
+        union = union_vocabularies([Vocabulary(c.vocab) for c in members])
+        weight = float(sum(c.nr_samples for c in members))
+        with span(self.metrics, "relay_join", relay=self.relay_id):
+            self._fed_stub.OfferVocab(pb.VocabOffer(
+                client_id=self.relay_id, tokens=list(union.tokens),
+                nr_samples=weight,
+            ))
+            setup = self._fed_stub.GetGlobalSetup(
+                pb.JoinRequest(client_id=self.relay_id),
+                timeout=self.setup_timeout,
+            )
+        self.session_token = setup.session_token or ""
+        if (setup.pacing_id or "").startswith("push"):
+            # The relay is polled by the root (TrainStep fan-out); it
+            # does not originate PushUpdate rounds. A push-paced root
+            # would silently never drive this relay's shard — fail the
+            # join loudly instead of stalling the whole tier.
+            raise ValueError(
+                f"relay {self.relay_id}: the upstream federation paces "
+                f"{setup.pacing_id!r}, but the relay tier requires a "
+                "polled policy (sync/cohort/async) — run the root "
+                "without --pacing push"
+            )
+        self.global_vocab = Vocabulary(tuple(setup.vocab))
+        self._negotiate_codec(setup.codec_id or "none")
+        hyper = json.loads(setup.hyperparams_json)
+        template = build_template_model(
+            hyper["family"], len(self.global_vocab), hyper["kwargs"]
+        )
+        self._template_flat = _shared_flat(
+            template, tuple(hyper["grads_to_share"])
+        )
+        self.update_gate.set_template(self._template_flat)
+        self.logger.info(
+            "relay %d joined upstream: %d members, %.0f total weight, "
+            "vocab %d, codec %r",
+            self.relay_id, len(members), weight, len(self.global_vocab),
+            self._codec.codec_id,
+        )
+        if self.metrics is not None:
+            self.metrics.log(
+                "relay_joined", relay=self.relay_id,
+                members=len(members), weight=weight,
+            )
+        base = pb.GlobalSetup()
+        base.CopyFrom(setup)
+        # Members are paced by THIS relay (it fans the root's polls out),
+        # never directly by the root's policy.
+        base.pacing_id = "sync"
+        base.session_token = ""
+        return base
+
+    def _negotiate_codec(self, server_codec_id: str) -> None:
+        if self.wire_codec_spec in (None, "auto"):
+            self._codec = WireCodec(server_codec_id)
+        else:
+            self._codec = WireCodec(self.wire_codec_spec)
+            if self._codec.codec_id != server_codec_id:
+                raise ValueError(
+                    f"relay {self.relay_id} configured codec "
+                    f"{self._codec.codec_id!r} but the federation runs "
+                    f"{server_codec_id!r}"
+                )
+        if not self._codec.identity:
+            m = self.metrics
+            self._uplink_up = UplinkEncoder(self._codec, metrics=m)
+            self._downlink_up = DownlinkDecoder(self._codec, metrics=m)
+            self._uplink_down = UplinkDecoder(
+                self._codec, metrics=m,
+                max_refs=max(8, 2 * self.federation.min_clients),
+            )
+            self._downlink_down = DownlinkEncoder(
+                self._codec, metrics=m,
+                max_views=max(8, 2 * self.federation.min_clients),
+            )
+
+    def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
+        if self.stopped.is_set():
+            return pb.Ack(code=1, detail="federation already finished")
+        client_codec = request.codec_id or "none"
+        negotiated = (
+            self._codec.codec_id if self._codec is not None else "none"
+        )
+        if client_codec != negotiated:
+            return pb.Ack(
+                code=2,
+                detail=(
+                    f"wire codec mismatch: relay runs {negotiated!r}, "
+                    f"member offered {client_codec!r}"
+                ),
+            )
+        self.federation.connect_ready(request.client_id, request.address)
+        ready = sum(
+            c.ready_for_training for c in self.federation.get_clients()
+        )
+        with self._setup_lock:
+            if ready >= self.federation.min_clients and not self._ready_sent:
+                self._ready_sent = True
+                ack = self._fed_stub.ReadyForTraining(pb.JoinRequest(
+                    client_id=self.relay_id,
+                    address=self._advertised_address,
+                    codec_id=negotiated,
+                    session_token=self.session_token,
+                ))
+                self.logger.info(
+                    "relay %d: shard complete (%d members) — upstream "
+                    "ready ack %d", self.relay_id, ready, ack.code,
+                )
+                if ack.code == 1:
+                    self._finalize()
+                    return pb.Ack(code=1, detail="federation finished")
+        return pb.Ack(code=0, detail="ready recorded by relay")
+
+    def PushUpdate(self, request: pb.StepReply, context) -> pb.Aggregate:
+        """Members of a relay shard are relay-paced (polled), never
+        push-paced — a member push means misconfiguration."""
+        self.logger.warning(
+            "relay %d: member %d sent PushUpdate (shard members are "
+            "polled); refusing", self.relay_id, request.client_id,
+        )
+        return pb.Aggregate(stop=True)
+
+    # ---- upstream FederationClient service (root -> relay) -----------------
+    def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
+        """One upstream round: fan the poll out to the shard, gate the
+        decoded replies, pre-reduce the admitted set with the weighted
+        mean, and answer with the pseudo-update (summed weight). A round
+        with no admissible member update raises — the root's probation
+        machinery treats the relay like any failed client."""
+        with self._lock:
+            seq = int(request.seq)
+            if (
+                seq and self._last_reply is not None
+                and seq <= self._last_seq
+            ):
+                # Replayed delivery: idempotent, same as a leaf client.
+                if self.metrics is not None:
+                    self.metrics.registry.counter("rpcs_deduplicated").inc()
+                    self.metrics.log(
+                        "rpc_deduplicated", client=self.relay_id,
+                        method="TrainStep", seq=seq,
+                    )
+                return self._last_reply
+            reply = self._train_round(request)
+            if seq:
+                self._last_seq = seq
+                self._last_reply = reply
+            return reply
+
+    def _member_stub(self, rec):
+        entry = self._member_stubs.get(rec.client_id)
+        if entry is None or entry[0] != rec.address:
+            if entry is not None:
+                entry[1].close()
+            channel = rpc.make_channel(rec.address)
+            stub = rpc.ServiceStub(
+                channel, "gfedntm.FederationClient",
+                metrics=self.metrics, peer=f"client{rec.client_id}",
+                retry_policy=self.retry_policy,
+                fault_injector=self.fault_injector,
+            )
+            entry = (rec.address, channel, stub)
+            self._member_stubs[rec.client_id] = entry
+        return entry[2]
+
+    def _note_member_failure(self, rec, round_idx: int, exc: Exception,
+                             what: str, reason: str = "rpc") -> None:
+        status = self.federation.mark_suspect(
+            rec.client_id, rec.address, round_idx,
+            probation_rounds=self.probation_rounds, reason=reason,
+        )
+        if status == DROPPED:
+            self.logger.warning(
+                "relay %d: dropping member %d after repeated failed %s "
+                "(%s)", self.relay_id, rec.client_id, what, exc,
+            )
+        else:
+            self.logger.warning(
+                "relay %d: member %d suspect after failed %s (%s)",
+                self.relay_id, rec.client_id, what, exc,
+            )
+
+    def _train_round(self, request: pb.StepRequest) -> pb.StepReply:
+        round_idx = int(request.global_iter)
+        members = self.federation.active_clients(round_idx)
+        if not members:
+            raise RuntimeError(
+                f"relay {self.relay_id}: no pollable members this round"
+            )
+        was_suspect = frozenset(
+            rec.client_id for rec in members if rec.status == SUSPECT
+        )
+        downstream = pb.StepRequest(
+            global_iter=request.global_iter,
+            local_steps=request.local_steps,
+            broadcast_round=self._applied_round + 1,
+        )
+
+        def poll(rec):
+            req = pb.StepRequest()
+            req.CopyFrom(downstream)
+            req.seq = self._member_seq + next(self._seq_counter)
+            try:
+                stub = self._member_stub(rec)
+                return rec, stub.TrainStep(req, timeout=None), None
+            except Exception as exc:  # noqa: BLE001 — probation accounting
+                return rec, None, exc
+
+        polled = list(self._pool.map(poll, members))
+        answered = []
+        for rec, reply, exc in polled:
+            if reply is None:
+                self._note_member_failure(rec, round_idx, exc, "TrainStep")
+                continue
+            answered.append((rec, reply))
+
+        if self._uplink_down is not None:
+            decode = self._uplink_down.decode
+        else:
+            def decode(bundle):
+                return codec.bundle_to_flatdict(bundle, metrics=self.metrics)
+
+        # The shared decode-and-gate pipeline (sanitize.decode_and_admit):
+        # the relay screens its members with the SAME admission, repeat-
+        # offender, and recovery rules as the root, so a poisoner behind
+        # a relay cannot be screened by stale tier-local policy.
+        result, losses, records = decode_and_admit(
+            answered, decode, self.update_gate, self._current_global(),
+            round_idx, metrics=self.metrics, was_suspect=was_suspect,
+            on_decode_error=lambda rec, err: self.logger.warning(
+                "relay %d: member %d reply not decodable (%s)",
+                self.relay_id, rec.client_id, err,
+            ),
+            on_poisoned=lambda rec, rej: self._note_member_failure(
+                rec, round_idx,
+                RuntimeError(f"{rej.reason}: {rej.detail}"),
+                "update admission", reason="poisoned",
+            ),
+            on_recovered=self.federation.mark_recovered,
+        )
+        if not result.accepted:
+            raise RuntimeError(
+                f"relay {self.relay_id}: round {round_idx} admitted no "
+                "member updates"
+            )
+
+        # The pre-reduction: one pseudo-update whose weight is the sum of
+        # the admitted member weights — the EM-composition that makes
+        # two-tier FedAvg equal flat FedAvg.
+        admitted = [(w, snap) for _cid, w, snap in result.accepted]
+        pseudo = weighted_mean(admitted)
+        # The mean promotes to float64 (and would average int counters as
+        # floats); the pseudo-update must present the TEMPLATE dtypes or
+        # the root's conformance gate rejects it as a dtype skew.
+        pseudo = {
+            k: np.asarray(v).astype(self._template_flat[k].dtype)
+            if k in self._template_flat else np.asarray(v)
+            for k, v in pseudo.items()
+        }
+        total_w = float(sum(w for w, _ in admitted))
+        loss_num = sum(
+            w * losses[cid] for cid, w, _ in result.accepted
+            if np.isfinite(losses[cid])
+        )
+        loss_den = sum(
+            w for cid, w, _ in result.accepted
+            if np.isfinite(losses[cid])
+        )
+        mean_loss = float(loss_num / loss_den) if loss_den else float("nan")
+        if self.metrics is not None:
+            self.metrics.log(
+                "relay_preaggregated", relay=self.relay_id,
+                round=round_idx, members=len(polled),
+                admitted=len(result.accepted), weight=total_w,
+            )
+
+        if self._uplink_up is not None:
+            shared = self._uplink_up.encode(pseudo)
+        else:
+            shared = codec.flatdict_to_bundle(pseudo, metrics=self.metrics)
+        replies = [records[cid][1] for cid, _w, _s in result.accepted]
+        return pb.StepReply(
+            client_id=self.relay_id,
+            shared=shared,
+            loss=mean_loss,
+            nr_samples=total_w,
+            current_mb=max(r.current_mb for r in replies),
+            current_epoch=max(r.current_epoch for r in replies),
+            finished=all(
+                c.finished for c in self.federation.get_clients()
+            ),
+            base_round=self._applied_round + 1,
+            seq=int(request.seq),
+        )
+
+    def _current_global(self) -> dict[str, np.ndarray]:
+        return (
+            self._current if self._current is not None
+            else self._template_flat
+        )
+
+    def ApplyAggregate(self, request: pb.Aggregate, context) -> pb.AggregateReply:
+        """Decode the root's push once, re-broadcast it to the shard with
+        the relay's own per-recipient downlink encoding, and account
+        member progress. Stop broadcasts and session resets fan out."""
+        with self._lock:
+            if request.stop:
+                self._fanout_stop()
+                self._finalize()
+                return pb.AggregateReply(
+                    client_id=self.relay_id, finished=True,
+                )
+            round_idx = int(request.round)
+            if (
+                not request.reset_session
+                and round_idx <= self._applied_round
+            ):
+                if self.metrics is not None:
+                    self.metrics.registry.counter("rpcs_deduplicated").inc()
+                    self.metrics.log(
+                        "rpc_deduplicated", client=self.relay_id,
+                        method="ApplyAggregate", round=round_idx,
+                    )
+                return pb.AggregateReply(
+                    client_id=self.relay_id,
+                    finished=all(
+                        c.finished for c in self.federation.get_clients()
+                    ),
+                )
+            if request.reset_session:
+                # The root discarded the trajectory our upstream session
+                # state describes; the shard's sessions chain off ours,
+                # so the reset cascades down before anything decodes.
+                self.logger.warning(
+                    "relay %d: upstream ordered a codec session reset "
+                    "(round %d)", self.relay_id, round_idx,
+                )
+                for session in (
+                    self._uplink_up, self._downlink_up,
+                    self._uplink_down, self._downlink_down,
+                ):
+                    if session is not None:
+                        session.reset()
+                self._member_acked.clear()
+            if self._downlink_up is not None:
+                average = self._downlink_up.decode(
+                    request.shared, round_idx=round_idx
+                )
+                if self._uplink_up is not None:
+                    self._uplink_up.note_aggregate(average, round_idx)
+            else:
+                average = codec.bundle_to_flatdict(
+                    request.shared, metrics=self.metrics
+                )
+            self._current = average
+            self._applied_round = round_idx
+            finished = self._fanout_aggregate(
+                average, round_idx, bool(request.reset_session)
+            )
+            return pb.AggregateReply(
+                client_id=self.relay_id, finished=finished,
+            )
+
+    def _fanout_aggregate(
+        self, average: dict[str, np.ndarray], round_idx: int, reset: bool
+    ) -> bool:
+        """Re-broadcast one decoded aggregate to every unfinished member,
+        per-recipient encoded against each member's own acked round."""
+        members = [
+            c for c in self.federation.get_clients()
+            if c.ready_for_training and not c.finished
+        ]
+        aggs = encode_push_for_recipients(
+            self._downlink_down, self._uplink_down, average, round_idx,
+            [rec.client_id for rec in members], self._member_acked,
+            reset, metrics=self.metrics,
+        )
+
+        def push(rec):
+            try:
+                ack = self._member_stub(rec).ApplyAggregate(
+                    aggs[rec.client_id]
+                )
+                self.federation.update_progress(
+                    rec.client_id, rec.current_mb, ack.current_epoch,
+                    rec.last_loss, finished=ack.finished,
+                )
+                return rec.client_id
+            except Exception as exc:  # noqa: BLE001 — probation accounting
+                self._note_member_failure(
+                    rec, round_idx, exc, "ApplyAggregate"
+                )
+                return None
+
+        acked = {
+            cid for cid in self._pool.map(push, members) if cid is not None
+        }
+        # Reentrant: ApplyAggregate already holds _lock; taking it here
+        # keeps the guard local to the mutation.
+        with self._lock:
+            for rec in members:
+                if rec.client_id in acked:
+                    self._member_acked[rec.client_id] = round_idx
+                else:
+                    self._member_acked.pop(rec.client_id, None)
+        return all(c.finished for c in self.federation.get_clients())
+
+    def _fanout_stop(self) -> None:
+        stop = pb.Aggregate(stop=True)
+        for rec in self.federation.get_clients():
+            if not rec.ready_for_training:
+                continue
+            try:
+                self._member_stub(rec).ApplyAggregate(stop)
+            except Exception as exc:  # noqa: BLE001 — best-effort stop
+                self.logger.warning(
+                    "relay %d: stop broadcast to member %d failed: %s",
+                    self.relay_id, rec.client_id, exc,
+                )
+
+    def _finalize(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        self.logger.info(
+            "relay %d: federation finished after round %d",
+            self.relay_id, self._applied_round,
+        )
+        if self.metrics is not None:
+            self.metrics.snapshot_registry(relay=self.relay_id)
+        self.stopped.set()
+
+
+def _shared_flat(
+    template, grads_to_share: tuple[str, ...]
+) -> dict[str, np.ndarray]:
+    """The template's shared flat subset — the same authoritative key set
+    the root server gates against (server._shared_template, shared here
+    without holding a FederatedServer)."""
+    from flax.traverse_util import flatten_dict
+
+    from gfedntm_tpu.models.params import build_share_mask
+
+    variables = {
+        "params": template.params,
+        "batch_stats": template.batch_stats,
+    }
+    mask = flatten_dict(
+        build_share_mask(variables, grads_to_share), sep="/"
+    )
+    flat = flatten_dict(variables, sep="/")
+    return {k: np.asarray(v) for k, v in flat.items() if mask.get(k)}

@@ -23,11 +23,24 @@ SERVICES: dict[str, dict[str, tuple[Any, Any]]] = {
         "OfferVocab": (pb.VocabOffer, pb.Ack),
         "GetGlobalSetup": (pb.JoinRequest, pb.GlobalSetup),
         "ReadyForTraining": (pb.JoinRequest, pb.Ack),
+        # Push pacing (README "Hierarchical federation & wire efficiency"):
+        # a client-initiated round — the client streams its post-local-round
+        # update, the reply carries the freshest broadcast (per-recipient
+        # delta-encoded against whatever the client reports holding).
+        "PushUpdate": (pb.StepReply, pb.Aggregate),
     },
     "gfedntm.FederationClient": {
         "TrainStep": (pb.StepRequest, pb.StepReply),
         "ApplyAggregate": (pb.Aggregate, pb.AggregateReply),
     },
+}
+
+# Methods an impl may legitimately omit at add_service time (the caller
+# then gets UNIMPLEMENTED): PushUpdate exists only under push pacing, and
+# pre-push test servicers predate it. Everything else is mandatory —
+# a missing production handler fails fast at registration.
+OPTIONAL_METHODS: dict[str, frozenset[str]] = {
+    "gfedntm.Federation": frozenset({"PushUpdate"}),
 }
 
 # Reference message caps (main.py:218-242, dft_params.cf:37-44) with sane
@@ -64,11 +77,26 @@ def add_service(server: grpc.Server, service_name: str, impl: Any,
     the caller's gRPC metadata (trace id, the SENDER's span id as
     ``remote_parent_id``, round, the paired send/recv clock stamps the
     trace merger aligns on). ``metrics=None`` registers the raw behaviours
-    unchanged — the un-instrumented dispatch path is bit-identical."""
+    unchanged — the un-instrumented dispatch path is bit-identical.
+
+    An impl may omit a method listed in :data:`OPTIONAL_METHODS`
+    (standard gRPC semantics: calling an unregistered method returns
+    UNIMPLEMENTED) — e.g. a pre-push-pacing test servicer without
+    ``PushUpdate``. Every other method is mandatory and raises here at
+    registration time: a typo'd production handler must crash at
+    startup, not surface mid-training as an UNIMPLEMENTED feeding the
+    probation machinery."""
     spec = SERVICES[service_name]
     handlers = {}
     for method, (req_cls, resp_cls) in spec.items():
-        behaviour = getattr(impl, method)
+        behaviour = getattr(impl, method, None)
+        if behaviour is None:
+            if method in OPTIONAL_METHODS.get(service_name, ()):
+                continue
+            raise AttributeError(
+                f"{type(impl).__name__} does not implement required "
+                f"method {method} of {service_name}"
+            )
         if fault_injector is not None:
             behaviour = _injected_behaviour(
                 fault_injector, service_name, method, behaviour

@@ -528,3 +528,82 @@ class UpdateGate:
                     "update_clipped", client=client_id, round=round_idx,
                     norm=norm, max_norm=max_norm,
                 )
+
+
+def decode_and_admit(
+    replies: "list[tuple[Any, Any]]",
+    decode: "Any",
+    gate: UpdateGate,
+    current_global: Mapping[str, np.ndarray],
+    round_idx: int,
+    *,
+    metrics: Any = None,
+    was_suspect: frozenset = frozenset(),
+    weight_scale: "Mapping[int, float] | None" = None,
+    staleness: "Mapping[int, int] | None" = None,
+    on_decode_error: "Any",
+    on_poisoned: "Any",
+    on_recovered: "Any",
+) -> "tuple[GateResult, dict[int, float], dict[int, tuple[Any, Any]]]":
+    """Decode one round's ``(member_record, StepReply)`` pairs and pass
+    them through ``gate`` — the ONE decode-and-gate pipeline shared by the
+    root server (``FederatedServer._collect_snapshots``) and the relay
+    tier (``RelayNode._train_round``), the uplink twin of
+    ``compression.encode_push_for_recipients``: a gate-policy change
+    (rejection reasons, staleness normalization, recovery semantics) made
+    on one tier MUST apply at the other, or a poisoner behind a relay is
+    screened by stale rules.
+
+    Shared here: the decode attempt with ``codec_ref_miss``
+    counter/event accounting (a reply the codec cannot decode costs the
+    round one contributor, never an error), FedAvg weight assembly
+    (``reply.nr_samples`` falling back to the member's join-time corpus
+    size, optionally scaled by ``weight_scale`` — the async staleness
+    discount), the admission call itself, the repeat-offender screen
+    (``gate.consecutive() >= gate.suspect_after``), and admission-scoped
+    probation recovery (a ``was_suspect`` member only clears when its
+    update is *accepted*). Tier-specific policy stays with the caller via
+    the three hooks: ``on_decode_error(rec, err)`` (logging),
+    ``on_poisoned(rec, rejection)`` (probation entry), and
+    ``on_recovered(client_id)``.
+
+    Returns ``(gate_result, losses_by_id, records_by_id)`` where
+    ``records_by_id`` maps member id to its ``(record, reply)`` pair for
+    the decodable replies.
+    """
+    from gfedntm_tpu.federation.compression import CodecError
+
+    records: "dict[int, tuple[Any, Any]]" = {}
+    losses: "dict[int, float]" = {}
+    candidates: "list[tuple[int, float, dict[str, np.ndarray]]]" = []
+    for rec, reply in replies:
+        try:
+            snap = decode(reply.shared)
+        except CodecError as err:
+            if metrics is not None:
+                metrics.registry.counter("codec_ref_miss").inc()
+                metrics.log(
+                    "codec_ref_miss", client=rec.client_id,
+                    ref_round=int(reply.shared.ref_round) - 1,
+                    round=round_idx,
+                )
+            on_decode_error(rec, err)
+            continue
+        records[rec.client_id] = (rec, reply)
+        losses[rec.client_id] = float(reply.loss)
+        weight = float(reply.nr_samples) or rec.nr_samples
+        if weight_scale is not None:
+            weight *= float(weight_scale.get(rec.client_id, 1.0))
+        candidates.append((rec.client_id, weight, snap))
+
+    result = gate.admit_round(
+        candidates, current_global, round_idx, staleness=staleness,
+    )
+    for rej in result.rejected:
+        rec, _reply = records[rej.client_id]
+        if gate.consecutive(rej.client_id) >= gate.suspect_after:
+            on_poisoned(rec, rej)
+    for client_id, _w, _s in result.accepted:
+        if client_id in was_suspect:
+            on_recovered(client_id)
+    return result, losses, records

@@ -65,16 +65,16 @@ from gfedntm_tpu.data.vocab import Vocabulary
 from gfedntm_tpu.federation import codec, pacing, rpc
 from gfedntm_tpu.federation.aggregation import make_aggregator
 from gfedntm_tpu.federation.compression import (
-    CodecError,
     DownlinkEncoder,
     UplinkDecoder,
+    encode_push_for_recipients,
     make_codec,
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.eval.monitor import COHERENCE_COLLAPSE, ContributionTracker
 from gfedntm_tpu.federation.registry import DROPPED, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
-from gfedntm_tpu.federation.sanitize import UpdateGate
+from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.train.guardian import DivergenceGuardian
 from gfedntm_tpu.models.ctm import CTM
@@ -146,6 +146,7 @@ class FederatedServer:
         divergence_loss_factor: float = 4.0,
         wire_codec: str = "none",
         codec_ref_cache: int = 8,
+        codec_ref_cache_max: int = 64,
         ops_port: int | None = None,
         ops_host: str = "127.0.0.1",
         profiler: RoundProfiler | None = None,
@@ -266,7 +267,20 @@ class FederatedServer:
         self._uplink_dec = UplinkDecoder(
             self.wire_codec, metrics=metrics, max_refs=codec_ref_cache,
         )
-        self._downlink_enc = DownlinkEncoder(self.wire_codec, metrics=metrics)
+        self._downlink_enc = DownlinkEncoder(
+            self.wire_codec, metrics=metrics, max_views=codec_ref_cache,
+        )
+        # Hard cap on both reference caches (ISSUE 11 satellite): the
+        # rotation-aware auto-size below (~4N/K) is unbounded in N at
+        # fixed K — the cap bounds server tensor memory; past it, a
+        # long-unsampled client degrades to a self-contained push /
+        # loud ReferenceMismatch heal instead of growing the cache.
+        self.codec_ref_cache_max = int(codec_ref_cache_max)
+        # Wire-codec sessions are single-threaded under poll pacing (the
+        # round loop owns them); push pacing adds gRPC PushUpdate threads
+        # encoding per-recipient replies concurrently with the engine
+        # advancing the canonical chain — every session touch holds this.
+        self._codec_lock = threading.Lock()
         # Per-client round of the last acked push — a push may only be
         # delta-encoded when every recipient holds the encoder's delta
         # reference (the immediately-previous broadcast). Under cohort and
@@ -281,6 +295,23 @@ class FederatedServer:
         # delta-encode against a broadcast the fresh process never held.
         self._push_lock = threading.Lock()
         self._push_acked: dict[int, int] = {}  # guarded-by: _push_lock
+        # Push pacing bookkeeping: the round of the last broadcast each
+        # client was SENT in a PushUpdate reply (caps base_round claims —
+        # a client cannot "ack" a round it was never given), and, after a
+        # divergence rollback, the rollback round each member still owes
+        # a session reset for (the reset rides every PushUpdate reply
+        # until the member demonstrably applied a post-rollback round).
+        self._push_sent: dict[int, int] = {}  # guarded-by: _push_lock
+        self._reset_owed: dict[int, int] = {}  # guarded-by: _push_lock
+        # Receipt-time replay guard for client-minted PushUpdate seqs —
+        # deliberately SEPARATE from `_reply_seen` (which the drain-time
+        # _collect_snapshots check reads and records): recording a push
+        # seq at receipt would make its own drain read as a replay.
+        self._push_seen: dict[int, int] = {}
+        # Identity-codec PushUpdate reply memo: (average object, round,
+        # encoded bundle) — see the PushUpdate identity branch.
+        self._push_identity_memo: "tuple[Any, int, pb.TensorBundle] | None" \
+            = None
         # Set by a divergence rollback (and by crash recovery): the NEXT
         # push carries Aggregate.reset_session so every recipient drops
         # its wire-codec session state (delta refs + error-feedback
@@ -482,11 +513,18 @@ class FederatedServer:
             self._ops_server.stop()
             self._ops_server = None
 
-    def _status(self) -> dict[str, Any]:
+    def _status(self, full: bool = False) -> dict[str, Any]:
         """The live ops endpoint's ``/status`` payload: round progress,
-        membership with probation states, negotiated codec + compression
-        ratios, and the straggler view — all JSON-safe reads, no training-
-        loop locks held across RPC work."""
+        membership, negotiated codec + compression ratios, and the
+        straggler view — all JSON-safe reads, no training-loop locks held
+        across RPC work.
+
+        The default view is a bounded *summary* (ISSUE 11 satellite):
+        per-state membership counts plus top-k failing/slowest members —
+        at 10⁴ clients the full per-client dict build stalls the ops
+        thread on every scrape. ``full=True`` (``/status?full=1``)
+        restores the complete roster and per-client straggler/
+        contribution series."""
         reg = self.metrics.registry if self.metrics is not None else None
 
         def gauge(name):
@@ -516,7 +554,10 @@ class FederatedServer:
                 self._engine.status() if self._engine is not None
                 else {"policy": self.pacing.spec_id}
             ),
-            "clients": self.federation.membership_snapshot(),
+            "clients": (
+                self.federation.membership_snapshot() if full
+                else self.federation.membership_summary()
+            ),
             # Crash-survival plane (README "Crash recovery & sessions"):
             # where (and from what) this process recovered, journal
             # cadence, and the durable-session/idempotency counters.
@@ -531,7 +572,10 @@ class FederatedServer:
                 "ratio_sent": gauge("compression_ratio_sent"),
                 "ratio_recv": gauge("compression_ratio_recv"),
             },
-            "stragglers": self.straggler.status(),
+            "stragglers": (
+                self.straggler.status() if full
+                else self.straggler.summary()
+            ),
             # Data-plane defense view (README "Robust aggregation &
             # divergence recovery"): every rejection/clip/rollback is
             # visible here as well as in the JSONL stream.
@@ -557,10 +601,10 @@ class FederatedServer:
             # Model-quality plane (README "Model-quality observability"):
             # coherence/diversity/drift ring buffer + per-client
             # contribution EWMAs; None when the plane is off.
-            "model_quality": self._model_quality_status(),
+            "model_quality": self._model_quality_status(full=full),
         }
 
-    def _model_quality_status(self) -> dict[str, Any] | None:
+    def _model_quality_status(self, full: bool = False) -> dict[str, Any] | None:
         if self.quality_every <= 0:
             return None
         out: dict[str, Any] = {
@@ -570,7 +614,10 @@ class FederatedServer:
         }
         if self._quality_mon is not None:
             out.update(self._quality_mon.status())
-        out["contributions"] = self.contributions.status()
+        out["contributions"] = (
+            self.contributions.status() if full
+            else self.contributions.summary()
+        )
         return out
 
     def wait_done(self, timeout: float | None = None) -> bool:
@@ -618,7 +665,10 @@ class FederatedServer:
         self.federation.set_session_token(client_id, token)
         with self._push_lock:
             self._push_acked.pop(client_id, None)
+            self._push_sent.pop(client_id, None)
+            self._reset_owed.pop(client_id, None)
         self._reply_seen.pop(client_id, None)
+        self._push_seen.pop(client_id, None)
         self._poll_warmed.discard(client_id)
         self.straggler.forget(client_id)
         self.contributions.forget(client_id)
@@ -657,6 +707,11 @@ class FederatedServer:
             vocab=list(self.global_vocab.tokens),
             model_family=self.family,
             codec_id=self.wire_codec.codec_id,
+            # Pacing negotiation: push-paced clients stream PushUpdate
+            # rounds of `local_steps` on their own clock instead of
+            # waiting for polls.
+            pacing_id=self.pacing.spec_id,
+            local_steps=self.local_steps,
             hyperparams_json=json.dumps(hyper),
             init_variables=codec.tree_to_bundle(
                 {"params": self.template.params,
@@ -911,6 +966,20 @@ class FederatedServer:
         # token reconnects of members that held live sessions get the
         # per-client reset order (Ack code 3) at readmission.
         self._session_reset_pending = not self.wire_codec.identity
+        if self.pacing.policy == "push" and not self.wire_codec.identity:
+            # Reply-delivered resets (the rollback mechanism): a push
+            # server is never polled, so _encode_push — the only consumer
+            # of _session_reset_pending — never runs, and a surviving
+            # client whose channel reconnects within its stub retry
+            # window never probes ReadyForTraining for the Ack-3 reset.
+            # Without this, its delta uplinks reference pre-crash state
+            # this process doesn't hold and ReferenceMismatch forever.
+            with self._push_lock:
+                self._reset_owed = {
+                    c.client_id: int(round_idx)
+                    for c in self.federation.get_clients()
+                    if not c.finished
+                }
         self._recovered_from = int(round_idx)
         self._recovered_source = "journal" if use_journal else "checkpoint"
 
@@ -1130,7 +1199,10 @@ class FederatedServer:
             # the token mint in GetGlobalSetup.)
             with self._push_lock:
                 self._push_acked.pop(request.client_id, None)
+                self._push_sent.pop(request.client_id, None)
+                self._reset_owed.pop(request.client_id, None)
             self._reply_seen.pop(request.client_id, None)
+            self._push_seen.pop(request.client_id, None)
             self._poll_warmed.discard(request.client_id)
             self.straggler.forget(request.client_id)
             self.contributions.forget(request.client_id)
@@ -1163,6 +1235,194 @@ class FederatedServer:
                 )
                 self._train_thread.start()
         return pb.Ack(code=ack_code, detail=ack_detail)
+
+    def PushUpdate(self, request: pb.StepReply, context) -> pb.Aggregate:
+        """Client-initiated round under push pacing (README "Hierarchical
+        federation & wire efficiency"): buffer the streamed update for
+        the engine's FedBuff drain and answer with the freshest
+        broadcast, per-recipient delta-encoded against the round the
+        client reports holding — one RPC moves the update up AND the
+        model down, and server work stays O(updates received).
+
+        The durable-session token authenticates the push (a stale
+        process's updates must not enter the average); the client's
+        ``base_round`` claim is its broadcast ack, clamped to what this
+        server actually sent it. The reply is an empty marker when the
+        client is already current, and carries ``stop`` once the
+        federation is over (the client finalizes)."""
+        cid = int(request.client_id)
+        m = self.metrics
+        if self._stopping.is_set() or self.training_done.is_set():
+            return pb.Aggregate(stop=True)
+        if self.pacing.policy != "push":
+            self.logger.warning(
+                "client %d sent PushUpdate but this federation paces %s; "
+                "refusing", cid, self.pacing.spec_id,
+            )
+            if m is not None:
+                m.registry.counter("push_updates_refused").inc()
+            return pb.Aggregate(stop=True)
+        rec = self.federation.get(cid)
+        if (
+            rec is None or not rec.session_token
+            or rec.session_token != request.session_token
+        ):
+            # An unknown member or a token minted for a different process:
+            # the pusher is stale — tell it to finalize rather than let
+            # an unauthenticated update into the average.
+            self.logger.warning(
+                "client %d PushUpdate with a stale/unknown session "
+                "token; refusing", cid,
+            )
+            if m is not None:
+                m.registry.counter("push_updates_refused").inc()
+            return pb.Aggregate(stop=True)
+        engine = self._engine
+        if not isinstance(engine, pacing.PushEngine):
+            # Training has not started (readiness quorum still forming):
+            # a HOLD marker (round=-1, nothing buffered) — the client
+            # re-presents the same round later instead of burning its
+            # local epoch budget into the void.
+            return pb.Aggregate(round=-1)
+
+        # Broadcast-ack bookkeeping from the client's own claim, capped
+        # by what this server actually sent it (a claim cannot fabricate
+        # a reference we never delivered — the delta encoder would
+        # otherwise trust it).
+        claimed = int(request.base_round) - 1
+        with self._push_lock:
+            acked = min(claimed, self._push_sent.get(cid, -1))
+            if acked >= 0:
+                self._push_acked[cid] = acked
+            else:
+                self._push_acked.pop(cid, None)
+            owed_round = self._reset_owed.get(cid)
+            if owed_round is not None and acked >= owed_round:
+                # The member demonstrably applied a post-rollback round
+                # THIS process delivered (acked is clamped to _push_sent):
+                # its reset landed. The raw claim must not clear it — a
+                # surviving client's pre-crash base_round can sit past the
+                # recovered journal round while this process delivered
+                # nothing, and popping the owed reset on that claim leaves
+                # the client's pre-crash codec sessions alive: every
+                # uplink then ReferenceMismatches and every reply round is
+                # dedup-skipped — the no-progress deadlock
+                # _session_reset_pending exists to prevent.
+                self._reset_owed.pop(cid, None)
+                owed_round = None
+        reset = owed_round is not None
+
+        # Replay guard: the stub retries UNAVAILABLE automatically, so a
+        # push delivered-but-reply-lost would be buffered (and averaged)
+        # twice without it. Client-minted per-push seqs dedup here —
+        # duplicates still get the freshest broadcast, just no second
+        # buffer slot (the TrainStep idempotency stance, inverted).
+        seq = int(request.seq)
+        duplicate = bool(seq) and self._push_seen.get(cid, 0) >= seq
+        if duplicate:
+            self.logger.warning(
+                "client %d: duplicate PushUpdate seq %d; answering "
+                "without re-buffering", cid, seq,
+            )
+            if m is not None:
+                m.registry.counter("rpcs_deduplicated").inc()
+                m.log(
+                    "rpc_deduplicated", client=cid, method="PushUpdate",
+                    seq=seq,
+                )
+        else:
+            if seq:
+                self._push_seen[cid] = seq
+            self.federation.update_progress(
+                cid, int(request.current_mb), int(request.current_epoch),
+                float(request.loss), finished=bool(request.finished),
+            )
+            depth = engine.submit(rec, request)
+            if m is not None:
+                m.registry.counter("push_updates_received").inc()
+                m.registry.gauge("push_buffer_depth").set(depth)
+
+        # Reply with the freshest broadcast the engine has installed. The
+        # round tag and the encoded bundle must be read ATOMICALLY vs the
+        # engine's chain advance: a reply carrying round K's view labeled
+        # K-1 would silently skew the client's uplink reference chain.
+        if self.wire_codec.identity:
+            # Counter BEFORE payload: racing the engine's install may
+            # under-label (client re-applies an identical view later —
+            # harmless) but never over-label (which would make the
+            # client dedup-skip the real round).
+            current = int(self.global_iterations) - 1
+            avg = self.last_average
+            if avg is None or current < 0 or (
+                not reset and acked >= current
+            ):
+                # Nothing new (or nothing aggregated yet): an empty
+                # marker the client recognizes by round <= applied. An
+                # owed session reset still rides it (bare reset order).
+                return pb.Aggregate(
+                    round=max(current, claimed, 0), reset_session=reset,
+                )
+            # One encode per installed average, not one per push: up to
+            # N concurrent replies between two aggregations would each
+            # rebuild the identical full-model bundle on gRPC threads —
+            # O(model bytes) per push at 10^4 clients. Keyed by the
+            # average's OBJECT identity, so a rollback/recovery
+            # reinstall (always a fresh dict) invalidates naturally;
+            # the benign race mirrors the under-label rule above
+            # (last-writer-wins, same content). This also matches the
+            # delta path's accounting: bundle_for counts encode bytes
+            # once per distinct bundle, not per recipient.
+            memo = self._push_identity_memo
+            if memo is None or memo[0] is not avg or memo[1] != current:
+                memo = (avg, current, codec.flatdict_to_bundle(avg, metrics=m))
+                self._push_identity_memo = memo
+            agg = pb.Aggregate(
+                shared=memo[2], round=current, reset_session=reset,
+            )
+        else:
+            with self._codec_lock:
+                # The canonical chain's own round is the authoritative
+                # tag for the bundle bundle_for() serves — never the
+                # separately-read iteration counter. Also covers crash
+                # recovery: a restored server has last_average but a
+                # fresh chain (last_round=-1) until its first
+                # aggregation — empty markers until then, not a
+                # bundle_for-before-advance error.
+                current = self._downlink_enc.last_round
+                if current < 0 or (not reset and acked >= current):
+                    # A bare reset order still rides the empty marker: a
+                    # recovered server has nothing aggregated to send
+                    # yet, but the client must drop its pre-crash codec
+                    # sessions BEFORE its next uplink encode or no
+                    # post-recovery update can ever decode (the first
+                    # aggregation would wait on an uplink that can only
+                    # ReferenceMismatch — a deadlock).
+                    return pb.Aggregate(
+                        round=max(current, claimed, 0), reset_session=reset,
+                    )
+                bundle = self._downlink_enc.bundle_for(
+                    None if reset else (acked if acked >= 0 else None)
+                )
+            agg = pb.Aggregate(
+                shared=bundle, round=current, reset_session=reset,
+            )
+        with self._push_lock:
+            self._push_sent[cid] = current
+        return agg
+
+    def _advance_broadcast(
+        self, average: dict[str, np.ndarray], iteration: int
+    ) -> None:
+        """Push pacing: advance the canonical broadcast chain for a round
+        with no immediate recipients — members pick the round up
+        (per-recipient encoded) in their next PushUpdate replies."""
+        if self.wire_codec.identity:
+            return
+        with self._codec_lock:
+            _bundle, view = self._downlink_enc.advance(
+                average, round_idx=iteration
+            )
+            self._uplink_dec.note_push(iteration, view)
 
     # ---- phase-2 training loop (server.py:408-553) -------------------------
     def _stub_for(self, stubs: dict, rec) -> rpc.ServiceStub | None:
@@ -1420,14 +1680,13 @@ class FederatedServer:
         aggregator's mean stage)."""
         self._ensure_template()
         m = self.metrics
-        records: dict[int, Any] = {}
-        losses: dict[int, float] = {}
-        candidates: list[tuple[int, float, dict[str, np.ndarray]]] = []
+        deduped: list = []
         for rec, reply in replies:
-            # Idempotent-RPC guard: a replayed StepReply (a delivery the
-            # client answered from its replay cache, or any duplicate of
-            # a seq this loop already consumed) must not enter the
-            # average twice — one step, one vote.
+            # Idempotent-RPC guard (root-only — the relay's upstream seq
+            # guard lives in its own servicer): a replayed StepReply (a
+            # delivery the client answered from its replay cache, or any
+            # duplicate of a seq this loop already consumed) must not
+            # enter the average twice — one step, one vote.
             seq = int(reply.seq)
             if seq and self._reply_seen.get(rec.client_id, 0) >= seq:
                 self.logger.warning(
@@ -1444,60 +1703,39 @@ class FederatedServer:
                 continue
             if seq:
                 self._reply_seen[rec.client_id] = seq
-            try:
-                if self.wire_codec.identity:
-                    snap = codec.bundle_to_flatdict(reply.shared, metrics=m)
-                else:
-                    snap = self._uplink_dec.decode(reply.shared)
-            except CodecError as err:
-                # A reply the negotiated codec cannot decode (usually a
-                # delta against a broadcast older than the reference
-                # cache) costs the round one contributor; the client still
-                # receives this round's push, which re-syncs its
-                # reference.
-                self.logger.warning(
-                    "round %d: client %d reply not decodable (%s); "
-                    "excluding it from the average",
-                    iteration, rec.client_id, err,
-                )
-                if m is not None:
-                    m.registry.counter("codec_ref_miss").inc()
-                    m.log(
-                        "codec_ref_miss", client=rec.client_id,
-                        ref_round=int(reply.shared.ref_round) - 1,
-                        round=iteration,
-                    )
-                continue
-            records[rec.client_id] = rec
-            losses[rec.client_id] = float(reply.loss)
-            weight = float(reply.nr_samples) or rec.nr_samples
-            if weight_scale is not None:
-                weight *= float(weight_scale.get(rec.client_id, 1.0))
-            candidates.append((rec.client_id, weight, snap))
+            deduped.append((rec, reply))
 
-        result = self.update_gate.admit_round(
-            candidates, self._current_global(), iteration,
-            staleness=staleness,
-        )
-        # Repeat offenders enter probation exactly like transport failures:
-        # backoff, then the permanent drop — a client that only ever sends
-        # poison must leave the federation in bounded time.
-        for rej in result.rejected:
-            rec = records[rej.client_id]
-            if (
-                self.update_gate.consecutive(rej.client_id)
-                >= self.update_gate.suspect_after
-            ):
-                self._note_client_failure(
-                    rec, rec.address, iteration,
-                    RuntimeError(f"{rej.reason}: {rej.detail}"),
-                    "update admission", reason="poisoned",
-                )
-        # Admission-scoped recovery (see docstring).
-        for client_id, _w, _s in result.accepted:
-            if client_id in was_suspect and self.federation.mark_recovered(
-                client_id
-            ):
+        if self.wire_codec.identity:
+            def decode(bundle):
+                return codec.bundle_to_flatdict(bundle, metrics=m)
+        else:
+            decode = self._uplink_dec.decode
+
+        def on_decode_error(rec, err):
+            # A reply the negotiated codec cannot decode (usually a delta
+            # against a broadcast older than the reference cache) costs
+            # the round one contributor; the client still receives this
+            # round's push, which re-syncs its reference.
+            self.logger.warning(
+                "round %d: client %d reply not decodable (%s); "
+                "excluding it from the average",
+                iteration, rec.client_id, err,
+            )
+
+        def on_poisoned(rec, rej):
+            # Repeat offenders enter probation exactly like transport
+            # failures: backoff, then the permanent drop — a client that
+            # only ever sends poison must leave the federation in bounded
+            # time.
+            self._note_client_failure(
+                rec, rec.address, iteration,
+                RuntimeError(f"{rej.reason}: {rej.detail}"),
+                "update admission", reason="poisoned",
+            )
+
+        def on_recovered(client_id):
+            # Admission-scoped recovery (see docstring).
+            if self.federation.mark_recovered(client_id):
                 self.logger.info(
                     "client %d recovered (update admitted at round %d)",
                     client_id, iteration,
@@ -1508,6 +1746,14 @@ class FederatedServer:
                         "client_recovered", client=client_id,
                         round=iteration,
                     )
+
+        result, losses, _records = decode_and_admit(
+            deduped, decode, self.update_gate, self._current_global(),
+            iteration, metrics=m, was_suspect=was_suspect,
+            weight_scale=weight_scale, staleness=staleness,
+            on_decode_error=on_decode_error, on_poisoned=on_poisoned,
+            on_recovered=on_recovered,
+        )
         self._round_accepted = [
             (client_id, weight, losses[client_id])
             for client_id, weight, _snap in result.accepted
@@ -1523,38 +1769,39 @@ class FederatedServer:
 
     def _encode_push(
         self, average: dict[str, np.ndarray], iteration: int, replies: list
-    ) -> pb.Aggregate:
-        """Encode one round's push through the negotiated wire codec. A
-        delta-encoded push is only legal when every recipient holds the
-        encoder's delta reference — the immediately-previous broadcast
-        (cohort/async recipients may instead hold older broadcasts, in
-        which case the push is self-contained); otherwise the push is
-        self-contained. The client-held view of this push becomes an
-        uplink delta reference for the following rounds. A pending
-        session reset (divergence rollback) rides out on this push's
-        ``reset_session`` flag."""
+    ) -> "dict[int, pb.Aggregate]":
+        """Encode one round's push **per recipient** through the negotiated
+        wire codec (README "Hierarchical federation & wire efficiency").
+
+        The downlink's canonical view chain advances once per round (the
+        consecutive-round delta the PR 3 stream always was); each
+        recipient then gets the bundle matched to *its own* last-acked
+        reference (``_push_acked``): the shared chain bundle when it is
+        up to date, an exact catch-up bundle when it holds an older
+        cached view (rotating cohorts keep delta+topk compression), and
+        a self-contained view bundle when it holds nothing usable —
+        replacing PR 9's fleet-consensus rule, under which one stale
+        recipient forced a self-contained push on everyone. Recipients
+        sharing a reference share one encoded bundle, so the encode cost
+        per round is O(distinct references in the cohort), not O(cohort).
+        A pending session reset (divergence rollback / crash recovery)
+        rides out on every recipient's ``reset_session`` flag with a
+        reference-free bundle."""
         reset_session = self._session_reset_pending
         self._session_reset_pending = False
+        recipients = [rec.client_id for rec, _reply in replies]
         if self.wire_codec.identity:
-            return pb.Aggregate(
-                shared=codec.flatdict_to_bundle(average, metrics=self.metrics),
-                round=iteration, reset_session=reset_session,
+            return encode_push_for_recipients(
+                None, None, average, iteration, recipients, {},
+                reset_session, metrics=self.metrics,
             )
-        repliers = {rec.client_id for rec, _reply in replies}
         with self._push_lock:
             acked = dict(self._push_acked)
-        ref_round = self._downlink_enc.last_round
-        allow_delta = (
-            ref_round >= 0 and bool(repliers)
-            and all(acked.get(cid) == ref_round for cid in repliers)
-        )
-        bundle, client_view = self._downlink_enc.encode(
-            average, round_idx=iteration, allow_delta=allow_delta
-        )
-        self._uplink_dec.note_push(iteration, client_view)
-        return pb.Aggregate(
-            shared=bundle, round=iteration, reset_session=reset_session,
-        )
+        with self._codec_lock:
+            return encode_push_for_recipients(
+                self._downlink_enc, self._uplink_dec, average, iteration,
+                recipients, acked, reset_session, metrics=self.metrics,
+            )
 
     def _divergence_rollback(
         self, iteration: int, verdict: str
@@ -1622,10 +1869,21 @@ class FederatedServer:
         # orders them to reset theirs via Aggregate.reset_session.
         with self._push_lock:
             self._push_acked.clear()
+            self._push_sent.clear()
+            if self.pacing.policy == "push":
+                # Reply-delivered resets: every unfinished member owes a
+                # session reset that rides its PushUpdate replies until
+                # it demonstrably applied a post-rollback round.
+                self._reset_owed = {
+                    c.client_id: iteration
+                    for c in self.federation.get_clients()
+                    if not c.finished
+                }
         self._session_reset_pending = True
         if not self.wire_codec.identity:
-            self._uplink_dec.reset()
-            self._downlink_enc.reset()
+            with self._codec_lock:
+                self._uplink_dec.reset()
+                self._downlink_enc.reset()
         # A coherence-collapse verdict can arrive with the loss/norm
         # guardian disabled (divergence_patience=0) — there is then no
         # streak-weight attribution, so nobody is quarantined.
@@ -1820,6 +2078,36 @@ class FederatedServer:
             )
         self._stopping.wait(self.round_backoff_s)
 
+    def _size_codec_caches(self) -> None:
+        """Size both codec reference caches at training start.
+
+        Cohort/async/push recipients sync at different rounds, so uplink
+        deltas may reference broadcasts much older than the sync default
+        cache depth — size the caches to the rotation period (every
+        client is re-polled within ~N/K aggregations in expectation) so
+        ``codec_ref_miss`` stays 0 — but CAP them at
+        ``codec_ref_cache_max``: the auto-size is O(N) at fixed K, and
+        server memory must not scale with the population (ISSUE 11).
+        Past the cap, a long-unsampled client costs one self-contained
+        push / one loud ReferenceMismatch heal instead of cache
+        growth."""
+        if self.pacing.policy == "sync" or self.wire_codec.identity:
+            return
+        fan = max(self.pacing.cohort_size, self.pacing.buffer_size, 1)
+        sized = max(
+            self._uplink_dec.max_refs,
+            4 * math.ceil(max(1, len(self.federation)) / fan),
+        )
+        capped = min(sized, max(1, self.codec_ref_cache_max))
+        if capped < sized:
+            self.logger.info(
+                "codec reference cache capped at %d (rotation-aware "
+                "size would be %d): long-unsampled clients degrade to "
+                "self-contained pushes", capped, sized,
+            )
+        self._uplink_dec.max_refs = capped
+        self._downlink_enc.max_views = capped
+
     def _run_training(self) -> None:
         # Recovery grace clock starts when training actually resumes (the
         # resume-ready quorum was just met) — not at restore time, which
@@ -1863,22 +2151,7 @@ class FederatedServer:
         # sized by the engine (a K-cohort never needs more than K
         # threads), created once for the whole training run.
         self._engine = pacing.make_engine(self, self.pacing)
-        if (
-            self.pacing.policy != "sync"
-            and not self.wire_codec.identity
-        ):
-            # Cohort/async recipients sync at different rounds, so uplink
-            # deltas may reference broadcasts much older than the sync
-            # default cache depth — size the reference cache to the
-            # rotation period (every client is re-polled within ~N/K
-            # aggregations in expectation) so codec_ref_miss stays 0.
-            fan = max(
-                self.pacing.cohort_size, self.pacing.buffer_size, 1
-            )
-            self._uplink_dec.max_refs = max(
-                self._uplink_dec.max_refs,
-                4 * math.ceil(max(1, len(self.federation)) / fan),
-            )
+        self._size_codec_caches()
         pool = ThreadPoolExecutor(
             max_workers=self._engine.pool_workers(self.poll_workers)
         )

@@ -59,7 +59,9 @@ from __future__ import annotations
 
 import bisect
 import contextlib
+import heapq
 import contextvars
+import inspect
 import itertools
 import json
 import os
@@ -109,6 +111,14 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "codec_negotiated": frozenset({"client", "codec"}),
     "codec_mismatch": frozenset({"client", "server_codec", "client_codec"}),
     "codec_ref_miss": frozenset({"client", "ref_round"}),
+    # bounded reference caches + wire-efficient scale-out (per-recipient
+    # delta encoding, push pacing, relay tier; README "Hierarchical
+    # federation & wire efficiency")
+    "codec_ref_evicted": frozenset({"direction", "round", "age"}),
+    "push_aggregated": frozenset({"round", "buffered", "admitted"}),
+    "relay_joined": frozenset({"relay", "members", "weight"}),
+    "relay_preaggregated": frozenset({"relay", "round", "members",
+                                      "admitted"}),
     # cross-process observability plane (README "Distributed tracing & ops
     # endpoint"): trace identity, live ops endpoint, device profiler window,
     # straggler analytics
@@ -591,6 +601,19 @@ MODEL_QUALITY_EVENTS: tuple[str, ...] = (
     "topic_drift",
 )
 
+#: Wire-efficient scale-out events (bounded reference-cache evictions,
+#: push-paced aggregations, the relay tier — README "Hierarchical
+#: federation & wire efficiency"). Same reverse-lint contract: graftlint
+#: verifies each keeps an emission call site, so the scale plane's
+#: telemetry (which BENCH_SCALE reproducibility depends on) can never be
+#: silently disconnected.
+SCALEOUT_EVENTS: tuple[str, ...] = (
+    "codec_ref_evicted",
+    "push_aggregated",
+    "relay_joined",
+    "relay_preaggregated",
+)
+
 
 def new_trace_id() -> str:
     """A fresh 16-hex-char trace id (one federation training run)."""
@@ -935,6 +958,76 @@ def collect_data_plane(records: list[dict[str, Any]]) -> dict[str, Any]:
         "rollbacks": rollbacks,
         "quarantines": quarantines,
     }
+
+
+def collect_wire_tiers(
+    node_records: "dict[str, list[dict[str, Any]]]"
+) -> dict[str, dict[str, Any]]:
+    """Per-node (per-tier) wire accounting from each stream's LAST
+    ``metrics_snapshot`` (registries are cumulative): bytes moved raw vs
+    compressed per direction, the resulting compression ratios, and the
+    per-recipient-encoding counters (catch-up / self-contained pushes,
+    reference evictions). In a hierarchical topology each relay and the
+    root write their own ``metrics.jsonl``, so feeding them all to
+    ``summarize``/``report`` reproduces the BENCH_SCALE per-tier numbers
+    from JSONL alone (README "Hierarchical federation & wire
+    efficiency")."""
+    out: dict[str, dict[str, Any]] = {}
+    for node, records in sorted(node_records.items()):
+        last: dict[str, dict] = {}
+        for r in records:
+            if r.get("event") == "metrics_snapshot":
+                for name, snap in (r.get("metrics") or {}).items():
+                    last[name] = snap
+
+        def cval(name: str) -> float:
+            snap = last.get(name)
+            if snap is None or snap.get("type") != "counter":
+                return 0.0
+            return float(snap.get("value") or 0.0)
+
+        sent_raw, sent = (
+            cval("uncompressed_bytes_sent"), cval("compressed_bytes_sent")
+        )
+        recv_raw, recv = (
+            cval("uncompressed_bytes_recv"), cval("compressed_bytes_recv")
+        )
+        out[node] = {
+            "sent_bytes": sent,
+            "sent_raw_bytes": sent_raw,
+            "ratio_sent": (sent_raw / sent) if sent else None,
+            "recv_bytes": recv,
+            "recv_raw_bytes": recv_raw,
+            "ratio_recv": (recv_raw / recv) if recv else None,
+            "rpc_bytes_sent": cval("rpc_bytes_sent"),
+            "rpc_bytes_recv": cval("rpc_bytes_recv"),
+            "catchup_pushes": cval("codec_catchup_pushes"),
+            "selfcontained_pushes": cval("codec_selfcontained_pushes"),
+            "refs_evicted": cval("codec_refs_evicted"),
+        }
+    return out
+
+
+def format_wire_tiers(tiers: dict[str, dict[str, Any]]) -> str:
+    """Render :func:`collect_wire_tiers` as the per-tier table the
+    ``summarize``/``report`` CLIs append when fed multiple streams."""
+    lines = ["wire accounting per tier:"]
+    lines.append(
+        f"  {'node':<16}{'sent':>12}{'ratio':>8}{'recv':>12}{'ratio':>8}"
+        f"{'catchup':>9}{'selfcont':>10}{'evicted':>9}"
+    )
+    for node, t in tiers.items():
+        sent = t["sent_bytes"] or t["rpc_bytes_sent"]
+        recv = t["recv_bytes"] or t["rpc_bytes_recv"]
+        rs = f"{t['ratio_sent']:.2f}x" if t["ratio_sent"] else "-"
+        rr = f"{t['ratio_recv']:.2f}x" if t["ratio_recv"] else "-"
+        lines.append(
+            f"  {node:<16}{_fmt_bytes(sent):>12}{rs:>8}"
+            f"{_fmt_bytes(recv):>12}{rr:>8}"
+            f"{t['catchup_pushes']:>9.0f}{t['selfcontained_pushes']:>10.0f}"
+            f"{t['refs_evicted']:>9.0f}"
+        )
+    return "\n".join(lines)
 
 
 def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
@@ -1487,6 +1580,20 @@ def render_prometheus(snapshot: dict[str, Any],
     return "\n".join(lines) + "\n"
 
 
+def _accepts_kwarg(fn, name: str) -> bool:
+    """True when ``fn`` can be called with keyword ``name``."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins / C callables: no signature
+        return False
+    p = params.get(name)
+    if p is not None:
+        return p.kind is not inspect.Parameter.VAR_POSITIONAL
+    return any(
+        q.kind is inspect.Parameter.VAR_KEYWORD for q in params.values()
+    )
+
+
 class OpsServer:
     """Live ops endpoint: a stdlib ``ThreadingHTTPServer`` on a daemon
     thread serving
@@ -1495,7 +1602,11 @@ class OpsServer:
     - ``/metrics`` — Prometheus text exposition of the registry
       (:func:`render_prometheus`);
     - ``/status`` — JSON from ``status_fn`` (the federation server's live
-      round / membership / codec view).
+      round / membership / codec view). ``/status?full=1`` passes
+      ``full=True`` through to ``status_fn`` (the federation server then
+      serves the complete per-client roster instead of the bounded
+      summary); a ``status_fn`` that takes no ``full`` kwarg is called
+      plain — older callers keep working.
 
     Entirely out of the training hot path: no thread is started unless
     :meth:`start` is called, and handlers only *read* registry snapshots.
@@ -1519,7 +1630,7 @@ class OpsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/healthz":
                         code, ctype, body = 200, "text/plain", b"ok\n"
@@ -1529,7 +1640,19 @@ class OpsServer:
                         ctype = "text/plain; version=0.0.4"
                         body = text.encode()
                     elif path == "/status":
-                        status = ops.status_fn() if ops.status_fn else {}
+                        full = "full=1" in query.split("&")
+                        if ops.status_fn is None:
+                            status = {}
+                        elif full and _accepts_kwarg(ops.status_fn, "full"):
+                            # Detected by signature, not by calling and
+                            # catching TypeError — that would also eat a
+                            # TypeError raised INSIDE status_fn and
+                            # silently serve the summary view instead.
+                            status = ops.status_fn(full=True)
+                        else:
+                            # status_fn without a full kwarg (older
+                            # callers / test fixtures) serves its one view
+                            status = ops.status_fn()
                         code, ctype = 200, "application/json"
                         body = json.dumps(
                             status, default=str, indent=1
@@ -1676,6 +1799,27 @@ class StragglerDetector:
             return {
                 str(cid): dict(state)
                 for cid, state in sorted(self._current.items(), key=str)
+            }
+
+    def summary(self, top_k: int = 5) -> dict[str, Any]:
+        """Bounded view for the default ``/status`` scrape: counts plus
+        the ``top_k`` slowest EWMAs. One heap pass over the live map —
+        the full per-client materialize-and-sort that :meth:`status`
+        does would stall the ops thread at 10⁴ clients (ISSUE 11
+        satellite); only the ``top_k`` winners are copied out."""
+        with self._lock:
+            top = heapq.nlargest(
+                top_k, self._current.items(),
+                key=lambda kv: (kv[1].get("ewma_s") or 0.0, str(kv[0])),
+            )
+            return {
+                "observed": len(self._current),
+                "flagged": sum(
+                    1 for v in self._current.values() if v.get("straggler")
+                ),
+                "top_slowest": [
+                    {"client": str(cid), **state} for cid, state in top
+                ],
             }
 
 

@@ -52,7 +52,8 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_data_plane.py tests/test_device_agg.py \
     tests/test_metrics.py tests/test_quality_plane.py \
     tests/test_analysis.py tests/test_pacing.py \
-    tests/test_survival.py tests/chaos/test_process_chaos.py \
+    tests/test_survival.py tests/test_scaleout.py \
+    tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
 
 if [ "${CHAOS:-0}" = "1" ]; then

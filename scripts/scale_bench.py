@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Control-plane scale bench: the BENCH_SCALE artifact (ISSUE 11).
+
+Measures, on the simulated-client loopback fleet
+(:mod:`gfedntm_tpu.federation.simfleet` — real wire/codec/gate/registry/
+pacing planes, stubbed learning), how server peak RSS and per-round wire
+bytes scale with the population N at fixed per-round fan K:
+
+- ``cohort`` (K-of-N sampling) and ``push`` (client-initiated rounds,
+  buffer B=K) must stay FLAT in N — the ISSUE 11 acceptance bar is
+  <= 1.2x from N=1k to N=10k;
+- the ``sync`` all-clients barrier is the baseline that grows ~N/1k x.
+
+Each configuration runs in its OWN subprocess so ``ru_maxrss`` (a
+process-lifetime high-water mark) cannot leak across configurations.
+
+A second, in-process measurement drives the per-recipient downlink
+encoder through a rotating K-of-N cohort and compares its sent bytes
+against the PR 10 fleet-consensus behaviour (rotation => every push
+self-contained): the acceptance bar is a > 2x measured reduction.
+
+Usage:
+    python scripts/scale_bench.py                 # full matrix -> BENCH_SCALE_r01.json
+    python scripts/scale_bench.py --single cohort 1000 16 6   # one config, JSON line
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+
+OUT_PATH = os.path.join(REPO, "BENCH_SCALE_r01.json")
+
+#: (mode, N, fan K/B, rounds). Sync runs fewer rounds — each one touches
+#: the whole population.
+MATRIX = [
+    ("cohort", 1_000, 16, 6),
+    ("cohort", 10_000, 16, 6),
+    ("push", 1_000, 16, 6),
+    ("push", 10_000, 16, 6),
+    ("sync", 1_000, 0, 2),
+    ("sync", 10_000, 0, 2),
+]
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def run_single(mode: str, n: int, fan: int, rounds: int) -> dict:
+    """One configuration in THIS process; returns the result record."""
+    import tempfile
+
+    from gfedntm_tpu.federation.simfleet import make_sim_fleet
+
+    rss_before = _rss_mb()
+    save_dir = tempfile.mkdtemp(prefix=f"scale-{mode}-{n}-")
+    pacing = {
+        "cohort": f"cohort:{fan}",
+        "push": f"push:{fan}",
+        "sync": "sync",
+    }[mode]
+    t0 = time.perf_counter()
+    server, servicers, template = make_sim_fleet(
+        n,
+        steps=rounds + 2,  # nobody finishes before max_iters ends the run
+        pacing_policy=pacing,
+        max_iters=rounds,
+        save_dir=save_dir,
+        checkpoint_every=0,
+        journal_every=0,
+        round_backoff_s=0.02,
+    )
+    setup_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    counter = server.byte_counter
+
+    def rounds_done_now() -> bool:
+        return int(server.global_iterations) >= rounds
+
+    round_bytes = None
+    if mode == "push":
+        # Single-threaded driver: round-robin client-initiated pushes
+        # into the live PushEngine until it completes max_iters
+        # aggregations (subsequent pushes answer stop=True).
+        order = sorted(servicers)
+        i = 0
+        while not server.training_done.is_set() and not rounds_done_now():
+            engine = server._engine
+            if engine is not None:
+                # Real clients push at their local-round cadence, so the
+                # buffer hovers near B; an unthrottled driver would grow
+                # the drain with the engine's O(N) tick time and measure
+                # itself, not the server.
+                while (
+                    engine.status().get("buffer_depth", 0) >= fan
+                    and not server.training_done.is_set()
+                    and not rounds_done_now()
+                ):
+                    time.sleep(0.001)
+            cid = order[i % len(order)]
+            i += 1
+            servicer = servicers[cid]
+            if servicer.finished:
+                continue
+            update = servicer.build_update(template)
+            agg = server.PushUpdate(update, None)
+            counter.note(agg, update)
+            servicer.apply(agg)
+        # Round-attributable bytes: snapshot BEFORE the stop broadcast
+        # (a one-time O(N) fan-out of ~10-byte stop messages that is not
+        # per-round cost).
+        round_bytes = counter.sent + counter.recv
+        server.wait_done(timeout=600)
+    else:
+        while not server.training_done.is_set():
+            if rounds_done_now() and round_bytes is None:
+                round_bytes = counter.sent + counter.recv
+            if server.training_done.wait(0.05):
+                break
+        assert server.wait_done(timeout=900), f"{mode} N={n} did not finish"
+        if round_bytes is None:
+            round_bytes = counter.sent + counter.recv
+    run_s = time.perf_counter() - t1
+    rounds_done = int(server.global_iterations)
+    server.stop()
+    return {
+        "mode": mode,
+        "n_clients": n,
+        "fan": fan,
+        "rounds": rounds_done,
+        "peak_rss_mb": round(_rss_mb(), 1),
+        "rss_before_mb": round(rss_before, 1),
+        "bytes_per_round": round_bytes / max(1, rounds_done),
+        "loopback_calls": counter.calls,
+        "setup_s": round(setup_s, 2),
+        "run_s": round(run_s, 2),
+    }
+
+
+def rotation_codec_measurement(
+    n: int = 48, k: int = 8, rounds: int = 48, d: int = 40_000,
+    codec_spec: str = "delta+topk:0.02",
+) -> dict:
+    """Per-recipient delta encoding vs the PR 10 fleet-consensus rule
+    under a rotating K-of-N cohort, measured at the session level: the
+    new encoder serves chain deltas + exact catch-ups; the old rule
+    degraded every rotating-cohort push to a self-contained bundle."""
+    import numpy as np
+
+    from gfedntm_tpu.federation.compression import DownlinkEncoder, WireCodec
+
+    rng = np.random.default_rng(0)
+    state = {"plane": rng.standard_normal(d).astype(np.float32)}
+    wc = WireCodec(codec_spec)
+    enc_new = DownlinkEncoder(wc, max_views=4 * math.ceil(n / k))
+    enc_old = DownlinkEncoder(WireCodec(codec_spec))
+    acked: dict[int, int] = {}
+    bytes_new = 0
+    bytes_old = 0
+    for r in range(rounds):
+        state = {
+            "plane": state["plane"]
+            + 1e-3 * rng.standard_normal(d).astype(np.float32)
+        }
+        enc_new.advance(state, r)
+        cohort = [(r * k + j) % n for j in range(k)]  # strict rotation
+        for cid in cohort:
+            bundle = enc_new.bundle_for(acked.get(cid))
+            bytes_new += bundle.ByteSize()
+            acked[cid] = r
+        # PR 10 rule: a rotating cohort never has every recipient on the
+        # previous broadcast, so every push was self-contained.
+        old_bundle, _view = enc_old.encode(state, r, allow_delta=False)
+        bytes_old += old_bundle.ByteSize() * k
+    return {
+        "n_clients": n,
+        "k": k,
+        "rounds": rounds,
+        "tensor_elems": d,
+        "codec": codec_spec,
+        "sent_bytes_per_recipient_encoding": bytes_new,
+        "sent_bytes_selfcontained_pr10": bytes_old,
+        "sent_bytes_ratio": round(bytes_old / max(1, bytes_new), 2),
+    }
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--single":
+        mode, n, fan, rounds = (
+            sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+            int(sys.argv[5]),
+        )
+        print(json.dumps(run_single(mode, n, fan, rounds)))
+        return 0
+
+    configs = []
+    for mode, n, fan, rounds in MATRIX:
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--single", mode, str(n), str(fan), str(rounds),
+        ]
+        print(f"== {mode} N={n} fan={fan} rounds={rounds}", file=sys.stderr)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800, env=env,
+        )
+        if out.returncode != 0:
+            print(out.stderr[-2000:], file=sys.stderr)
+            raise SystemExit(f"config {mode} N={n} failed")
+        configs.append(json.loads(out.stdout.strip().splitlines()[-1]))
+        print(json.dumps(configs[-1]), file=sys.stderr)
+
+    by = {(c["mode"], c["n_clients"]): c for c in configs}
+
+    def ratio(mode, key):
+        lo, hi = by[(mode, 1_000)][key], by[(mode, 10_000)][key]
+        return round(hi / max(lo, 1e-9), 2)
+
+    rotation = rotation_codec_measurement()
+    result = {
+        "bench": "scale",
+        "rev": "r01",
+        "host": os.uname().nodename,
+        "configs": configs,
+        "ratios_10k_over_1k": {
+            "cohort_rss": ratio("cohort", "peak_rss_mb"),
+            "cohort_bytes_per_round": ratio("cohort", "bytes_per_round"),
+            "push_rss": ratio("push", "peak_rss_mb"),
+            "push_bytes_per_round": ratio("push", "bytes_per_round"),
+            "sync_rss": ratio("sync", "peak_rss_mb"),
+            "sync_bytes_per_round": ratio("sync", "bytes_per_round"),
+        },
+        "rotation_codec": rotation,
+        "acceptance": {
+            "fixed_fan_rss_flat_1p2x": (
+                ratio("cohort", "peak_rss_mb") <= 1.2
+                and ratio("push", "peak_rss_mb") <= 1.2
+            ),
+            "fixed_fan_bytes_flat_1p2x": (
+                ratio("cohort", "bytes_per_round") <= 1.2
+                and ratio("push", "bytes_per_round") <= 1.2
+            ),
+            "sync_bytes_grow_5x": (
+                ratio("sync", "bytes_per_round") >= 5.0
+            ),
+            "rotation_ratio_over_2x": (
+                rotation["sent_bytes_ratio"] > 2.0
+            ),
+        },
+    }
+    with open(OUT_PATH, "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(result["ratios_10k_over_1k"]))
+    print(json.dumps(result["acceptance"]))
+    print(f"wrote {OUT_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,19 +170,29 @@ class TestFederatedServerStatus:
             assert status["codec"] == "delta+fp16"
             assert status["aggregator"] == "fedadam"
             assert status["min_clients"] == 2
-            assert status["clients"] == []
-            assert status["stragglers"] == {}
+            # default view is the bounded SUMMARY (ISSUE 11): counts per
+            # state, not an O(N) per-client roster
+            assert status["clients"]["total"] == 0
+            assert status["clients"]["by_status"] == {}
+            assert status["stragglers"] == {
+                "observed": 0, "flagged": 0, "top_slowest": [],
+            }
             assert status["compression"] == {
                 "ratio_sent": None, "ratio_recv": None,
             }
-            # membership appears as soon as a client registers
+            # membership appears as soon as a client registers; the full
+            # roster stays behind ?full=1
             server.federation.connect_vocab(5, ("tok",), 12.0)
             status = json.loads(_get(base + "/status")[2])
-            (rec,) = status["clients"]
+            assert status["clients"]["total"] == 1
+            assert status["clients"]["by_status"] == {"active": 1}
+            full = json.loads(_get(base + "/status?full=1")[2])
+            (rec,) = full["clients"]
             assert rec["client_id"] == 5
             assert rec["status"] == "active"
             assert rec["nr_samples"] == 12.0
             assert rec["last_loss"] is None  # NaN must serialize as null
+            assert full["stragglers"] == {}  # the raw per-client map
             (started,) = metrics.events("ops_server_started")
             assert started["port"] == server.ops_actual_port
         finally:

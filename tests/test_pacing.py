@@ -442,23 +442,29 @@ def test_push_ack_round_tags_gate_delta_encoding():
     rec1, rec2 = ClientRecord(1), ClientRecord(2)
     reply = pb.StepReply(client_id=1)
 
-    # round 0: nobody holds a broadcast -> self-contained
-    agg0 = server._encode_push(tmpl, 0, [(rec1, reply), (rec2, reply)])
-    assert agg0.shared.ref_round == 0
-    # both recipients acked round 0 -> round 1 may delta against it
+    # round 0: nobody holds a broadcast -> self-contained for everyone
+    aggs0 = server._encode_push(tmpl, 0, [(rec1, reply), (rec2, reply)])
+    assert aggs0[1].shared.ref_round == 0
+    assert aggs0[2].shared.ref_round == 0
+    # both recipients acked round 0 -> round 1 deltas against it, and the
+    # up-to-date recipients SHARE one encoded bundle
     with server._push_lock:
         server._push_acked.update({1: 0, 2: 0})
-    agg1 = server._encode_push(tmpl, 1, [(rec1, reply), (rec2, reply)])
-    assert agg1.shared.ref_round == 1  # delta vs round 0 (1 + ref)
-    # rotating cohort: recipient 3 last acked an OLDER round -> the push
-    # must be self-contained, never a mis-decodable delta
+    aggs1 = server._encode_push(tmpl, 1, [(rec1, reply), (rec2, reply)])
+    assert aggs1[1].shared.ref_round == 1  # delta vs round 0 (1 + ref)
+    assert aggs1[1] is aggs1[2]
+    # rotating cohort (ISSUE 11): recipient 3 last acked an OLDER round —
+    # per-recipient encoding keeps the chain delta for the current
+    # recipient and serves 3 an exact catch-up against ITS round, instead
+    # of forcing a fleet-wide self-contained push
     rec3 = ClientRecord(3)
     with server._push_lock:
         server._push_acked.update({1: 1, 2: 1, 3: 0})
-    agg2 = server._encode_push(
+    aggs2 = server._encode_push(
         tmpl, 2, [(rec1, reply), (rec3, reply)]
     )
-    assert agg2.shared.ref_round == 0
+    assert aggs2[1].shared.ref_round == 2  # chain delta vs round 1
+    assert aggs2[3].shared.ref_round == 1  # catch-up vs 3's round 0
 
 
 # ---- registry + sampler scale (satellite) -----------------------------------

@@ -392,9 +392,17 @@ class TestThreeClientE2E:
         assert status["training_done"] is True
         assert status["codec"] == "none"
         assert status["trace_id"] == server.trace_id
-        assert len(status["clients"]) == n
-        assert {c["client_id"] for c in status["clients"]} == {1, 2, 3}
-        assert all(c["status"] == "active" for c in status["clients"])
+        # default /status carries the bounded membership SUMMARY
+        # (ISSUE 11); the per-client roster moved behind ?full=1
+        assert status["clients"]["total"] == n
+        assert status["clients"]["by_status"] == {"active": n}
+        with urllib.request.urlopen(
+            base + "/status?full=1", timeout=10
+        ) as resp:
+            full = json.loads(resp.read())
+        assert len(full["clients"]) == n
+        assert {c["client_id"] for c in full["clients"]} == {1, 2, 3}
+        assert all(c["status"] == "active" for c in full["clients"])
 
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(base + "/nope", timeout=10)
