@@ -46,6 +46,24 @@ baseline, staging) — the diagnosis surface for the BENCH_r03-r05 run-
 phase timeouts; set ``BENCH_PROFILE_DIR`` to additionally wrap a phase
 window in the PR 4 ``RoundProfiler`` (``BENCH_PROFILE_ROUNDS``, default
 ``1:2`` = the compile fit, phase indices in ``_BENCH_PHASES``).
+
+Staged run phase (ISSUE 12): the run phase is further split into
+sub-phases — backend_init -> data_staging -> first_step_compile ->
+steady_state (-> trace_fit) -> multichip -> torch_baseline — each with
+its own sub-deadline (``_STAGE_DEADLINES_S`` /
+``BENCH_STAGE_TIMEOUT_<NAME>``) enforced from OUTSIDE the subprocess by
+``_watch_stages`` reading the fsync'd stage file, plus a partial-summary
+flush after every completed stage. A hang therefore costs one stage's
+deadline, the breadcrumb names the hung stage (``accel_timeout_phase``),
+and the stages that completed still ship (``run_stages`` /
+``provenance: partial``). The ``multichip`` stage data-shards the whole
+corpus across the host/device mesh (``parallel.sharded.fit_data_sharded``;
+``BENCH_MESH_DEVICES``, CPU default = one device per core) and becomes
+the headline metric with MFU from live-measured FLOPs (``utils.flops``).
+``--compile_cache DIR`` / ``BENCH_COMPILE_CACHE`` wires the persistent
+XLA compilation cache; ``BENCH_TRY_BACKEND`` forces an honest
+accelerator attempt even when the probe already degraded. The final
+summary is schema-checked against ``scripts/bench_schema.py``.
 """
 
 from __future__ import annotations
@@ -126,25 +144,246 @@ def _probe_backend() -> str:
 
 # Phase indices for the run-phase RoundProfiler window (BENCH_PROFILE_DIR /
 # BENCH_PROFILE_ROUNDS): the profiler treats each bench phase as one
-# "round", so e.g. "2:3" captures a jax.profiler trace of the steady fit.
+# "round", so e.g. "2:3" captures a jax.profiler trace of the steady fit
+# and "4:5" one of the multi-chip data-sharded fit.
 _BENCH_PHASES = (
     "synthetic_corpus",        # 0
     "compile_and_first_run",   # 1
     "steady_state_fit",        # 2
     "trace_fit",               # 3
-    "torch_baseline",          # 4
+    "multichip",               # 4
+    "torch_baseline",          # 5
 )
 
 
-def run(backend: str) -> dict:
-    import jax
+# ---------------------------------------------------------------------------
+# Staged run phase (ISSUE 12 tentpole): the monolithic 720 s "run" phase is
+# split into sub-phases, each bracketed by begin/done records in a stage
+# file the ORCHESTRATOR watches from outside the process. A tunnel hang is
+# therefore killed at the hung STAGE's own sub-deadline, the breadcrumb
+# names that stage, and the partial-summary flush after every completed
+# stage means a timeout still ships the stages that finished — BENCH_r05's
+# rc=124 with parsed:null (all evidence lost) cannot recur.
+# ---------------------------------------------------------------------------
 
-    if backend in ("cpu", "unavailable"):
-        # Runtime env-var edits are invisible here: the TPU-tunnel
-        # sitecustomize imports jax config at interpreter start, snapshotting
-        # JAX_PLATFORMS. config.update is the override that actually works.
-        jax.config.update("jax_platforms", "cpu")
-        backend = "cpu"
+_RUN_STAGES = (
+    "backend_init",        # jax platform pin + device enumeration (the hang site)
+    "data_staging",        # synthetic corpus + dataset/trainer construction
+    "first_step_compile",  # warmup fit: trace + XLA compile + first run
+    "steady_state",        # timed fit over the compiled program
+    "trace_fit",           # optional untimed profiler fit
+    "multichip",           # data-sharded fit across the host/device mesh
+    "torch_baseline",      # live torch CPU reference measurement
+)
+
+#: Per-stage sub-deadlines (seconds), overridable per stage with
+#: BENCH_STAGE_TIMEOUT_<NAME> (e.g. BENCH_STAGE_TIMEOUT_BACKEND_INIT=60).
+#: first_step_compile is the widest: an unbounded first-step compile was
+#: the leading suspect for the 720 s wall this staging exists to diagnose.
+_STAGE_DEADLINES_S = {
+    "backend_init": 150.0,
+    "data_staging": 120.0,
+    "first_step_compile": 300.0,
+    "steady_state": 240.0,
+    "trace_fit": 120.0,
+    "multichip": 240.0,
+    "torch_baseline": 150.0,
+}
+
+
+def _stage_deadline(stage: str) -> float:
+    env = os.environ.get(f"BENCH_STAGE_TIMEOUT_{stage.upper()}")
+    if env:
+        return float(env)
+    return _STAGE_DEADLINES_S.get(stage, 240.0)
+
+
+class StageLog:
+    """Stage breadcrumbs + partial-summary flush for the staged run phase.
+
+    Every stage transition is appended (fsync'd) as one JSON line to
+    ``BENCH_STAGE_PATH`` so the watching orchestrator can enforce
+    per-stage sub-deadlines and a SIGKILL still leaves each completed
+    stage's timings/payload on disk; completed stages are also mirrored
+    into ``BENCH_PARTIAL_PATH`` as a ready-to-ship partial summary JSON
+    object (atomic replace). Both paths default to unset = disabled, so
+    library use of :func:`run` is unaffected."""
+
+    def __init__(self, backend: str, metrics=None):
+        self.path = os.environ.get("BENCH_STAGE_PATH") or None
+        self.partial_path = os.environ.get("BENCH_PARTIAL_PATH") or None
+        self.backend = backend
+        self.metrics = metrics
+        self.stages: "dict[str, dict]" = {}
+        self.order: "list[str]" = []
+
+    def _append(self, rec: dict) -> None:
+        if not self.path:
+            return
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as err:
+            sys.stderr.write(f"bench: stage log write failed: {err!r}\n")
+
+    def stage(self, name: str):
+        """Context manager bracketing one stage; yields a payload dict the
+        stage body may fill (banked into the done record + partial)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            # The test hook: BENCH_FAKE_HANG_STAGE=<name> turns this stage
+            # into a deliberate hang so the watchdog path is testable with
+            # the real kill/flush machinery (tests/test_bench_harness.py).
+            self._append({
+                "stage": name, "status": "begin", "wall_time": time.time(),
+                "deadline_s": _stage_deadline(name),
+            })
+            if os.environ.get("BENCH_FAKE_HANG_STAGE") == name:
+                time.sleep(3600.0)
+            t0 = time.perf_counter()
+            payload: dict = {}
+            yield payload
+            seconds = round(time.perf_counter() - t0, 3)
+            self.done(name, seconds, **payload)
+
+        return _cm()
+
+    def done(self, name: str, seconds: float, **payload) -> None:
+        rec = {"seconds": seconds, **payload}
+        self.stages[name] = rec
+        if name not in self.order:
+            self.order.append(name)
+        self._append({
+            "stage": name, "status": "done", "wall_time": time.time(),
+            **rec,
+        })
+        if self.metrics is not None:
+            self.metrics.log("bench_stage", stage=name, seconds=seconds)
+        self._flush_partial()
+
+    def summary(self) -> dict:
+        return {name: dict(self.stages[name]) for name in self.order}
+
+    def _flush_partial(self) -> None:
+        if not self.partial_path:
+            return
+        value = 0.0
+        for rec in self.stages.values():
+            if rec.get("docs_per_s"):
+                value = rec["docs_per_s"]
+        obj = {
+            "metric": "bench_run_partial",
+            "value": value,
+            "unit": "docs/s",
+            "vs_baseline": None,
+            "backend": self.backend,
+            "partial": True,
+            "stage_order": list(self.order),
+            "run_stages": self.summary(),
+        }
+        tmp = self.partial_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.partial_path)
+        except OSError as err:
+            sys.stderr.write(f"bench: partial flush failed: {err!r}\n")
+
+
+def _read_stage_file(path: str) -> "list[dict]":
+    """Parse a stage JSONL file, tolerating a torn final line (the writer
+    can be SIGKILLed mid-append)."""
+    recs: "list[dict]" = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    except OSError:
+        pass
+    return recs
+
+
+def _stage_view(recs: "list[dict]"):
+    """(completed stage names, in-flight ``(name, begin_wall_time)`` or
+    None) from a stage file's records."""
+    done = [r["stage"] for r in recs if r.get("status") == "done"]
+    done_set = set(done)
+    open_ = [
+        (r["stage"], float(r.get("wall_time", 0.0)))
+        for r in recs
+        if r.get("status") == "begin" and r["stage"] not in done_set
+    ]
+    return done, (open_[-1] if open_ else None)
+
+
+def _read_partial(path: str) -> "dict | None":
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        return obj if obj.get("run_stages") else None
+    # graftlint: disable=exception-hygiene -- an absent/torn partial file
+    # simply means "no partial evidence"; the caller reports None
+    except (OSError, ValueError):
+        return None
+
+
+def run(backend: str) -> dict:
+    stages = StageLog(backend=backend)
+    with stages.stage("backend_init") as binfo:
+        import jax
+
+        if backend in ("cpu", "unavailable"):
+            # Runtime env-var edits are invisible here: the TPU-tunnel
+            # sitecustomize imports jax config at interpreter start,
+            # snapshotting JAX_PLATFORMS. config.update is the override
+            # that actually works.
+            jax.config.update("jax_platforms", "cpu")
+            backend = "cpu"
+            # Partial summaries must name the backend the numbers were
+            # actually measured on, not the pre-degradation request —
+            # a shipped partial claiming "axon" for CPU numbers is the
+            # exact misattribution accel_attempts exists to prevent.
+            stages.backend = backend
+        cache_dir = os.environ.get("BENCH_COMPILE_CACHE") or None
+        if cache_dir:
+            # Persistent XLA compilation cache (--compile_cache /
+            # BENCH_COMPILE_CACHE): reruns replay compiles from disk, so
+            # compile timings then measure cache DEserialization — the
+            # summary records the dir so the reader knows which.
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Multi-chip mesh sizing must happen BEFORE backend init on CPU
+        # (XLA parses the forced-device flag exactly once).
+        mesh_req = int(os.environ.get("BENCH_MESH_DEVICES", "0") or 0)
+        if backend == "cpu":
+            if mesh_req == 0:
+                # Virtual devices beyond physical cores would only slice
+                # the same silicon thinner — an honest CPU multi-chip
+                # default is one device per core (cap 8, the test mesh).
+                mesh_req = min(os.cpu_count() or 1, 8)
+            if mesh_req > 1:
+                from gfedntm_tpu.parallel.mesh import ensure_virtual_devices
+
+                ensure_virtual_devices(mesh_req)
+        # Device enumeration initializes the backend — THE historical
+        # hang site on a dead tunnel, now bracketed by its own stage.
+        n_devices = len(jax.devices())
+        mesh_n = max(1, min(mesh_req or n_devices, n_devices))
+        binfo.update(
+            platform=jax.default_backend(), devices=n_devices,
+            mesh_devices=mesh_n, compilation_cache_dir=cache_dir,
+        )
 
     from gfedntm_tpu.data.datasets import BowDataset
     from gfedntm_tpu.data.synthetic import generate_synthetic_corpus
@@ -192,26 +431,29 @@ def run(backend: str) -> dict:
         rounds=os.environ.get("BENCH_PROFILE_ROUNDS", "1:2"),
         metrics=metrics,
     )
+    stages.metrics = metrics
 
     profiler.observe(_BENCH_PHASES.index("synthetic_corpus"))
-    with phase_timer(metrics, "synthetic_corpus"):
-        corpus = generate_synthetic_corpus(
-            vocab_size=vocab, n_topics=k, n_docs=docs_per_node,
-            nwords=(150, 250), n_nodes=n_clients, frozen_topics=5, seed=0,
-            materialize_docs=False,
-        )
-        idx2token = {i: f"wd{i}" for i in range(vocab)}
-        datasets = [
-            BowDataset(X=node.bow, idx2token=idx2token)
-            for node in corpus.nodes
-        ]
+    with stages.stage("data_staging") as dinfo:
+        with phase_timer(metrics, "synthetic_corpus"):
+            corpus = generate_synthetic_corpus(
+                vocab_size=vocab, n_topics=k, n_docs=docs_per_node,
+                nwords=(150, 250), n_nodes=n_clients, frozen_topics=5,
+                seed=0, materialize_docs=False,
+            )
+            idx2token = {i: f"wd{i}" for i in range(vocab)}
+            datasets = [
+                BowDataset(X=node.bow, idx2token=idx2token)
+                for node in corpus.nodes
+            ]
 
-    template = AVITM(
-        input_size=vocab, n_components=k, hidden_sizes=(50, 50),
-        batch_size=batch, num_epochs=epochs, lr=2e-3, momentum=0.99,
-        seed=0,
-    )
-    trainer = FederatedTrainer(template, n_clients=n_clients)
+        template = AVITM(
+            input_size=vocab, n_components=k, hidden_sizes=(50, 50),
+            batch_size=batch, num_epochs=epochs, lr=2e-3, momentum=0.99,
+            seed=0,
+        )
+        trainer = FederatedTrainer(template, n_clients=n_clients)
+        dinfo.update(docs=n_clients * docs_per_node, vocab=vocab)
 
     # Warmup fit: stages the corpora once (cached in the trainer) and
     # compiles the whole-run program.
@@ -220,17 +462,23 @@ def run(backend: str) -> dict:
     # after the steady fit, landing in the same registry snapshot.
     devmem = DeviceMemoryMonitor(metrics.registry)
     profiler.observe(_BENCH_PHASES.index("compile_and_first_run"))
-    t0 = time.perf_counter()
-    with phase_timer(metrics, "compile_and_first_run"):
-        warm = trainer.fit(datasets, metrics=metrics)
-        jax.block_until_ready(warm.client_params)
-    compile_s = time.perf_counter() - t0
-    devmem.sample()
-    assert np.isfinite(warm.losses).all()
-    stage_s = sum(
-        r["seconds"] for r in metrics.events("phase")
-        if r["phase"] == "stage_data"
-    )
+    with stages.stage("first_step_compile") as cinfo:
+        t0 = time.perf_counter()
+        with phase_timer(metrics, "compile_and_first_run"):
+            warm = trainer.fit(datasets, metrics=metrics)
+            jax.block_until_ready(warm.client_params)
+        compile_s = time.perf_counter() - t0
+        devmem.sample()
+        assert np.isfinite(warm.losses).all()
+        stage_s = sum(
+            r["seconds"] for r in metrics.events("phase")
+            if r["phase"] == "stage_data"
+        )
+        cinfo.update(
+            compile_and_first_run_s=round(compile_s, 2),
+            one_time_stage_data_s=round(stage_s, 3),
+            compilation_cache_dir=cache_dir,
+        )
 
     # Timed fit: staged data + compiled program are reused, so this measures
     # the schedule build (host numpy) + the compiled whole-run scan — the
@@ -240,12 +488,19 @@ def run(backend: str) -> dict:
     # untimed fit below.
     n_before = len(metrics.events("phase"))
     profiler.observe(_BENCH_PHASES.index("steady_state_fit"))
-    t0 = time.perf_counter()
-    with phase_timer(metrics, "steady_state_fit"):
-        result = trainer.fit(datasets, metrics=metrics)
-        jax.block_until_ready(result.client_params)
-    steady_s = time.perf_counter() - t0
-    devmem.sample()
+    with stages.stage("steady_state") as sinfo:
+        t0 = time.perf_counter()
+        with phase_timer(metrics, "steady_state_fit"):
+            result = trainer.fit(datasets, metrics=metrics)
+            jax.block_until_ready(result.client_params)
+        steady_s = time.perf_counter() - t0
+        devmem.sample()
+        sinfo.update(
+            docs_per_s=round(
+                float(result.losses.shape[0]) * n_clients * batch
+                / steady_s, 1,
+            ),
+        )
     # Phase accounting for the TIMED fit only (the traced fit below logs
     # its own program_segment events, which must not pollute this).
     phases = metrics.events("phase")[n_before:]
@@ -267,21 +522,56 @@ def run(backend: str) -> dict:
     traced_fit_s = None
     if trace_dir is not None:
         profiler.observe(_BENCH_PHASES.index("trace_fit"))
-        t0 = time.perf_counter()
-        try:
-            # metrics=None: profiler overhead inflates segment times ~5x,
-            # and the registry's trainer_step_s histogram is cumulative —
-            # a traced fit would skew the summarize p50/p95/p99 the same
-            # way the phase slicing above guards against.
-            with trace(trace_dir):
-                traced = trainer.fit(datasets, metrics=None)
-                jax.block_until_ready(traced.client_params)
-            traced_fit_s = round(time.perf_counter() - t0, 2)
-        except Exception as err:
-            # The failure is banked into the summary's trace_dir field
-            # AND said out loud — a trace-less bench must name why.
-            sys.stderr.write(f"bench: profiler trace failed: {err!r}\n")
-            trace_dir = f"profiler-failed-on-{backend}"
+        with stages.stage("trace_fit") as tinfo:
+            t0 = time.perf_counter()
+            try:
+                # metrics=None: profiler overhead inflates segment times
+                # ~5x, and the registry's trainer_step_s histogram is
+                # cumulative — a traced fit would skew the summarize
+                # p50/p95/p99 the same way the phase slicing above guards
+                # against.
+                with trace(trace_dir):
+                    traced = trainer.fit(datasets, metrics=None)
+                    jax.block_until_ready(traced.client_params)
+                traced_fit_s = round(time.perf_counter() - t0, 2)
+            except Exception as err:
+                # The failure is banked into the summary's trace_dir field
+                # AND said out loud — a trace-less bench must name why.
+                sys.stderr.write(f"bench: profiler trace failed: {err!r}\n")
+                trace_dir = f"profiler-failed-on-{backend}"
+            tinfo.update(trace_dir=trace_dir)
+
+    # Multi-chip data-sharded fit (ISSUE 12 tentpole): the SAME total
+    # corpus trains as one local dataset sharded over the mesh
+    # (parallel.sharded.fit_data_sharded — bucketed padding, AOT compile
+    # split, donated carried state), with MFU from live-measured
+    # per-device FLOPs. This is the headline number when it runs; set
+    # BENCH_MESH_DEVICES=1 to force single-device, 0/unset = one device
+    # per core on CPU, all devices on an accelerator.
+    multichip = None
+    profiler.observe(_BENCH_PHASES.index("multichip"))
+    with stages.stage("multichip") as minfo:
+        from gfedntm_tpu.parallel.mesh import make_param_mesh
+        from gfedntm_tpu.parallel.sharded import fit_data_sharded
+
+        mc_ds = BowDataset(
+            X=np.concatenate([node.bow for node in corpus.nodes]),
+            idx2token=idx2token,
+        )
+        mc_model = AVITM(
+            input_size=vocab, n_components=k, hidden_sizes=(50, 50),
+            batch_size=batch, num_epochs=6 if on_accel else 3, lr=2e-3,
+            momentum=0.99, seed=0, fused_decoder=False,
+        )
+        mc_mesh = make_param_mesh(axis_name="data", n_devices=mesh_n)
+        multichip = fit_data_sharded(
+            mc_model, mc_ds, mesh=mc_mesh, metrics=metrics,
+        )
+        assert np.isfinite(np.asarray(mc_model.epoch_losses)).all()
+        minfo.update(**{
+            mk: mv for mk, mv in multichip.items()
+            if isinstance(mv, (int, float, str, type(None)))
+        })
 
     global_steps = int(result.losses.shape[0])
     docs_processed = float(global_steps) * n_clients * batch
@@ -310,20 +600,32 @@ def run(backend: str) -> dict:
     # if the live run is unavailable.
     torch_docs_per_sec, torch_src = None, None
     profiler.observe(_BENCH_PHASES.index("torch_baseline"))
-    try:
-        sys.path.insert(0, os.path.join(_REPO_ROOT, "experiments_scripts"))
-        from torch_baseline import run_torch_baseline
+    with stages.stage("torch_baseline") as binfo2:
+        try:
+            sys.path.insert(
+                0, os.path.join(_REPO_ROOT, "experiments_scripts")
+            )
+            from torch_baseline import run_torch_baseline
 
-        with phase_timer(metrics, "torch_baseline"):
-            tb = run_torch_baseline(epochs=1)
-        torch_docs_per_sec, torch_src = tb["docs_per_s"], "measured-live"
-    except Exception as err:
-        sys.stderr.write(f"bench: live torch baseline failed: {err!r}\n")
-        artifact = os.path.join(_REPO_ROOT, "results/torch_baseline.json")
-        if os.path.exists(artifact):
-            with open(artifact) as f:
-                torch_docs_per_sec = json.load(f)["docs_per_s"]
-            torch_src = "committed-artifact"
+            with phase_timer(metrics, "torch_baseline"):
+                tb = run_torch_baseline(epochs=1)
+            torch_docs_per_sec, torch_src = (
+                tb["docs_per_s"], "measured-live",
+            )
+        except Exception as err:
+            sys.stderr.write(
+                f"bench: live torch baseline failed: {err!r}\n"
+            )
+            artifact = os.path.join(
+                _REPO_ROOT, "results/torch_baseline.json"
+            )
+            if os.path.exists(artifact):
+                with open(artifact) as f:
+                    torch_docs_per_sec = json.load(f)["docs_per_s"]
+                torch_src = "committed-artifact"
+        binfo2.update(
+            torch_docs_per_s=torch_docs_per_sec, source=torch_src,
+        )
 
     metrics.log(
         "bench_summary", backend=backend, docs_per_sec=docs_per_sec,
@@ -389,6 +691,11 @@ def run(backend: str) -> dict:
             n_clients * batch / (program_step_ms / 1e3), 1
         ),
         "profile_trace_dir": trace_dir,
+        # The RoundProfiler window over the bench phases (BENCH_PROFILE_DIR
+        # / BENCH_PROFILE_ROUNDS) — the staged-diagnosis trace the
+        # acceptance evidence points at when the accelerator is
+        # unreachable; None = no window requested.
+        "profiler_window_dir": os.environ.get("BENCH_PROFILE_DIR") or None,
         # Wall time of the separate profiler-on fit (NOT the headline
         # measurement): the gap vs steady_state_s is profiler overhead.
         "traced_fit_s": traced_fit_s,
@@ -396,7 +703,10 @@ def run(backend: str) -> dict:
         # relaunches replay compiles from disk), this measures cache
         # deserialization, not compilation — the field below says which.
         "compile_and_first_run_s": round(compile_s, 1),
-        "compilation_cache_dir": os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        "compilation_cache_dir": (
+            os.environ.get("BENCH_COMPILE_CACHE")
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        ),
         "steady_state_s": round(steady_s, 1),
         "regime": {
             "n_clients": n_clients, "vocab": vocab, "k": k, "batch": batch,
@@ -417,6 +727,49 @@ def run(backend: str) -> dict:
     if traced_fit_s is not None:
         timings["trace_fit"] = traced_fit_s
     result["run_phase_timings"] = timings
+    # Staged sub-phase record (the ISSUE 12 diagnosis surface): per-stage
+    # wall seconds + payloads, in execution order. The same records were
+    # flushed incrementally to BENCH_STAGE_PATH/BENCH_PARTIAL_PATH, so a
+    # stage that HANGS still leaves everything before it on disk.
+    result["run_stages"] = stages.summary()
+    if multichip is not None:
+        result["multichip"] = multichip
+        if multichip.get("docs_per_s"):
+            # Headline (ISSUE 12): multi-chip data-sharded docs/s with
+            # MFU from live-measured program FLOPs over a live-resolved
+            # per-device peak (utils.flops — measured matmul probe on
+            # CPU, nominal spec on accelerators). The 5-client federated
+            # number stays on the record under federated_docs_per_s.
+            result["federated_docs_per_s"] = result["value"]
+            result["federated_vs_torch_cpu"] = result["vs_torch_cpu"]
+            result["metric"] = "multichip_sharded_prodlda_throughput"
+            result["value"] = multichip["docs_per_s"]
+            result["mesh_devices"] = multichip["devices"]
+            result["mfu"] = multichip["mfu"]
+            result["mfu_peak_source"] = multichip["peak_flops_source"]
+            result["multichip_compile_s"] = multichip["compile_s"]
+            # Every ratio on the record must share the NEW numerator —
+            # leaving a federated-numerator ratio next to a multichip
+            # value would let a reader pair them. No torch baseline =>
+            # vs_baseline is the floor ratio recomputed for this
+            # numerator, with the definition labeled accordingly.
+            if torch_docs_per_sec:
+                result["vs_baseline"] = round(
+                    multichip["docs_per_s"] / torch_docs_per_sec, 2
+                )
+                result["vs_torch_cpu"] = result["vs_baseline"]
+            else:
+                result["vs_baseline"] = round(
+                    multichip["docs_per_s"] / baseline_docs_per_sec, 1
+                )
+                result["vs_torch_cpu"] = None
+                result["baseline_definition"] = (
+                    "reference >=3s-sleep orchestration floor (torch "
+                    "baseline unavailable)"
+                )
+            result["vs_orchestration_floor"] = round(
+                multichip["docs_per_s"] / baseline_docs_per_sec, 1
+            )
     # The full bench record goes into the telemetry stream too, schema-
     # linted so the documented event contract can't silently drift.
     validate_record(metrics.log("bench_result", **result))
@@ -723,25 +1076,63 @@ def _phase_main(phase: str, backend: str) -> None:
     print("\n" + json.dumps(out), flush=True)
 
 
+def _watch_stages(proc, stage_path: str, timeout_s: float):
+    """Babysit a staged phase subprocess from OUTSIDE the process.
+
+    Polls the stage file the subprocess appends begin/done records to
+    (:class:`StageLog`); kills the process the moment the IN-FLIGHT
+    stage exceeds its own sub-deadline (``_stage_deadline``), with the
+    overall ``timeout_s`` as the backstop for un-staged phases and
+    inter-stage gaps. Returns None on clean exit, else
+    ``(hung_stage_or_None, waited_s)`` for the kill it performed —
+    the named stage is exactly the evidence BENCH_r05 lost.
+    """
+    t0 = time.monotonic()
+    while True:
+        if proc.poll() is not None:
+            return None
+        _done, inflight = _stage_view(_read_stage_file(stage_path))
+        if inflight is not None:
+            stage, began = inflight
+            waited = time.time() - began
+            if waited > _stage_deadline(stage):
+                proc.kill()
+                return (stage, waited)
+        elapsed = time.monotonic() - t0
+        if elapsed > timeout_s:
+            proc.kill()
+            return ((inflight[0] if inflight else None), elapsed)
+        time.sleep(0.25)
+
+
 def _run_phase(
     phase: str, backend: str, timeout_s: float, retries: int = 1,
     failures: "list[dict] | None" = None,
 ):
-    """Run a bench phase in a SUBPROCESS with a hard timeout.
+    """Run a bench phase in a SUBPROCESS under staged watching.
 
     The TPU tunnel can hang any device call indefinitely (its client
     re-dials with unbounded sleeps; observed twice as a 20+-minute bench
     with ~20 s of CPU time). Phase isolation means a hang costs one
-    timeout + retry on a FRESH tunnel connection instead of the whole
-    bench, and the orchestrator below stays stdlib-only so it cannot hang.
-    Returns the parsed JSON or None.
+    sub-deadline + retry on a FRESH tunnel connection instead of the
+    whole bench, and the orchestrator below stays stdlib-only so it
+    cannot hang. The run phase additionally writes per-stage begin/done
+    records (BENCH_STAGE_PATH) and a partial summary after every
+    completed stage (BENCH_PARTIAL_PATH): :func:`_watch_stages` kills at
+    the first stage whose own sub-deadline lapses, and the failure
+    breadcrumb then carries the hung stage's NAME, the completed stages,
+    and the partial summary — so a timeout ships evidence instead of
+    rc=124 with parsed:null (BENCH_r05). Returns the parsed JSON or None.
 
     ``failures`` (if given) collects one machine-readable record per
     failed attempt — phase, backend, the sub-deadline it ran under, a
-    reason code (``timeout`` / ``rc`` / ``bad_json``) and a stderr tail —
-    so an abandoned accelerator attempt leaves evidence in the final
-    JSON (``accel_attempts``) instead of silently shipping CPU numbers.
+    reason code (``timeout`` / ``stage_timeout`` / ``rc`` /
+    ``bad_json``), a stderr tail, and (for staged phases) ``stage`` /
+    ``stages_completed`` / ``partial`` — so an abandoned accelerator
+    attempt leaves evidence in the final JSON (``accel_attempts``)
+    instead of silently shipping CPU numbers.
     """
+    import tempfile
 
     def _note(reason: str, **extra) -> None:
         if failures is not None:
@@ -764,38 +1155,122 @@ def _run_phase(
             if p and "axon" not in p
         )
         env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # An explicit accelerator attempt (including BENCH_TRY_BACKEND on
+        # a host whose env already degraded to cpu) must actually aim the
+        # subprocess at that platform.
+        env["JAX_PLATFORMS"] = backend
     for attempt in range(retries + 1):
+        fd, stage_path = tempfile.mkstemp(prefix=f"bench_{phase}_stages_")
+        os.close(fd)
+        fd, partial_path = tempfile.mkstemp(
+            prefix=f"bench_{phase}_partial_"
+        )
+        os.close(fd)
+        os.unlink(partial_path)  # StageLog creates it atomically on flush
+        env["BENCH_STAGE_PATH"] = stage_path
+        env["BENCH_PARTIAL_PATH"] = partial_path
+        # stdout/stderr go to FILES, not pipes: the watcher below polls
+        # without draining, and a chatty child (XLA warnings, a large
+        # summary line) would fill a 64 KiB pipe and deadlock — blocked
+        # on write(), making no stage progress, and get falsely killed
+        # as a timeout.
+        fd, out_path = tempfile.mkstemp(prefix=f"bench_{phase}_out_")
+        os.close(fd)
+        fd, err_path = tempfile.mkstemp(prefix=f"bench_{phase}_err_")
+        os.close(fd)
         try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=timeout_s,
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(
-                f"bench: phase {phase!r} timed out after {timeout_s:.0f}s "
-                f"(attempt {attempt + 1})\n"
-            )
-            _note("timeout", attempt=attempt + 1)
-            continue
-        if proc.returncode == 0 and proc.stdout.strip():
-            try:
-                return json.loads(proc.stdout.strip().splitlines()[-1])
-            except json.JSONDecodeError as err:
-                sys.stderr.write(
-                    f"bench: phase {phase!r} bad JSON ({err})\n"
+            with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+                proc = subprocess.Popen(
+                    cmd, stdout=out_f, stderr=err_f, text=True, env=env,
                 )
-                _note("bad_json", attempt=attempt + 1, error=str(err))
+                hung = _watch_stages(proc, stage_path, timeout_s)
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            with open(out_path) as f:
+                out = f.read()
+            with open(err_path) as f:
+                err = f.read()
+            done, inflight = _stage_view(_read_stage_file(stage_path))
+            partial = _read_partial(partial_path)
+        finally:
+            for p in (stage_path, partial_path, out_path, err_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        if hung is not None:
+            stage, waited = hung
+            sys.stderr.write(
+                f"bench: phase {phase!r} "
+                + (f"hung in stage {stage!r} " if stage else "")
+                + f"killed after {waited:.0f}s (attempt {attempt + 1}); "
+                f"completed stages: {done}\n"
+            )
+            _note(
+                "stage_timeout" if stage else "timeout",
+                attempt=attempt + 1, stage=stage,
+                waited_s=round(waited, 1), stages_completed=done,
+                partial=partial, stderr_tail=(err or "")[-300:],
+            )
+            continue
+        if proc.returncode == 0 and out.strip():
+            try:
+                return json.loads(out.strip().splitlines()[-1])
+            except json.JSONDecodeError as jerr:
+                sys.stderr.write(
+                    f"bench: phase {phase!r} bad JSON ({jerr})\n"
+                )
+                _note(
+                    "bad_json", attempt=attempt + 1, error=str(jerr),
+                    stages_completed=done, partial=partial,
+                )
         else:
             sys.stderr.write(
                 f"bench: phase {phase!r} rc={proc.returncode} "
                 f"(attempt {attempt + 1}); stderr tail: "
-                f"{proc.stderr[-500:]}\n"
+                f"{(err or '')[-500:]}\n"
             )
             _note(
                 "rc", attempt=attempt + 1, rc=proc.returncode,
-                stderr_tail=proc.stderr[-300:],
+                stage=(inflight[0] if inflight else None),
+                stages_completed=done, partial=partial,
+                stderr_tail=(err or "")[-300:],
             )
     return None
+
+
+def _hung_stage(failures: "list[dict] | None") -> "str | None":
+    """The most recent named hung/in-flight stage across attempt
+    breadcrumbs — what accel_timeout_phase should say instead of the
+    undiagnostic 'run'."""
+    for f in reversed(failures or []):
+        if f.get("stage"):
+            return f["stage"]
+    return None
+
+
+def _best_partial(failures: "list[dict] | None") -> "dict | None":
+    """The richest partial summary any failed attempt flushed (most
+    completed stages wins — later attempts tie-break by recency)."""
+    best, best_n = None, -1
+    for f in failures or []:
+        p = f.get("partial")
+        if p and len(p.get("run_stages", {})) >= best_n:
+            best, best_n = p, len(p.get("run_stages", {}))
+    return dict(best) if best else None
+
+
+def _strip_partials(failures: "list[dict]") -> "list[dict]":
+    """Attempt records for the shipped summary: the per-attempt partial
+    copies stay out (the best one ships as the summary itself); the
+    stage/reason/deadline evidence stays in."""
+    return [
+        {k: v for k, v in f.items() if k != "partial"} for f in failures
+    ]
 
 
 _TPU_ARTIFACT = os.path.join(_REPO_ROOT, "results", "bench_tpu", "bench_latest.json")
@@ -874,7 +1349,30 @@ def main() -> None:
         return
 
     _reset_budget()
+    if "--compile_cache" in sys.argv:
+        # Persistent XLA compilation cache, applied in every phase
+        # subprocess via the env (BENCH_COMPILE_CACHE is the env-only
+        # spelling): reruns replay compiles from disk.
+        idx = sys.argv.index("--compile_cache") + 1
+        if idx >= len(sys.argv) or sys.argv[idx].startswith("--"):
+            sys.stderr.write(
+                "bench: --compile_cache needs a directory argument; "
+                "ignoring\n"
+            )
+        else:
+            os.environ["BENCH_COMPILE_CACHE"] = sys.argv[idx]
     backend = "cpu" if "--cpu" in sys.argv else _probe_backend()
+    try_backend = os.environ.get("BENCH_TRY_BACKEND")
+    if (
+        try_backend and try_backend != "cpu" and backend == "cpu"
+        and "--cpu" not in sys.argv
+    ):
+        # Force an honest accelerator ATTEMPT even when the probe already
+        # degraded (e.g. this host pins JAX_PLATFORMS=cpu): the staged
+        # run pins the failure to a named sub-phase — backend_init on a
+        # dead tunnel or absent plugin — with per-attempt breadcrumbs,
+        # instead of never having tried at all.
+        backend = try_backend
 
     # Adaptive deadlines under a hard whole-bench budget (BENCH_BUDGET_S):
     # a contended chip can push the (compile + 3 fits + torch baseline)
@@ -889,9 +1387,12 @@ def main() -> None:
     # attempt is recorded and surfaced on whatever summary ships, so a
     # degraded run is self-describing (no more silent CPU numbers).
     accel_failures: "list[dict]" = []
+    # Breadcrumbs are collected for EVERY backend (ISSUE 12 satellite): a
+    # CPU-backend phase timeout must ship its completed stages + hung
+    # stage name too, not only abandoned accelerator attempts.
     summary = _run_phase(
         "run", backend, timeout_s=main_timeout, retries=0,
-        failures=accel_failures if backend != "cpu" else None,
+        failures=accel_failures,
     )
     if summary is None and backend != "cpu":
         # Escalate only when the budget still holds a 2x attempt PLUS the
@@ -913,9 +1414,10 @@ def main() -> None:
             # attempt is still part of the round's story (each record
             # carries its phase/deadline/reason) — a live summary after
             # a timeout must not erase the timeout.
-            summary["accel_attempts"] = accel_failures
+            summary["accel_attempts"] = _strip_partials(accel_failures)
         if summary.get("backend") == "tpu":
             _persist_tpu_artifact(summary)
+    cpu_failures: "list[dict]" = []
     if summary is None and backend != "cpu":
         # Live TPU is unreachable: prefer the last banked live-TPU artifact
         # (explicitly marked cached) over presenting a CPU number as the
@@ -926,23 +1428,28 @@ def main() -> None:
                 "bench: live TPU unreachable; emitting banked TPU artifact "
                 "with provenance=cached\n"
             )
-            summary["accel_timeout_phase"] = "run"
-            summary["accel_attempts"] = accel_failures
+            summary["accel_timeout_phase"] = (
+                _hung_stage(accel_failures) or "run"
+            )
+            summary["accel_attempts"] = _strip_partials(accel_failures)
             print(json.dumps(summary))
             return
         sys.stderr.write("bench: degrading main phase to CPU\n")
         backend = "cpu"
         summary = _run_phase(
             "run", "cpu", timeout_s=max(60.0, _remaining_s(10.0)),
-            retries=0,
+            retries=0, failures=cpu_failures,
         )
         if summary is not None:
             summary["provenance"] = "live-cpu-degraded"
             # The accelerator attempt(s) that forced this fallback, with
             # their sub-deadlines and reasons: the headline below is a
-            # CPU number BECAUSE of these.
-            summary["accel_timeout_phase"] = "run"
-            summary["accel_attempts"] = accel_failures
+            # CPU number BECAUSE of these. accel_timeout_phase names the
+            # hung STAGE when the staged watcher identified one.
+            summary["accel_timeout_phase"] = (
+                _hung_stage(accel_failures) or "run"
+            )
+            summary["accel_attempts"] = _strip_partials(accel_failures)
             # No banked live-TPU bench exists to serve as the cached
             # fallback; point the record at the strongest COMMITTED TPU
             # evidence so a degraded capture is self-describing instead
@@ -971,17 +1478,36 @@ def main() -> None:
             except (OSError, ValueError, KeyError):
                 pass
     if summary is None:
-        summary = {
-            "metric": "federated_prodlda_5client_throughput",
-            "value": 0.0,
-            "unit": "docs/s",
-            "vs_baseline": 0.0,
-            "backend": backend,
-            "error": "all bench phase attempts failed or hung (TPU tunnel)",
-        }
-        if accel_failures:
-            summary["accel_timeout_phase"] = "run"
-            summary["accel_attempts"] = accel_failures
+        # Every live attempt failed — but the staged partial flush means
+        # the stages that DID complete can still ship (BENCH_r05's rc=124
+        # lost everything; this is the fix's last line of defense).
+        partial = _best_partial(cpu_failures) or _best_partial(
+            accel_failures
+        )
+        if partial is not None:
+            summary = partial
+            summary["provenance"] = "partial"
+            summary["error"] = (
+                "run phase killed at a stage sub-deadline; completed "
+                "stages shipped, accel_timeout_phase names the hung one"
+            )
+        else:
+            summary = {
+                "metric": "federated_prodlda_5client_throughput",
+                "value": 0.0,
+                "unit": "docs/s",
+                "vs_baseline": 0.0,
+                "backend": backend,
+                "error": (
+                    "all bench phase attempts failed or hung (TPU tunnel)"
+                ),
+            }
+        hung = _hung_stage(cpu_failures) or _hung_stage(accel_failures)
+        if accel_failures or cpu_failures:
+            summary["accel_timeout_phase"] = hung or "run"
+            summary["accel_attempts"] = _strip_partials(
+                accel_failures + cpu_failures
+            )
 
     if "error" not in summary:
         # The fused soak is a bonus artifact — it only runs when the main
@@ -1012,6 +1538,22 @@ def main() -> None:
                     "see results/fused_kernel_soak.json for the committed "
                     "soak"
                 )
+
+    # Shared artifact-shape contract (scripts/bench_schema.py): a bench
+    # must ALWAYS emit its one JSON line, so violations ship in-band as
+    # schema_errors instead of crashing the emitter.
+    try:
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "scripts"))
+        import bench_schema
+
+        problems = bench_schema.validate(summary, "bench")
+        if problems:
+            sys.stderr.write(
+                "bench: schema violations: " + "; ".join(problems) + "\n"
+            )
+            summary["schema_errors"] = problems
+    except ImportError as err:  # pragma: no cover - repo layout drift
+        sys.stderr.write(f"bench: schema validator unavailable: {err!r}\n")
 
     print(json.dumps(summary))
 

@@ -10,6 +10,15 @@ hardware the code exists for. In the gram-path modules
 ``eval/monitor.py``) every jax matmul must pin
 ``precision=jax.lax.Precision.HIGHEST``.
 
+``utils/flops.py`` (PR 12) is gram-adjacent and in scope too: its
+matmul probe is the MFU *denominator* for the multi-chip throughput
+accounting, and an unpinned probe on TPU would measure the bf16-pass
+peak — silently inflating the reported peak ~4x and deflating every
+MFU built on it. The training-step matmuls themselves
+(``train/steps.py``, the model modules) stay out of scope: they are
+ordinary forward/backward compute whose precision is the model's
+``compute_dtype`` policy, not a gram identity.
+
 Mechanics: only *jax-traced* scopes are checked — a function (or the
 module body) counts as jax-traced when its own statements reference the
 ``jnp``/``jax``/``lax`` roots. Inside such a scope:
@@ -73,6 +82,7 @@ class PrecisionPinRule(Rule):
         "gfedntm_tpu/federation/device_agg.py",
         "gfedntm_tpu/federation/aggregation.py",
         "gfedntm_tpu/eval/monitor.py",
+        "gfedntm_tpu/utils/flops.py",
     )
 
     HINT = (

@@ -278,8 +278,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the divergence-rollback path, reason "
                         "'coherence_collapse' (needs --quality_every > 0 "
                         "and --quality_ref)")
+    p.add_argument("--mesh_devices", type=int, default=0,
+                   help="multi-chip local training: data-shard each local "
+                        "corpus over a 1-D mesh of the first N devices "
+                        "(parallel.mesh.make_param_mesh). 0/1 = the "
+                        "single-device path, unchanged. On a CPU platform "
+                        "with fewer devices, N virtual host devices are "
+                        "forced before backend init "
+                        "(--xla_force_host_platform_device_count) so the "
+                        "multi-chip paths are drivable without an "
+                        "accelerator — the tier-1 debug knob")
     p.add_argument("--verbose", action="store_true")
     return p
+
+
+def _ensure_mesh_devices(args: argparse.Namespace) -> None:
+    """Make ``--mesh_devices N`` honest before the backend initializes:
+    force N virtual host devices on CPU platforms (no-op when the backend
+    is already up or a real accelerator is present)."""
+    n = int(getattr(args, "mesh_devices", 0) or 0)
+    if n > 1:
+        from gfedntm_tpu.parallel.mesh import ensure_virtual_devices
+
+        have = ensure_virtual_devices(n)
+        if have < n:
+            logging.warning(
+                "--mesh_devices %d requested but only %d devices are "
+                "visible; meshes will use %d", n, have, have,
+            )
 
 
 def load_config(args: argparse.Namespace) -> GfedConfig:
@@ -486,6 +512,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
             "--role client needs --id >= 1 (client ids start at 1; "
             "0 is the server)"
         )
+    _ensure_mesh_devices(args)
     if args.source is None:
         raise SystemExit(
             "--source required (synthetic .npz archive or .parquet corpus)"
@@ -523,6 +550,7 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         reconnect_window=getattr(args, "reconnect_window", 180.0),
         wire_codec=getattr(args, "wire_codec", None) or "auto",
         profiler=profiler,
+        mesh_devices=getattr(args, "mesh_devices", 0) or 0,
     )
     client.run()
     client.shutdown()
@@ -591,6 +619,7 @@ def run_simulate(args: argparse.Namespace, cfg: GfedConfig) -> int:
         trace,
     )
 
+    _ensure_mesh_devices(args)
     corpora, synthetic = _load_corpora(args)
     if synthetic is not None and args.model_type == "ctm":
         raise SystemExit(

@@ -78,9 +78,21 @@ class FederatedStepper:
         grads_to_share: tuple[str, ...] = SHARE_ALL,
         epoch_snapshot_dir: str | None = None,
         metrics=None,
+        mesh=None,
     ):
         self.model = model
         self.grads_to_share = tuple(grads_to_share)
+        # Multi-chip local training (README "Multi-chip training & bench
+        # interpretation"): with a 1-D data mesh
+        # (``parallel.mesh.make_param_mesh(axis_name="data")``) the local
+        # corpus doc-shards across the mesh and every per-poll minibatch is
+        # sharding-constrained over its row axis, so the client's step math
+        # runs data-parallel across all local devices while the protocol
+        # surface (snapshots, averages, accounting) is unchanged. A
+        # size-1 mesh (or None) is EXACTLY the historical single-device
+        # path — same program, bit-for-bit.
+        self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        self._mesh_axis = str(self.mesh.axis_names[0]) if self.mesh else None
         # Optional MetricsLogger: per-step wall-time histogram
         # ("stepper_step_s", host-synced — includes the loss device fetch)
         # plus first-step compile capture via the jit wrapper and per-step
@@ -107,7 +119,12 @@ class FederatedStepper:
         self._step_fn = build_train_step(
             model.module, model.tx, model.family, model._beta_weight(),
             metrics=metrics, label="train_step",
+            dshard=(self.mesh, self._mesh_axis) if self.mesh else None,
         )
+        if self.mesh is not None and metrics is not None:
+            metrics.registry.gauge("sharded_devices").set(
+                float(self.mesh.devices.size)
+            )
         self._flat_mask = flatten_dict(self.share_mask, sep="/")
         self._shared_keys = frozenset(
             k for k, shared in self._flat_mask.items() if shared
@@ -129,9 +146,17 @@ class FederatedStepper:
 
     # ---- phase setup (preFit, federated_model.py:57-96) --------------------
     def pre_fit(self, train_dataset: BowDataset) -> None:
-        """Create the shuffled batch schedule and prime the first minibatch."""
+        """Create the shuffled batch schedule and prime the first minibatch.
+
+        On a data mesh the staged corpus doc-shards across the devices
+        (``parallel.sharded.shard_docs``) — the memory-scaling half of
+        the multi-chip client path."""
         self.model.train_data = train_dataset
         self._data = self.model._device_data(train_dataset)
+        if self.mesh is not None:
+            from gfedntm_tpu.parallel.sharded import shard_docs
+
+            self._data = shard_docs(self._data, self.mesh, self._mesh_axis)
         self._new_epoch_schedule()
 
     def _new_epoch_schedule(self) -> None:
@@ -139,6 +164,19 @@ class FederatedStepper:
             len(self.model.train_data), self.model.batch_size,
             self.model._np_rng,
         )
+        if self.mesh is not None:
+            # Bucketed batch padding (train.steps.pad_batch_axis): ONE
+            # padded [S, B_pad] shape with B_pad divisible by the mesh, so
+            # the sharded step program compiles once and masked pad rows
+            # are exact no-ops (loss + accounting read the mask).
+            from gfedntm_tpu.data.datasets import EpochSchedule
+            from gfedntm_tpu.train.steps import pad_batch_axis
+
+            idx, mask = pad_batch_axis(
+                self._schedule.indices, self._schedule.mask,
+                int(self.mesh.devices.size),
+            )
+            self._schedule = EpochSchedule(indices=idx, mask=mask)
         self._step_in_epoch = 0
 
     @property
@@ -179,10 +217,32 @@ class FederatedStepper:
             # The first step is trace+compile dominated — timed_jit already
             # logged it as jit_compile; keep it out of the steady-state
             # histogram so p95/p99 reflect real step time.
+            step_s = time.perf_counter() - t0
             if self._first_step_done:
                 self.metrics.registry.histogram("stepper_step_s").observe(
-                    time.perf_counter() - t0
+                    step_s
                 )
+                if self.mesh is not None and step_s > 0:
+                    # Per-device throughput of the sharded local step:
+                    # real (masked) docs this step over wall time, split
+                    # uniformly across the mesh (the constraint shards
+                    # rows evenly).
+                    docs = float(
+                        self._schedule.mask[self._step_in_epoch].sum()
+                    )
+                    reg = self.metrics.registry
+                    reg.gauge("sharded_docs_per_s").set(docs / step_s)
+                    reg.gauge("sharded_docs_per_s_per_device").set(
+                        docs / step_s / float(self.mesh.devices.size)
+                    )
+            else:
+                # First call is trace+compile dominated: bank it as the
+                # sharded path's compile-seconds gauge (timed_jit already
+                # logged the jit_compile event).
+                if self.mesh is not None:
+                    self.metrics.registry.gauge("sharded_compile_s").set(
+                        step_s
+                    )
             self._first_step_done = True
             self._devmem.sample()
         self._last_batch_size = float(self._schedule.mask[self._step_in_epoch].sum())

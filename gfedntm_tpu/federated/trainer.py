@@ -491,22 +491,42 @@ class FederatedTrainer:
                     metrics.log("resume", step=start_step)
 
         step = start_step
+        steady_program_s = 0.0
+        steady_steps = 0
+        compile_program_s = 0.0
+        program_flops_per_step = None
         while step < total_steps:
             n = min(seg_len, total_steps - step)
             run = self._get_program()
             # RNG folding is per absolute step (scan xs carries step indices),
             # so resumed runs reproduce the unresumed ones exactly.
+            seg_args = (
+                params, batch_stats, opt_state, data, weights_j, ids_j,
+                jnp.asarray(indices[step:step + n]),
+                jnp.asarray(masks[step:step + n]),
+                jnp.arange(step, step + n),
+                jnp.asarray(exchange[step:step + n]),
+                jnp.asarray(total_weight, jnp.float32),
+                rng,
+            )
+            if metrics is not None and program_flops_per_step is None:
+                # Live-measured FLOPs of the real program (XLA cost
+                # analysis on the lowered module) — measured BEFORE the
+                # timed window (re-lowering the whole scan program costs
+                # real seconds that are not execution time) and BEFORE
+                # the call (on accelerators the program donates and
+                # consumes these state buffers). XLA's analysis counts a
+                # scan/while BODY once regardless of trip count (pinned
+                # by test_multichip), so the segment program's measured
+                # flops already approximate ONE step — no division by n.
+                from gfedntm_tpu.utils.flops import measure_program_flops
+
+                seg_flops = measure_program_flops(run, *seg_args)
+                if seg_flops is not None:
+                    program_flops_per_step = seg_flops
             t0 = time.perf_counter()
             try:
-                params, batch_stats, opt_state, seg_losses = run(
-                    params, batch_stats, opt_state, data, weights_j, ids_j,
-                    jnp.asarray(indices[step:step + n]),
-                    jnp.asarray(masks[step:step + n]),
-                    jnp.arange(step, step + n),
-                    jnp.asarray(exchange[step:step + n]),
-                    jnp.asarray(total_weight, jnp.float32),
-                    rng,
-                )
+                params, batch_stats, opt_state, seg_losses = run(*seg_args)
                 loss_chunks.append(np.asarray(seg_losses))
             finally:
                 # Logged even when the segment raises (OOM/interrupt), so a
@@ -530,6 +550,11 @@ class FederatedTrainer:
                     metrics.registry.histogram("trainer_step_s").observe(
                         seg_s / max(n, 1)
                     )
+            if n in self._compiled_lengths:
+                steady_program_s += seg_s
+                steady_steps += n
+            else:
+                compile_program_s += seg_s
             self._compiled_lengths.add(n)
             step += n
             if metrics is not None:
@@ -559,6 +584,37 @@ class FederatedTrainer:
             manager.close()
 
         if metrics is not None:
+            # Multi-chip throughput telemetry (the PR 1 registry): real
+            # (mask-true) docs per second over the steady-state segments,
+            # split per mesh device, and MFU from the live-measured
+            # program FLOPs against the backend's peak (nominal spec on
+            # accelerators, measured matmul probe on CPU — utils.flops).
+            n_dev = int(self.mesh.devices.size)
+            reg = metrics.registry
+            reg.gauge("federated_mesh_devices").set(float(n_dev))
+            if compile_program_s > 0:
+                reg.gauge("federated_compile_s").set(compile_program_s)
+            if steady_steps > 0 and steady_program_s > 0 and total_steps:
+                total_docs = float(masks[:, :C, :].sum())
+                docs_per_step = total_docs / total_steps
+                docs_per_s = docs_per_step * steady_steps / steady_program_s
+                reg.gauge("docs_per_s").set(docs_per_s)
+                reg.gauge("docs_per_s_per_device").set(docs_per_s / n_dev)
+                if program_flops_per_step is not None:
+                    from gfedntm_tpu.utils.flops import (
+                        mfu as compute_mfu,
+                        resolve_peak_flops_per_device,
+                    )
+
+                    peak, _src = resolve_peak_flops_per_device(
+                        jax.default_backend()
+                    )
+                    mfu_val = compute_mfu(
+                        program_flops_per_step,
+                        steady_program_s / steady_steps, n_dev, peak,
+                    )
+                    if mfu_val is not None:
+                        reg.gauge("mfu").set(mfu_val)
             metrics.snapshot_registry(step=total_steps)
 
         losses = np.concatenate(loss_chunks, axis=0)[:, :C]

@@ -365,9 +365,18 @@ class Client:
         wire_codec: str | None = "auto",
         profiler=None,
         reconnect_window: float = 180.0,
+        mesh_devices: int = 0,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
+        # Multi-chip local training (--mesh_devices): 0/1 = the historical
+        # single-device stepper, bit-for-bit; N>1 = the local corpus
+        # doc-shards over a 1-D data mesh of the first N devices and every
+        # local step runs data-parallel across them (README "Multi-chip
+        # training & bench interpretation"). The mesh is built lazily at
+        # model-build time so a client constructed before the backend
+        # initializes still composes with ensure_virtual_devices.
+        self.mesh_devices = int(mesh_devices)
         self.corpus = corpus
         self.server_address = server_address
         self.listen_address = listen_address
@@ -884,10 +893,30 @@ class Client:
             if hyper["family"] == "ctm" and self.save_dir is not None
             else None
         )
+        mesh = None
+        if self.mesh_devices > 1:
+            import jax
+
+            from gfedntm_tpu.parallel.mesh import make_param_mesh
+
+            n = min(self.mesh_devices, len(jax.devices()))
+            if n < self.mesh_devices:
+                self.logger.warning(
+                    "client %d asked for --mesh_devices %d but only %d "
+                    "devices exist; using %d",
+                    self.client_id, self.mesh_devices, n, n,
+                )
+            if n > 1:
+                mesh = make_param_mesh(axis_name="data", n_devices=n)
+                self.logger.info(
+                    "client %d data-sharding its local corpus over a "
+                    "%d-device mesh", self.client_id, n,
+                )
         self.stepper = FederatedStepper(
             model, grads_to_share=tuple(hyper["grads_to_share"]),
             epoch_snapshot_dir=snapshot_dir,
             metrics=self.metrics,
+            mesh=mesh,
         )
         with span(self.metrics, "pre_fit", client=self.client_id):
             self.stepper.pre_fit(self.dataset)

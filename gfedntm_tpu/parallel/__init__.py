@@ -1,18 +1,29 @@
 from gfedntm_tpu.parallel import mesh as mesh
-from gfedntm_tpu.parallel.mesh import make_client_mesh, stack_and_pad
+from gfedntm_tpu.parallel.mesh import (
+    ensure_virtual_devices,
+    make_client_mesh,
+    make_param_mesh,
+    stack_and_pad,
+)
 from gfedntm_tpu.parallel.sharded import (
+    fit_data_sharded,
     fit_sharded,
     make_dp_mp_mesh,
     shard_data,
+    shard_docs,
     shard_tree,
 )
 
 __all__ = [
     "mesh",
+    "ensure_virtual_devices",
     "make_client_mesh",
+    "make_param_mesh",
     "stack_and_pad",
+    "fit_data_sharded",
     "fit_sharded",
     "make_dp_mp_mesh",
     "shard_data",
+    "shard_docs",
     "shard_tree",
 ]

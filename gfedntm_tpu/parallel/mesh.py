@@ -43,15 +43,66 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False):
 
 
 def make_param_mesh(
-    devices: list | None = None, axis_name: str = "params"
+    devices: list | None = None, axis_name: str = "params",
+    n_devices: int | None = None,
 ) -> Mesh:
     """1-D mesh over every available device for the flattened-parameter
     plane of the device-resident aggregation path: client snapshots stack
     to ``[N, D]`` and shard their D axis over this mesh, so gate statistics
     and robust estimators run as per-shard XLA programs with only
-    [N]-sized partials crossing devices."""
+    [N]-sized partials crossing devices.
+
+    The same 1-D all-devices mesh is the *data* mesh of the multi-chip
+    local-training path (``parallel.sharded.fit_data_sharded``, the
+    mesh-enabled federation client) — pass ``axis_name="data"`` and
+    optionally ``n_devices`` to cap the mesh at the first N devices (the
+    CLI ``--mesh_devices`` debug knob)."""
     devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices < 1 or n_devices > len(devices):
+            raise ValueError(
+                f"n_devices={n_devices} out of range: have "
+                f"{len(devices)} devices"
+            )
+        devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis_name,))
+
+
+def ensure_virtual_devices(n: int) -> int:
+    """Best-effort host-platform virtual-device bootstrap: make the CPU
+    backend expose ``n`` devices by setting
+    ``--xla_force_host_platform_device_count`` BEFORE the backend
+    initializes (XLA parses XLA_FLAGS exactly once, at first backend
+    init). Returns the live device count afterwards.
+
+    This is what makes the multi-chip paths drivable in tier-1 / from the
+    CLI (``--mesh_devices N``) without an accelerator: on a CPU platform
+    with no flag in place yet, the flag is injected and the platform
+    pinned to cpu (the image's sitecustomize overrides the env var, so
+    ``jax.config`` is the authoritative pin). When the backend is already
+    initialized — or a real accelerator is the platform — the
+    environment is left alone and the caller sees whatever device count
+    exists; callers must size their mesh from the RETURNED count, not
+    from ``n``."""
+    import os
+
+    try:
+        from jax._src.xla_bridge import backends_are_initialized
+    except ImportError:  # pragma: no cover - jax-version drift guard
+        def backends_are_initialized() -> bool:
+            return False
+
+    if not backends_are_initialized():
+        platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+        if not platforms or "cpu" in platforms:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
+            if "cpu" in platforms:
+                jax.config.update("jax_platforms", "cpu")
+    return len(jax.devices())
 
 
 def pad_to_multiple(n: int, m: int) -> int:

@@ -25,6 +25,7 @@ when a SINGLE model must scale beyond one device's convenient working set.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -247,3 +248,307 @@ def fit_sharded(
                 "Epoch: [%d/%d]\tSharded Train Loss: %.4f",
                 epoch + 1, model.num_epochs, train_loss,
             )
+
+
+def shard_docs(
+    data: dict[str, Any], mesh: Mesh, axis_name: str = "data"
+) -> dict[str, Any]:
+    """Shard a staged corpus dict over its document axis (zero-padding the
+    doc count up to the mesh size first — schedules never index the pad
+    rows, so the padding is inert). The memory-scaling half of the
+    data-sharded path: each device holds ``~N/n_devices`` documents."""
+    from gfedntm_tpu.parallel.mesh import pad_to_multiple
+
+    n_dev = int(mesh.devices.size)
+    out: dict[str, Any] = {}
+    for k, v in data.items():
+        if v is None:
+            out[k] = None
+            continue
+        arr = np.asarray(v)
+        n_pad = pad_to_multiple(arr.shape[0], n_dev)
+        if n_pad != arr.shape[0]:
+            arr = np.concatenate(
+                [arr, np.zeros((n_pad - arr.shape[0],) + arr.shape[1:],
+                               arr.dtype)],
+                axis=0,
+            )
+        spec = P(axis_name, *([None] * (arr.ndim - 1)))
+        out[k] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def fit_data_sharded(
+    model,
+    train_dataset: BowDataset,
+    validation_dataset: BowDataset | None = None,
+    mesh: Mesh | None = None,
+    n_devices: int | None = None,
+    metrics=None,
+    donate: bool = True,
+    peak_flops_per_device: float | None = None,
+    save_dir: str | None = None,
+    patience: int = 5,
+    delta: float = 0.0,
+    label: str = "train_epoch_dp",
+) -> dict[str, Any]:
+    """Data-parallel local training across the host mesh: the multi-chip
+    path a federation client (and the bench) runs its LOCAL corpus on.
+
+    One model, one optimizer trajectory, many chips: the corpus shards
+    over the 1-D all-devices mesh (:func:`shard_docs` /
+    ``parallel.mesh.make_param_mesh(axis_name="data")``), the model state
+    replicates, and every per-step batch is sharding-constrained over its
+    row axis (``train.steps._apply_dshard``) so XLA splits the row-wise
+    matmuls across the mesh and inserts the batch-statistic psums. The
+    program SEMANTICS are the single-device program's — full-batch loss,
+    full-batch masked BatchNorm — so parity with ``model.fit`` is
+    reduction-order-only (betas within 1e-4; pinned by the multichip
+    tests on the forced 8-device CPU mesh).
+
+    Mechanics the throughput story depends on:
+
+    - **Bucketed batch padding** (``train.steps.pad_batch_axis``): every
+      epoch's schedule is padded to one ``[S, B_pad]`` shape with
+      ``B_pad % n_devices == 0``, so the steady state compiles ONCE and
+      ragged final batches cannot recompile it.
+    - **AOT compile split**: the epoch program is lowered and compiled
+      ahead of time, so ``compile_s`` is the exact XLA compile cost
+      (reported separately from steady-state epochs — the bench's
+      first-step-compile vs steady-state staging) and the compiled
+      executable's own cost analysis supplies live-measured per-device
+      FLOPs for MFU (``utils.flops``).
+    - **Donated carried state** (accelerators only, see
+      ``train.steps.donation_argnums``): the carried
+      params/batch_stats/opt_state buffers are donated epoch-to-epoch;
+      the initial state is protected with
+      ``train.optimizers.copy_for_donation`` so the model object's own
+      arrays are never consumed.
+
+    Telemetry (``metrics`` = observability MetricsLogger): a
+    ``jit_compile`` event for the AOT compile, per-epoch ``phase``
+    events, and registry gauges ``sharded_devices``,
+    ``sharded_compile_s``, ``sharded_docs_per_s``,
+    ``sharded_docs_per_s_per_device``, ``sharded_mfu`` (the PR 1
+    registry), plus one ``sharded_fit`` summary event.
+
+    Returns a summary dict (docs_per_s, per-device docs/s, mfu,
+    compile_s, steady_s, flops_per_epoch, devices, epochs) and leaves the
+    trained state on ``model`` (replicated; host reads gather
+    transparently).
+
+    The fused Pallas decoder does not compose with this path (it meshes
+    via the V-sharded ``vshard`` composition of :func:`fit_sharded`) —
+    build the model with ``fused_decoder=False``.
+    """
+    from gfedntm_tpu.parallel.mesh import make_param_mesh
+    from gfedntm_tpu.train.optimizers import copy_for_donation
+    from gfedntm_tpu.train.steps import (
+        build_train_epoch,
+        donation_argnums,
+        pad_batch_axis,
+    )
+    from gfedntm_tpu.utils.flops import (
+        measure_program_flops,
+        mfu as compute_mfu,
+        resolve_peak_flops_per_device,
+    )
+
+    if model.family not in ("avitm", "ctm"):
+        raise NotImplementedError(f"unknown model family {model.family!r}")
+    if getattr(model.module, "fused_decoder", False):
+        raise ValueError(
+            "fit_data_sharded runs the unfused XLA loss; the fused Pallas "
+            "decoder composes with meshes via fit_sharded's V-sharded "
+            "path instead (build the model with fused_decoder=False)"
+        )
+    if mesh is None:
+        mesh = make_param_mesh(axis_name="data", n_devices=n_devices)
+    axis = mesh.axis_names[0]
+    n_dev = int(mesh.devices.size)
+
+    program = build_train_epoch(
+        model.module, model.tx, model.family, model._beta_weight(),
+        dshard=(mesh, axis), donate=donate, metrics=None, label=label,
+    )
+
+    model.train_data = train_dataset
+    model.validation_data = validation_dataset
+    data = shard_docs(model._device_data(train_dataset), mesh, axis)
+    val_data = (
+        model._device_data(validation_dataset)
+        if validation_dataset is not None
+        else None
+    )
+
+    replicated = NamedSharding(mesh, P())
+    state = jax.tree.map(
+        lambda leaf: jax.device_put(leaf, replicated)
+        if hasattr(leaf, "shape") else leaf,
+        (model.params, model.batch_stats, model.opt_state),
+    )
+    if donation_argnums((0, 1, 2), donate):
+        # The program consumes its state inputs on accelerators; on a
+        # 1-device mesh device_put may have aliased the model's own
+        # buffers, so the first call gets a protective copy (the
+        # optimizers.copy_for_donation seam).
+        state = copy_for_donation(state)
+    params, batch_stats, opt_state = state
+
+    n_train = len(train_dataset)
+    sched0 = make_epoch_schedule(n_train, model.batch_size, model._np_rng)
+    idx0, mask0 = pad_batch_axis(sched0.indices, sched0.mask, n_dev)
+
+    # AOT: lowering + compiling ahead of time gives (a) the exact compile
+    # seconds, separated from the first epoch's execution, and (b) the
+    # compiled executable's cost analysis — live-measured FLOPs of the
+    # real program, not an analytic formula.
+    example = (
+        params, batch_stats, opt_state, data,
+        _replicate(np.asarray(idx0), mesh),
+        _replicate(np.asarray(mask0), mesh),
+        _replicate(model._next_rng(), mesh),
+    )
+    t0 = time.perf_counter()
+    compiled = program.lower(*example).compile()
+    compile_s = time.perf_counter() - t0
+    # XLA's cost analysis counts the scan BODY once regardless of trip
+    # count (pinned by test_multichip), so the epoch program's measured
+    # flops approximate ONE step; the epoch total is steps x that.
+    flops_per_step = measure_program_flops(program, compiled=compiled)
+    steps_per_epoch = int(idx0.shape[0])
+    flops_per_epoch = (
+        flops_per_step * steps_per_epoch
+        if flops_per_step is not None else None
+    )
+    peak, peak_source = (
+        (peak_flops_per_device, "caller")
+        if peak_flops_per_device is not None
+        else resolve_peak_flops_per_device(jax.default_backend())
+    )
+    if metrics is not None:
+        metrics.log("jit_compile", what=label, seconds=compile_s)
+        metrics.registry.gauge("sharded_devices").set(float(n_dev))
+        metrics.registry.gauge("sharded_compile_s").set(compile_s)
+
+    scheduler = None
+    if model.reduce_on_plateau:
+        from gfedntm_tpu.train.schedulers import (
+            ReduceLROnPlateau,
+            set_learning_rate,
+        )
+
+        scheduler = ReduceLROnPlateau(model.lr)
+    early_stopping = None
+    if validation_dataset is not None:
+        from gfedntm_tpu.train.early_stopping import EarlyStopping
+
+        early_stopping = EarlyStopping(
+            patience=patience, delta=delta,
+            checkpoint_fn=(lambda: model.save(save_dir)) if save_dir else None,
+            verbose=model.verbose,
+        )
+
+    model.epoch_losses = []
+    steady_s = 0.0
+    steady_epochs = 0
+    epoch_args = example[4:6]  # first epoch reuses the example schedule
+    for epoch in range(model.num_epochs):
+        model.nn_epoch = epoch
+        if epoch > 0:
+            sched = make_epoch_schedule(
+                n_train, model.batch_size, model._np_rng
+            )
+            idx, mask = pad_batch_axis(sched.indices, sched.mask, n_dev)
+            epoch_args = (
+                _replicate(np.asarray(idx), mesh),
+                _replicate(np.asarray(mask), mesh),
+            )
+            rng = _replicate(model._next_rng(), mesh)
+        else:
+            rng = example[6]
+        t0 = time.perf_counter()
+        params, batch_stats, opt_state, losses = compiled(
+            params, batch_stats, opt_state, data, *epoch_args, rng
+        )
+        losses = np.asarray(losses)  # host sync: real epoch wall time
+        epoch_s = time.perf_counter() - t0
+        if epoch > 0:  # epoch 0 absorbs device-cache warmup noise
+            steady_s += epoch_s
+            steady_epochs += 1
+        if metrics is not None:
+            metrics.log(
+                "phase", phase="sharded_epoch", seconds=epoch_s, epoch=epoch,
+            )
+        train_loss = float(losses.sum()) / n_train
+        model.epoch_losses.append(train_loss)
+        model.params = params
+        model.batch_stats = batch_stats
+        model.opt_state = opt_state
+        model.best_components = np.asarray(params["beta"])
+        if np.isnan(train_loss):
+            break
+
+        monitored = train_loss
+        if validation_dataset is not None:
+            vsched = make_epoch_schedule(
+                len(validation_dataset), model.batch_size, model._np_rng
+            )
+            vlosses = model._eval_epoch_fn(
+                params, batch_stats, val_data,
+                np.asarray(vsched.indices), np.asarray(vsched.mask),
+                model._next_rng(),
+            )
+            val_loss = float(np.sum(np.asarray(vlosses))) / len(
+                validation_dataset
+            )
+            if np.isnan(val_loss):
+                break
+            monitored = val_loss
+            early_stopping(val_loss)
+            if early_stopping.early_stop:
+                model.logger.info("Early stopping")
+                break
+        if scheduler is not None:
+            set_learning_rate(model.opt_state, scheduler.step(monitored))
+        if model.verbose:
+            model.logger.info(
+                "Epoch: [%d/%d]\tData-sharded Train Loss: %.4f",
+                epoch + 1, model.num_epochs, train_loss,
+            )
+
+    per_epoch_s = steady_s / steady_epochs if steady_epochs else None
+    docs_per_s = (
+        n_train / per_epoch_s if per_epoch_s and per_epoch_s > 0 else None
+    )
+    mfu_val = compute_mfu(flops_per_epoch, per_epoch_s or 0.0, n_dev, peak)
+    summary = {
+        "devices": n_dev,
+        "epochs_run": len(model.epoch_losses),
+        "compile_s": round(compile_s, 3),
+        "steady_s": round(steady_s, 3),
+        "docs_per_s": round(docs_per_s, 1) if docs_per_s else None,
+        "docs_per_s_per_device": (
+            round(docs_per_s / n_dev, 1) if docs_per_s else None
+        ),
+        "flops_per_step": flops_per_step,
+        "steps_per_epoch": steps_per_epoch,
+        "flops_per_epoch": flops_per_epoch,
+        "mfu": round(mfu_val, 6) if mfu_val is not None else None,
+        "peak_flops_source": peak_source,
+        "batch_pad": int(idx0.shape[1]),
+    }
+    if metrics is not None:
+        if docs_per_s:
+            metrics.registry.gauge("sharded_docs_per_s").set(docs_per_s)
+            metrics.registry.gauge("sharded_docs_per_s_per_device").set(
+                docs_per_s / n_dev
+            )
+        if mfu_val is not None:
+            metrics.registry.gauge("sharded_mfu").set(mfu_val)
+        metrics.log(
+            "sharded_fit", devices=n_dev,
+            docs_per_s=summary["docs_per_s"], mfu=summary["mfu"],
+            compile_s=summary["compile_s"],
+        )
+    return summary

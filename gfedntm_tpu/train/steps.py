@@ -62,6 +62,63 @@ def _gather_batch(data: dict[str, Any], idx: jax.Array) -> dict[str, Any]:
     return {k: jnp.take(v, idx, axis=0) for k, v in data.items() if v is not None}
 
 
+def _apply_dshard(batch: dict[str, Any], mask, dshard):
+    """Constrain a gathered batch's row axis onto the data mesh.
+
+    ``dshard=(mesh, axis_name)`` is the GSPMD data-parallel hook of the
+    multi-chip local-training path (``parallel.sharded.fit_data_sharded``,
+    the mesh-enabled ``FederatedStepper``): the program's semantics are
+    untouched — full-batch loss, full-batch (masked) BatchNorm statistics
+    — and only the *placement* of the per-step batch changes, so XLA
+    splits the row-wise compute across the mesh and inserts the psums the
+    batch statistics need. Parity with the single-device program is
+    therefore reduction-order-only (the 1e-4 band the multichip tests
+    pin). The batch axis must divide the mesh (callers bucket-pad the
+    schedule with :func:`pad_batch_axis` first)."""
+    if dshard is None:
+        return batch, mask
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, axis = dshard
+
+    def constrain(v):
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+
+    return {k: constrain(v) for k, v in batch.items()}, constrain(mask)
+
+
+def pad_batch_axis(indices, mask, multiple: int):
+    """Bucket-pad an ``[S, B]`` epoch schedule's batch axis up to a
+    multiple of ``multiple`` with masked no-op rows.
+
+    Two jobs at once for the data-sharded training paths: (a) every
+    per-step batch divides evenly over the mesh, and (b) every step of
+    every epoch shares ONE padded shape, so the steady state never
+    recompiles on ragged final batches. Masked rows are exact no-ops —
+    the mask-aware loss and BatchNorm already guarantee this for the
+    ragged-final-batch padding the schedules carry; this adds more of the
+    same. The padded rows gather doc 0 (a real row, so no out-of-bounds
+    clamp paths), masked to zero contribution. The first ``B`` rows of
+    every step are byte-identical to the unpadded schedule, and jax's
+    counter-based PRNG draws per flattened element, so the kept rows'
+    dropout/reparam draws match the unpadded program's exactly."""
+    import numpy as np
+
+    from gfedntm_tpu.parallel.mesh import pad_to_multiple
+
+    b = int(indices.shape[1])
+    b_pad = pad_to_multiple(b, multiple)
+    if b_pad == b:
+        return indices, mask
+    s = indices.shape[0]
+    idx_out = np.zeros((s, b_pad), dtype=indices.dtype)
+    idx_out[:, :b] = indices
+    mask_out = np.zeros((s, b_pad), dtype=mask.dtype)
+    mask_out[:, :b] = mask
+    return idx_out, mask_out
+
+
 def donation_argnums(
     argnums: tuple[int, ...], donate: bool = True
 ) -> tuple[int, ...]:
@@ -272,6 +329,7 @@ def build_train_epoch(
     metrics=None,
     label: str = "train_epoch",
     donate: bool = True,
+    dshard=None,
 ):
     """Returns jitted ``(params, batch_stats, opt_state, data, indices, masks,
     rng) -> (params, batch_stats, opt_state, losses[S])``.
@@ -289,7 +347,19 @@ def build_train_epoch(
     the epoch program updates the model in place in HBM; callers must
     treat the state they passed in as consumed, which every in-repo
     caller already does (state is reassigned from the outputs).
+
+    ``dshard=(mesh, axis_name)`` (see :func:`_apply_dshard`) runs the SAME
+    program data-parallel over a mesh: each gathered batch's rows are
+    sharding-constrained onto the mesh, XLA splits the row-wise compute
+    and inserts the batch-statistic psums. Mutually exclusive with the
+    fused Pallas loss (which composes with meshes via ``vshard`` instead).
     """
+    if dshard is not None and getattr(module, "fused_decoder", False):
+        raise ValueError(
+            "dshard (GSPMD data-parallel) does not compose with the fused "
+            "Pallas decoder; use the V-sharded vshard path "
+            "(parallel.sharded.fit_sharded) or fused_decoder=False"
+        )
 
     def train_epoch(params, batch_stats, opt_state, data, indices, masks, rng):
         def body(carry, xs):
@@ -301,6 +371,7 @@ def build_train_epoch(
                 "reparam": jax.random.fold_in(step_rng, 1),
             }
             batch = _gather_batch(data, idx)
+            batch, mask = _apply_dshard(batch, mask, dshard)
             new_params, new_bs, new_opt, loss = grad_step(
                 module, tx, family, beta_weight, params, batch_stats,
                 opt_state, batch, mask, rngs, vshard=vshard,
@@ -332,6 +403,7 @@ def build_train_step(
     metrics=None,
     label: str = "train_step",
     donate: bool = False,
+    dshard=None,
 ):
     """Jitted ONE-minibatch step: ``(params, batch_stats, opt_state, data,
     idx[B], mask[B], rng) -> (params, batch_stats, opt_state, loss)``.
@@ -342,7 +414,16 @@ def build_train_step(
     single-program training. ``metrics`` adds first-call compile capture
     (see :func:`~gfedntm_tpu.utils.observability.timed_jit`). ``donate``
     defaults OFF here (unlike the epoch program): the stepper snapshots
-    shared parameters between steps, so in-place state is opt-in."""
+    shared parameters between steps, so in-place state is opt-in.
+    ``dshard=(mesh, axis)`` data-shards the minibatch over a mesh (the
+    federation client's multi-chip local step — see :func:`_apply_dshard`;
+    the caller bucket-pads ``idx``/``mask`` with :func:`pad_batch_axis`)."""
+    if dshard is not None and getattr(module, "fused_decoder", False):
+        raise ValueError(
+            "dshard (GSPMD data-parallel) does not compose with the fused "
+            "Pallas decoder; use fused_decoder=False for mesh-sharded "
+            "federation clients"
+        )
 
     def train_step(params, batch_stats, opt_state, data, idx, mask, rng):
         rngs = {
@@ -350,6 +431,7 @@ def build_train_step(
             "reparam": jax.random.fold_in(rng, 1),
         }
         batch = _gather_batch(data, idx)
+        batch, mask = _apply_dshard(batch, mask, dshard)
         return grad_step(
             module, tx, family, beta_weight, params, batch_stats, opt_state,
             batch, mask, rngs,

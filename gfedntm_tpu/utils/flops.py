@@ -1,0 +1,143 @@
+"""Live FLOPs + MFU accounting for the multi-chip training paths.
+
+Every MFU number this repo reports divides *measured* work by a peak:
+
+- the work side comes from the XLA cost model of the ACTUAL lowered
+  program (:func:`measure_program_flops` — ``Lowered.cost_analysis()``,
+  or the compiled executable's analysis when an AOT handle is available),
+  never from a hand-maintained analytic formula that drifts when the
+  model changes;
+- the peak side is the nominal accelerator spec when one is published
+  (TPU v5e bf16 MXU), and a LIVE matmul probe on backends without one
+  (:func:`measure_peak_flops_per_device` — the CPU tier), so a CPU MFU
+  is "fraction of what this host's BLAS can do", not a number divided by
+  a TPU spec it never had (the meaningless ~1e-4 of BENCH_r03-r05).
+
+GL002 note: this module sits in the MFU/throughput accounting path and
+is in the precision-pin rule's scope — its probe matmul pins
+``precision=jax.lax.Precision.HIGHEST``. On CPU the pin is a no-op (f32
+is f32); on TPUs it makes the probe measure the HIGHEST-precision f32
+peak, which is the right comparator for this repo's f32 training math
+(the nominal bf16 peak stays the accelerator denominator, reported
+separately as ``peak_source``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+#: Nominal per-device peaks for backends with a published spec (FLOP/s).
+#: The chip behind the tunnel reports "TPU v5 lite": 197 TFLOP/s bf16 MXU.
+NOMINAL_PEAK_FLOPS: dict[str, float] = {
+    "tpu": 197.0e12,
+    "axon": 197.0e12,
+}
+
+_peak_cache: dict[str, float] = {}
+
+
+def measure_program_flops(fn: Any, *args, compiled: Any = None) -> float | None:
+    """FLOPs of ONE invocation of ``fn(*args)`` from the XLA cost model.
+
+    ``fn`` must be a ``jax.jit`` product (anything with ``.lower``).
+    Lowering + cost analysis runs the compiler's own accounting over the
+    real program — a live measurement of the code as built, not an
+    analytic estimate. Pass ``compiled=`` (an AOT ``Compiled`` handle)
+    to reuse an existing compilation instead of re-lowering.
+
+    Scan caveat (pinned by test_multichip): XLA's analysis counts a
+    ``scan``/``while`` body ONCE regardless of trip count, so for a
+    length-S scan program the returned number approximates ONE step,
+    not S steps. Callers whose program is a step scan must multiply by
+    their own step count (fit_data_sharded, the federated trainer).
+
+    Returns None when the backend/jax version exposes no cost analysis —
+    callers must treat MFU as unavailable rather than report 0.
+    """
+    try:
+        if compiled is not None:
+            analysis = compiled.cost_analysis()
+        else:
+            lower = getattr(fn, "lower", None)
+            if lower is None:
+                return None
+            analysis = lower(*args).cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0.0 else None
+    except Exception:  # graftlint: disable=exception-hygiene -- cost
+        # analysis is best-effort telemetry; a backend without it yields
+        # "MFU unavailable", which the caller reports as such
+        return None
+
+
+def measure_peak_flops_per_device(
+    backend: str | None = None, n: int = 1024, repeats: int = 3
+) -> float | None:
+    """Live-measured matmul peak of ONE device (FLOP/s), best-of-N timed
+    ``[n, n] @ [n, n]`` f32 matmuls pinned HIGHEST. Cached per backend —
+    the probe costs ~100 ms once. Used as the MFU denominator on backends
+    without a published spec (the CPU tier)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = backend or jax.default_backend()
+    if key in _peak_cache:
+        return _peak_cache[key]
+    try:
+        a = jnp.ones((n, n), jnp.float32)
+        prog = jax.jit(
+            lambda x: jnp.matmul(
+                x, x, precision=jax.lax.Precision.HIGHEST
+            )
+        )
+        jax.block_until_ready(prog(a))  # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(prog(a))
+            best = min(best, time.perf_counter() - t0)
+        peak = 2.0 * n * n * n / best
+    except Exception:  # graftlint: disable=exception-hygiene -- a probe
+        # failure means "no peak reference"; callers report MFU as
+        # unavailable instead of dividing by a made-up number
+        return None
+    _peak_cache[key] = peak
+    return peak
+
+
+def resolve_peak_flops_per_device(
+    backend: str,
+) -> tuple[float | None, str]:
+    """(peak FLOP/s per device, source) for an MFU denominator: the
+    published nominal peak for known accelerators, else a live matmul
+    probe (``"measured-matmul-probe"``), else ``(None, "unavailable")``."""
+    if backend in NOMINAL_PEAK_FLOPS:
+        return NOMINAL_PEAK_FLOPS[backend], "nominal-spec"
+    peak = measure_peak_flops_per_device(backend)
+    if peak is not None:
+        return peak, "measured-matmul-probe"
+    return None, "unavailable"
+
+
+def mfu(
+    flops_per_call: float | None,
+    seconds_per_call: float,
+    n_devices: int,
+    peak_per_device: float | None,
+) -> float | None:
+    """Model FLOPs utilization: achieved FLOP/s per device over the peak.
+
+    ``flops_per_call`` is the WHOLE program's cost (all devices — the XLA
+    analysis counts the full computation), so per-device achieved FLOP/s
+    is ``flops / seconds / n_devices``."""
+    if (
+        flops_per_call is None
+        or peak_per_device is None
+        or seconds_per_call <= 0.0
+        or n_devices < 1
+    ):
+        return None
+    return flops_per_call / seconds_per_call / n_devices / peak_per_device

@@ -147,6 +147,13 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     # bench stream (bench.py emits through the same logger/schema)
     "bench_summary": frozenset({"backend"}),
     "bench_result": frozenset({"metric", "value", "unit", "backend"}),
+    # staged bench sub-phases (bench.py run-phase staging: a stage record
+    # lands in the stream the moment the stage completes, so a later hang
+    # cannot erase it; README "Multi-chip training & bench interpretation")
+    "bench_stage": frozenset({"stage", "seconds"}),
+    # multi-chip data-sharded local training (parallel.sharded
+    # .fit_data_sharded / the mesh-enabled federation client)
+    "sharded_fit": frozenset({"devices", "docs_per_s"}),
 }
 
 

@@ -38,6 +38,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import bench_schema  # noqa: E402  (sibling module; scripts/ is sys.path[0])
+
+
+def _emit(row: dict) -> None:
+    """Print one JSON metric line, schema-checked at the emission site
+    (scripts/bench_schema.py) so artifact fields can't silently drift."""
+    problems = bench_schema.validate_row(row)
+    if problems:
+        raise SystemExit("agg_microbench schema drift: " + "; ".join(problems))
+    print(json.dumps(row), flush=True)
+
 
 def _build_pairs(n: int, d: int, seed: int = 0):
     import numpy as np
@@ -118,11 +129,11 @@ def run_pacing_sweep(args) -> None:
                 run_cell()  # warm allocators / caches
                 ms = _best_of(run_cell, args.repeats)
                 wall[(spec, k_spec, n)] = ms
-                print(json.dumps({
+                _emit({
                     "metric": "pacing_round_wall_ms", "estimator": spec,
                     "n_clients": n, "cohort": k, "cohort_spec": k_spec,
                     "d": args.d, "wall_ms": round(ms, 3),
-                }), flush=True)
+                })
     # Growth summary: for each (estimator, K) the wall-clock ratio from
     # the smallest to the largest population. Fixed-K rows must stay ~1
     # (cost tracks the cohort); the 'all' row is the sync barrier and
@@ -139,7 +150,7 @@ def run_pacing_sweep(args) -> None:
         }
         if k_spec != "all":
             row["tracks_cohort"] = row["growth"] < 2.0
-        print(json.dumps(row), flush=True)
+        _emit(row)
 
 
 def main() -> None:
@@ -202,11 +213,11 @@ def main() -> None:
                 est(pairs)  # warm caches/allocators
                 ms = _best_of(lambda: est(pairs), args.repeats)
                 wall[(spec, "numpy", n)] = ms
-                print(json.dumps({
+                _emit({
                     "metric": "agg_estimator_wall_ms", "estimator": spec,
                     "backend": "numpy", "n_clients": n, "d": args.d,
                     "wall_ms": round(ms, 3),
-                }), flush=True)
+                })
             if engine is not None:
                 est = make_estimator(spec)
                 plane = FlatPlane(template)
@@ -226,12 +237,12 @@ def main() -> None:
                 run_dev()  # jit compile at this (n, d) shape
                 ms = _best_of(run_dev, args.repeats)
                 wall[(spec, "device", n)] = ms
-                print(json.dumps({
+                _emit({
                     "metric": "agg_estimator_wall_ms", "estimator": spec,
                     "backend": "device", "n_clients": n, "d": args.d,
                     "wall_ms": round(ms, 3),
                     "stack_ms": round(stack_ms, 3),
-                }), flush=True)
+                })
 
     # Growth summary: wall-clock ratio from the smallest to the largest N
     # per (estimator, backend); the device path earns its keep when its
@@ -252,7 +263,7 @@ def main() -> None:
             row["sublinear_vs_numpy"] = (
                 row["device_growth"] < row["numpy_growth"]
             )
-        print(json.dumps(row), flush=True)
+        _emit(row)
 
 
 if __name__ == "__main__":

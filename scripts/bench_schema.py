@@ -1,0 +1,94 @@
+"""BENCH-JSON schema validator shared by every artifact emitter.
+
+One place that says what each bench artifact line/object must carry, so
+the fields downstream readers key on (the trajectory reviewer, summarize,
+the scale/pacing acceptance checks) cannot silently drift when an emitter
+is refactored — exactly what happened to the r03-r05 run-phase evidence.
+
+Used by ``bench.py`` (main summary + partial summaries), by
+``scripts/agg_microbench.py`` (per-row metrics), and by
+``scripts/scale_bench.py`` (the BENCH_SCALE artifact). ``validate`` is
+pure and returns problem strings; emitters that must never crash
+(bench.py) report them in-band as ``schema_errors``, while dev tools
+(the scripts) raise via :func:`require`.
+"""
+
+from __future__ import annotations
+
+#: kind -> required top-level fields. Presence-only by design: value
+#: domains are the emitters' business, the SHAPE contract is ours.
+SCHEMAS: dict[str, tuple[str, ...]] = {
+    # bench.py's one-line summary (any provenance: live / cached /
+    # degraded / partial).
+    "bench": ("metric", "value", "unit", "vs_baseline", "backend"),
+    # The partial summary StageLog flushes after every completed stage.
+    "bench_partial": (
+        "metric", "value", "unit", "backend", "partial", "run_stages",
+    ),
+    # scripts/agg_microbench.py per-row JSON lines, keyed by row metric.
+    "agg_estimator_wall_ms": (
+        "metric", "estimator", "backend", "n_clients", "d", "wall_ms",
+    ),
+    "agg_growth": ("metric", "estimator", "n_lo", "n_hi", "d"),
+    "pacing_round_wall_ms": (
+        "metric", "estimator", "n_clients", "cohort_spec", "d", "wall_ms",
+    ),
+    "pacing_cost_growth": (
+        "metric", "estimator", "cohort_spec", "n_lo", "n_hi", "growth",
+    ),
+    # scripts/scale_bench.py's BENCH_SCALE artifact object.
+    "scale_bench": (
+        "bench", "rev", "configs", "ratios_10k_over_1k", "acceptance",
+    ),
+}
+
+#: Fields a bench summary must ALSO carry when the named condition key is
+#: present/truthy: an abandoned accelerator attempt must ship evidence.
+CONDITIONAL: dict[str, dict[str, tuple[str, ...]]] = {
+    "bench": {
+        "accel_timeout_phase": ("accel_attempts",),
+        "partial": ("run_stages",),
+    },
+}
+
+
+def validate(obj: dict, kind: str = "bench") -> list[str]:
+    """Problems with ``obj`` under the ``kind`` schema ([] = valid)."""
+    if kind not in SCHEMAS:
+        return [f"unknown bench schema kind {kind!r}"]
+    if not isinstance(obj, dict):
+        return [f"{kind}: expected a JSON object, got {type(obj).__name__}"]
+    problems = [
+        f"{kind}: missing required field {field!r}"
+        for field in SCHEMAS[kind]
+        if field not in obj
+    ]
+    for trigger, extras in CONDITIONAL.get(kind, {}).items():
+        if obj.get(trigger):
+            problems.extend(
+                f"{kind}: {trigger!r} present but required companion "
+                f"{field!r} missing"
+                for field in extras
+                if field not in obj
+            )
+    return problems
+
+
+def validate_row(row: dict) -> list[str]:
+    """Validate a metric-keyed JSON line (agg_microbench rows) against
+    the schema its own ``metric`` field names."""
+    metric = row.get("metric")
+    if metric not in SCHEMAS:
+        return [f"row metric {metric!r} has no registered schema"]
+    return validate(row, metric)
+
+
+def require(obj: dict, kind: str = "bench") -> dict:
+    """Raise ``ValueError`` on schema problems; returns ``obj`` so
+    emitters can validate inline at the emission site."""
+    problems = validate(obj, kind)
+    if problems:
+        raise ValueError(
+            "bench artifact schema violation: " + "; ".join(problems)
+        )
+    return obj

@@ -53,8 +53,30 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_metrics.py tests/test_quality_plane.py \
     tests/test_analysis.py tests/test_pacing.py \
     tests/test_survival.py tests/test_scaleout.py \
+    tests/test_multichip.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
+
+if [ "${MULTICHIP:-0}" = "1" ]; then
+    # Fast multi-chip gate (README "Multi-chip training & bench
+    # interpretation"): the forced-8-device sharded-vs-single-device
+    # parity tests plus the dryrun_multichip graft entry, so the
+    # multi-chip paths stay drivable without an accelerator.
+    echo "== multi-chip parity + graft dryrun (MULTICHIP=1) =="
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_multichip.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print('dryrun_multichip(8) OK')
+" || exit 1
+fi
 
 if [ "${CHAOS:-0}" = "1" ]; then
     # Process-level chaos suite (README "Crash recovery & sessions"):

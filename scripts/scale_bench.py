@@ -36,6 +36,7 @@ import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 OUT_PATH = os.path.join(REPO, "BENCH_SCALE_r01.json")
 
@@ -257,6 +258,11 @@ def main() -> int:
             ),
         },
     }
+    # Shared artifact-shape contract: a BENCH_SCALE artifact missing its
+    # acceptance/ratio fields must fail HERE, not in a later reader.
+    import bench_schema
+
+    bench_schema.require(result, "scale_bench")
     with open(OUT_PATH, "w") as fh:
         json.dump(result, fh, indent=1)
         fh.write("\n")

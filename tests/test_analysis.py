@@ -332,8 +332,33 @@ class TestPrecisionPin:
         rule = PrecisionPinRule()
         assert rule.applies_to("gfedntm_tpu/federation/device_agg.py")
         assert rule.applies_to("gfedntm_tpu/eval/monitor.py")
-        # The Pallas kernel deliberately runs reduced precision.
+        # PR 12: the MFU matmul probe is the throughput-accounting
+        # denominator — gram-adjacent, in scope.
+        assert rule.applies_to("gfedntm_tpu/utils/flops.py")
+        # The Pallas kernel deliberately runs reduced precision, and the
+        # training-step matmuls follow the model's compute_dtype policy.
         assert not rule.applies_to("gfedntm_tpu/ops/fused_decoder.py")
+        assert not rule.applies_to("gfedntm_tpu/train/steps.py")
+
+    def test_seeded_unpinned_flops_probe_fails(self, tmp_path):
+        # PR 12 scope extension: stripping the HIGHEST pin from the live
+        # MFU probe (utils/flops.py) must flag — an unpinned probe on TPU
+        # measures the bf16-pass peak and silently deflates every MFU.
+        import os
+
+        from gfedntm_tpu.analysis.runner import repo_root
+
+        live = os.path.join(repo_root(), "gfedntm_tpu/utils/flops.py")
+        src = open(live).read()
+        assert "precision=jax.lax.Precision.HIGHEST" in src
+        seeded = src.replace(
+            "x, x, precision=jax.lax.Precision.HIGHEST", "x, x", 1
+        )
+        found = lint_src(
+            tmp_path, PrecisionPinRule(paths=EVERYWHERE), seeded,
+            name="flops_seeded.py",
+        )
+        assert any(f.rule_name == "precision-pin" for f in found)
 
 
 # ---------------------------------------------------------------------------

@@ -10,8 +10,16 @@ the round's result. These tests pin that logic (pure host-side — no jax).
 
 import json
 import os
+import sys
 
 import bench as bench_mod
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"),
+)
+import bench_schema  # noqa: E402
 
 
 def _write_artifact(path, backend="tpu", value=123456.7):
@@ -243,3 +251,261 @@ class TestMainOrchestration:
         assert attempts and all(a["phase"] == "run" for a in attempts)
         assert all(a["reason"] == "timeout" for a in attempts)
         assert all(a["timeout_s"] > 0 for a in attempts)
+
+    def test_all_attempts_dead_ships_best_partial(self, monkeypatch,
+                                                  capsys, tmp_path):
+        """ISSUE 12 satellite: when EVERY live attempt hangs, the
+        completed stages' evidence must still ship — the best partial
+        summary any attempt flushed becomes the record (provenance:
+        partial), accel_timeout_phase names the hung STAGE, and the
+        per-attempt breadcrumbs survive with their bulky partial copies
+        stripped. BENCH_r05's rc=124 / parsed:null (all evidence lost)
+        is the regression this pins."""
+        partial = {
+            "metric": "bench_run_partial", "value": 1810.4,
+            "unit": "docs/s", "vs_baseline": None, "backend": "cpu",
+            "partial": True,
+            "stage_order": ["backend_init", "data_staging"],
+            "run_stages": {
+                "backend_init": {"seconds": 2.1, "platform": "cpu"},
+                "data_staging": {"seconds": 7.9, "docs": 2500},
+            },
+        }
+        calls = []
+
+        def fake_run_phase(phase, bk, timeout_s, retries=1, failures=None):
+            calls.append((phase, bk))
+            if failures is not None:
+                failures.append(dict(
+                    phase=phase, backend=bk,
+                    timeout_s=round(timeout_s, 1),
+                    reason="stage_timeout", attempt=1,
+                    stage="first_step_compile",
+                    stages_completed=list(partial["stage_order"]),
+                    partial=dict(partial),
+                ))
+            return None
+
+        monkeypatch.setattr(bench_mod, "_probe_backend", lambda: "axon")
+        monkeypatch.setattr(bench_mod, "_run_phase", fake_run_phase)
+        monkeypatch.setattr(bench_mod.sys, "argv", ["bench.py"])
+        monkeypatch.setenv("BENCH_NO_GIT", "1")
+        monkeypatch.setenv("BENCH_BUDGET_S", "3600")
+        monkeypatch.setattr(
+            bench_mod, "_TPU_ARTIFACT", str(tmp_path / "missing.json")
+        )
+        bench_mod.main()
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        result = json.loads(out)
+        assert result["provenance"] == "partial"
+        assert result["value"] == 1810.4
+        assert result["run_stages"]["data_staging"]["docs"] == 2500
+        assert result["accel_timeout_phase"] == "first_step_compile"
+        attempts = result["accel_attempts"]
+        assert attempts and all("partial" not in a for a in attempts)
+        assert all(
+            a["stages_completed"] == partial["stage_order"]
+            for a in attempts
+        )
+        # The shipped partial satisfies both artifact shape contracts.
+        assert bench_schema.validate(result, "bench_partial") == []
+        assert bench_schema.validate(result, "bench") == []
+
+
+class TestStagedWatchdog:
+    """The staged run-phase machinery itself, against REAL subprocesses:
+    per-stage sub-deadlines enforced from outside, completed stages
+    flushed before the kill, the hung stage named (ISSUE 12 tentpole)."""
+
+    _STAGED_SCRIPT = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import bench
+
+log = bench.StageLog(backend="cpu")
+with log.stage("backend_init") as p:
+    p.update(platform="cpu", devices=8)
+with log.stage("data_staging") as p:
+    p.update(docs=2500, docs_per_s=1810.4)
+with log.stage("first_step_compile") as p:   # BENCH_FAKE_HANG_STAGE hangs here
+    p.update(unreachable=True)
+print("DONE")
+"""
+
+    def _spawn_staged(self, tmp_path, hang_stage, deadline_s="1.0"):
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        stage_path = str(tmp_path / "stages.jsonl")
+        partial_path = str(tmp_path / "partial.json")
+        env = dict(
+            os.environ,
+            BENCH_STAGE_PATH=stage_path,
+            BENCH_PARTIAL_PATH=partial_path,
+            BENCH_FAKE_HANG_STAGE=hang_stage,
+        )
+        env[f"BENCH_STAGE_TIMEOUT_{hang_stage.upper()}"] = deadline_s
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             self._STAGED_SCRIPT.format(repo=repo)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return proc, stage_path, partial_path, env
+
+    def test_hung_stage_killed_at_its_own_deadline(self, tmp_path,
+                                                    monkeypatch):
+        """The monkeypatched-hanging-stage regression: a stage that hangs
+        (BENCH_FAKE_HANG_STAGE, the documented test hook) is killed at
+        ITS deadline — not the whole-phase backstop — and the watcher
+        returns its name; the stages that completed are all on disk."""
+        proc, stage_path, partial_path, env = self._spawn_staged(
+            tmp_path, "first_step_compile"
+        )
+        for k in ("BENCH_STAGE_TIMEOUT_FIRST_STEP_COMPILE",):
+            monkeypatch.setenv(k, env[k])
+        try:
+            hung = bench_mod._watch_stages(
+                proc, stage_path, timeout_s=120.0
+            )
+        finally:
+            proc.kill()
+            proc.wait()
+        assert hung is not None
+        stage, waited = hung
+        assert stage == "first_step_compile"
+        assert 1.0 <= waited < 30.0  # its 1 s deadline, not the 120 s backstop
+        done, inflight = bench_mod._stage_view(
+            bench_mod._read_stage_file(stage_path)
+        )
+        assert done == ["backend_init", "data_staging"]
+        assert inflight is not None and inflight[0] == "first_step_compile"
+        # The partial flushed after every completed stage still ships —
+        # schema-valid, carrying each completed stage's timings/payload.
+        partial = bench_mod._read_partial(partial_path)
+        assert partial is not None
+        assert bench_schema.validate(partial, "bench_partial") == []
+        assert partial["stage_order"] == ["backend_init", "data_staging"]
+        assert partial["run_stages"]["data_staging"]["docs"] == 2500
+        assert partial["value"] == 1810.4  # best completed-stage throughput
+
+    def test_clean_exit_returns_none(self, tmp_path):
+        """No hang -> the watcher reports a clean exit and every stage's
+        done record (and the final partial) is on disk."""
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        stage_path = str(tmp_path / "stages.jsonl")
+        env = dict(os.environ, BENCH_STAGE_PATH=stage_path)
+        env.pop("BENCH_FAKE_HANG_STAGE", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             self._STAGED_SCRIPT.format(repo=repo)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            assert bench_mod._watch_stages(
+                proc, stage_path, timeout_s=120.0
+            ) is None
+        finally:
+            proc.kill()
+            proc.wait()
+        done, inflight = bench_mod._stage_view(
+            bench_mod._read_stage_file(stage_path)
+        )
+        assert done == [
+            "backend_init", "data_staging", "first_step_compile",
+        ]
+        assert inflight is None
+
+    def test_stage_view_tolerates_torn_tail(self, tmp_path):
+        """The writer can be SIGKILLed mid-append: a torn final line must
+        not cost the parsed records before it."""
+        p = tmp_path / "stages.jsonl"
+        p.write_text(
+            json.dumps({"stage": "backend_init", "status": "begin",
+                        "wall_time": 1.0}) + "\n"
+            + json.dumps({"stage": "backend_init", "status": "done",
+                          "seconds": 2.0, "wall_time": 3.0}) + "\n"
+            + '{"stage": "data_st'  # torn mid-append
+        )
+        done, inflight = bench_mod._stage_view(
+            bench_mod._read_stage_file(str(p))
+        )
+        assert done == ["backend_init"]
+        assert inflight is None
+
+    def test_hung_stage_and_best_partial_helpers(self):
+        att = [
+            {"reason": "rc", "stage": None},
+            {"reason": "stage_timeout", "stage": "backend_init",
+             "partial": {"run_stages": {"a": {}}}},
+            {"reason": "stage_timeout", "stage": "data_staging",
+             "partial": {"run_stages": {"a": {}, "b": {}}}},
+        ]
+        assert bench_mod._hung_stage(att) == "data_staging"
+        assert bench_mod._hung_stage([]) is None
+        assert bench_mod._hung_stage(None) is None
+        best = bench_mod._best_partial(att)
+        assert best is not None and len(best["run_stages"]) == 2
+        assert bench_mod._best_partial(None) is None
+        stripped = bench_mod._strip_partials(att)
+        assert all("partial" not in a for a in stripped)
+        assert [a.get("stage") for a in stripped] == [
+            None, "backend_init", "data_staging",
+        ]
+
+
+class TestBenchSchema:
+    """scripts/bench_schema.py — the shared artifact-shape contract
+    (ISSUE 12 satellite: bench.py / agg_microbench.py / scale_bench.py
+    all emit through it so fields can't silently drift)."""
+
+    def test_valid_bench_summary(self):
+        ok = {"metric": "m", "value": 1.0, "unit": "docs/s",
+              "vs_baseline": 2.0, "backend": "cpu"}
+        assert bench_schema.validate(ok, "bench") == []
+        assert bench_schema.require(ok, "bench") is ok
+
+    def test_missing_field_named(self):
+        problems = bench_schema.validate(
+            {"metric": "m", "value": 1.0}, "bench"
+        )
+        assert any("vs_baseline" in p for p in problems)
+        assert any("backend" in p for p in problems)
+
+    def test_conditional_companions(self):
+        """An abandoned accelerator attempt must ship its evidence: a
+        summary claiming accel_timeout_phase without accel_attempts (or
+        partial without run_stages) is a schema violation."""
+        base = {"metric": "m", "value": 0.0, "unit": "docs/s",
+                "vs_baseline": None, "backend": "cpu"}
+        bad = dict(base, accel_timeout_phase="backend_init")
+        assert any(
+            "accel_attempts" in p
+            for p in bench_schema.validate(bad, "bench")
+        )
+        good = dict(bad, accel_attempts=[{"reason": "stage_timeout"}])
+        assert bench_schema.validate(good, "bench") == []
+        bad2 = dict(base, partial=True)
+        assert any(
+            "run_stages" in p for p in bench_schema.validate(bad2, "bench")
+        )
+
+    def test_row_validation_keys_on_metric(self):
+        row = {"metric": "agg_estimator_wall_ms", "estimator": "mean",
+               "backend": "numpy", "n_clients": 4, "d": 1000,
+               "wall_ms": 1.5}
+        assert bench_schema.validate_row(row) == []
+        assert bench_schema.validate_row({"metric": "nope"}) != []
+        del row["wall_ms"]
+        assert bench_schema.validate_row(row) != []
+
+    def test_require_raises_and_unknown_kind(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="backend"):
+            bench_schema.require({"metric": "m"}, "bench")
+        assert bench_schema.validate({}, "no_such_kind") != []
+        assert bench_schema.validate("not a dict", "bench") != []
