@@ -87,6 +87,9 @@ class ExceptionHygieneRule(Rule):
     )
     default_paths = (
         "gfedntm_tpu/federation/",
+        # The serving plane answers live user traffic: a swallowed model-
+        # load or request-path failure is an outage nobody can see.
+        "gfedntm_tpu/serving/",
         "gfedntm_tpu/utils/observability.py",
         "gfedntm_tpu/train/guardian.py",
         "gfedntm_tpu/train/checkpoint.py",

@@ -59,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--id", type=int, default=None,
                    help="node id (0 = server, >=1 = client; omit to simulate)")
-    p.add_argument("--role", choices=("auto", "server", "client", "relay"),
+    p.add_argument("--role",
+                   choices=("auto", "server", "client", "relay", "serve"),
                    default="auto",
                    help="process role (default auto: derived from --id). "
                         "'relay' runs a mid-tier aggregator (README "
@@ -68,7 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "with the full admission gate, pre-reduces them "
                         "into one pseudo-update, and joins the upstream "
                         "server at --server_address as ordinary client "
-                        "--id")
+                        "--id. 'serve' runs the topic-inference serving "
+                        "plane (README \"Serving\"): it watches save_dir "
+                        "for journal/checkpoint-published rounds, "
+                        "hot-swaps the newest un-flagged model, and "
+                        "answers doc->theta queries over gRPC Infer and "
+                        "the ops-HTTP /infer route")
     p.add_argument("--source", type=str, default=None,
                    help="data path (.npz synthetic archive or .parquet)")
     p.add_argument("--data_type", choices=("synthetic", "real"),
@@ -278,6 +284,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the divergence-rollback path, reason "
                         "'coherence_collapse' (needs --quality_every > 0 "
                         "and --quality_ref)")
+    # Serving plane (README "Serving"): the `serve` role's knobs. The
+    # model identity (family/kwargs/vocab) normally comes from the
+    # journal itself (self-describing since the serving PR); --model_type
+    # + --config are the fallback for older recovery state.
+    p.add_argument("--serve_poll", type=float, default=1.0,
+                   help="serve role: seconds between checks of save_dir "
+                        "for a newer published round (default 1.0)")
+    p.add_argument("--serve_max_batch", type=int, default=64,
+                   help="serve role: micro-batch doc cap — requests "
+                        "coalesce up to this many docs per compiled "
+                        "bucket program (default 64)")
+    p.add_argument("--serve_linger_ms", type=float, default=2.0,
+                   help="serve role: how long an idle batcher waits for "
+                        "company before dispatching a lone request "
+                        "(fuller buckets vs added latency; default 2 ms)")
+    p.add_argument("--serve_duration", type=float, default=0.0,
+                   help="serve role: exit after this many seconds "
+                        "(0 = serve until interrupted — production mode)")
+    p.add_argument("--no_quality_gate", action="store_true",
+                   help="serve role: swap in every published round, even "
+                        "ones the coherence guard flagged (the gate is ON "
+                        "by default; see README \"Serving\")")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="multi-chip local training: data-shard each local "
                         "corpus over a 1-D mesh of the first N devices "
@@ -598,6 +626,56 @@ def run_relay(args: argparse.Namespace, cfg: GfedConfig) -> int:
     relay.wait_done()
     relay.shutdown()
     metrics.close()
+    return 0
+
+
+def run_serve(args: argparse.Namespace, cfg: GfedConfig) -> int:
+    """``--role serve``: the topic-inference serving plane (README
+    "Serving") — load the newest published round from ``save_dir``'s
+    journal/checkpoint store, hot-swap as the federation publishes newer
+    ones (refusing coherence-flagged candidates), and answer doc→θ
+    queries over gRPC ``Infer`` plus the ops-HTTP ``/infer`` route."""
+    from gfedntm_tpu.serving import ServingPlane
+    from gfedntm_tpu.utils.observability import MetricsLogger
+
+    save_dir = os.path.join(args.save_dir, "serve")
+    metrics = MetricsLogger(
+        os.path.join(save_dir, "metrics.jsonl"), node="serve"
+    )
+    plane = ServingPlane(
+        args.save_dir,
+        family=args.model_type,
+        model_kwargs=model_kwargs_from_config(cfg, args.model_type),
+        max_batch=getattr(args, "serve_max_batch", 64),
+        linger_s=getattr(args, "serve_linger_ms", 2.0) / 1e3,
+        poll_s=getattr(args, "serve_poll", 1.0),
+        quality_gate=not getattr(args, "no_quality_gate", False),
+        metrics=metrics,
+        ops_port=getattr(args, "ops_port", None),
+    )
+    # Distinct default base from the client (50051+id) and relay
+    # (51051+id) schemes so a co-hosted serving plane never collides.
+    port = args.listen_port if args.listen_port is not None else 52051
+    plane.start(f"[::]:{port}")
+    logging.info(
+        "serving plane on gRPC port %d (ops %s); watching %s",
+        plane.bound_port, plane.ops_actual_port, args.save_dir,
+    )
+    duration = getattr(args, "serve_duration", 0.0) or 0.0
+    try:
+        if duration > 0:
+            import time
+
+            time.sleep(duration)
+        else:
+            while not plane.wait(timeout=3600.0):
+                pass
+    except KeyboardInterrupt:
+        logging.info("serving plane interrupted; draining")
+    finally:
+        plane.stop()
+        metrics.snapshot_registry()
+        metrics.close()
     return 0
 
 
@@ -937,6 +1015,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     cfg = load_config(args)
     role = getattr(args, "role", "auto")
+    if role == "serve":
+        return run_serve(args, cfg)
     if role == "relay":
         return run_relay(args, cfg)
     if role == "server" or (role == "auto" and args.id == 0):

@@ -33,6 +33,12 @@ SERVICES: dict[str, dict[str, tuple[Any, Any]]] = {
         "TrainStep": (pb.StepRequest, pb.StepReply),
         "ApplyAggregate": (pb.Aggregate, pb.AggregateReply),
     },
+    # Serving plane (README "Serving"): the user-facing doc->topic
+    # inference workload, served by the `serve` CLI role against
+    # journal/checkpoint-published rounds while the federation trains.
+    "gfedntm.Inference": {
+        "Infer": (pb.InferRequest, pb.InferReply),
+    },
 }
 
 # Methods an impl may legitimately omit at add_service time (the caller

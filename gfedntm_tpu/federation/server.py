@@ -774,11 +774,33 @@ class FederatedServer:
         ]
 
     def _state_extra(self) -> dict[str, Any]:
-        return {
+        """JSON-able run descriptors persisted with checkpoints and the
+        round journal. ``model_kwargs`` makes the recovery state
+        self-describing for the SERVING plane (README "Serving"): a
+        ``serve`` process can rebuild the exact template model from the
+        journal alone, no operator model flags. ``quality`` is the PR 7
+        coherence guard's verdict on the journaled round — the serving
+        plane refuses to hot-swap in a candidate whose quality round the
+        guard flagged (``flagged`` = a live unhealthy streak at journal
+        time), keeping the last good model instead."""
+        extra: dict[str, Any] = {
             "family": self.family,
             "aggregator": self.aggregator.name,
             "wire_codec": self.wire_codec.codec_id,
+            "model_kwargs": dict(self.model_kwargs),
         }
+        mon = self._quality_mon
+        if mon is not None:
+            view = mon.status()
+            streak = int(view.get("unhealthy_streak") or 0)
+            last = view.get("last") or {}
+            extra["quality"] = {
+                "flagged": streak > 0,
+                "unhealthy_streak": streak,
+                "npmi": last.get("npmi"),
+                "round": last.get("round"),
+            }
+        return extra
 
     def _save_round_checkpoint(self) -> None:
         """Persist round state (never lets a checkpoint failure kill

@@ -338,14 +338,23 @@ class DecoderNetwork(nn.Module):
             theta=theta,
         )
 
-    def get_theta(self, x_bow, x_ctx=None, labels=None):
+    def get_theta(self, x_bow, x_ctx=None, labels=None, *, noise=None):
         """MC-sample theta without touching BatchNorm stats or dropout
-        (``decoder_network.py:137-147``: eval forward + fresh reparam draw)."""
+        (``decoder_network.py:137-147``: eval forward + fresh reparam draw).
+
+        ``noise`` injects a fixed eps instead of the rng draw — ``0.0``
+        yields the DETERMINISTIC posterior-mean theta ``softmax(mu)`` the
+        serving plane answers queries with (no rng collection needed);
+        the default keeps the reference's MC-sampling semantics."""
         posterior_mu, posterior_log_sigma = self._encode(
             x_bow, x_ctx, labels, train=False, mask=None
         )
         std = jnp.exp(0.5 * posterior_log_sigma)
-        eps = jax.random.normal(
-            self.make_rng("reparam"), std.shape, dtype=std.dtype
+        eps = (
+            noise
+            if noise is not None
+            else jax.random.normal(
+                self.make_rng("reparam"), std.shape, dtype=std.dtype
+            )
         )
         return jax.nn.softmax(posterior_mu + eps * std, axis=1)

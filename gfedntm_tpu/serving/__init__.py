@@ -1,0 +1,39 @@
+"""Serving plane: hot-swappable doc→topic inference as a first-class
+production workload (README "Serving").
+
+- :mod:`~gfedntm_tpu.serving.engine` — published-round model source
+  (journal/checkpoint prefer-newer), the JIT'd bucket-padded encoder-only
+  doc→θ engine, and the quality-gated atomic hot-swap.
+- :mod:`~gfedntm_tpu.serving.service` — micro-batch coalescing, the
+  gRPC ``Infer`` servicer, the ops-HTTP ``/infer`` + ``/ready`` surface,
+  and the :class:`ServingPlane` process wrapper the ``serve`` CLI role
+  runs.
+- :mod:`~gfedntm_tpu.serving.loadgen` — the closed-loop saturating load
+  generator behind the BENCH_SERVE artifacts.
+"""
+
+from gfedntm_tpu.serving.engine import (
+    ModelSource,
+    PublishedModel,
+    ServingEngine,
+    default_buckets,
+)
+from gfedntm_tpu.serving.loadgen import ClosedLoopLoadGen
+from gfedntm_tpu.serving.service import (
+    Batcher,
+    InferenceServicer,
+    ServingPlane,
+    make_infer_stub,
+)
+
+__all__ = [
+    "Batcher",
+    "ClosedLoopLoadGen",
+    "InferenceServicer",
+    "ModelSource",
+    "PublishedModel",
+    "ServingEngine",
+    "ServingPlane",
+    "default_buckets",
+    "make_infer_stub",
+]

@@ -1,0 +1,643 @@
+"""Hot-swappable doc→topic inference engine (README "Serving").
+
+The training planes end at the last averaged round; this module is the
+first *serving* workload: it loads published global models from the same
+journal/checkpoint store the federation server writes (PR 10
+:class:`~gfedntm_tpu.train.checkpoint.RoundJournal` +
+:class:`~gfedntm_tpu.train.checkpoint.FederationCheckpointer`, with
+``restore_from_checkpoint``'s prefer-newer rule), JITs the encoder-only
+doc→θ path (:meth:`DecoderNetwork.get_theta` with ``noise=0`` — the
+deterministic posterior-mean theta, eval-mode BatchNorm, no dropout, no
+decoder matmul), and swaps models atomically as the federation publishes
+new rounds — without dropping in-flight requests.
+
+Design points:
+
+- **Bucketed padding** (:func:`gfedntm_tpu.parallel.mesh.pad_to_multiple`
+  semantics on the batch axis): request batches are padded up to a small
+  set of power-of-two bucket sizes, so the steady state runs a handful of
+  compiled programs instead of recompiling per ragged batch — the same
+  recompile-kill recipe as ``train.steps.pad_batch_axis``. Padded rows
+  are all-zero BoW vectors; eval-mode BatchNorm uses running statistics,
+  so they cannot perturb the real rows and are sliced off before return.
+- **Donated steady state** (:func:`gfedntm_tpu.train.steps.donation_argnums`
+  gating, accelerator-only): the padded input buffer is freshly built per
+  batch and never read after the call, so donating it lets XLA reuse its
+  HBM for the θ output instead of double-buffering every request.
+- **Atomic hot-swap**: a published round is loaded, applied, and **warmed
+  through every bucket** off to the side, then installed by a single
+  attribute rebind. In-flight requests snapshot the slot once at batch
+  time — a swap under them is invisible; nothing is ever torn down while
+  referenced.
+- **Quality gate**: a candidate whose journaled ``quality`` record says
+  the PR 7 coherence guard had a live unhealthy streak
+  (``quality.flagged``) is refused — the plane keeps serving the last
+  good model and emits a ``serve_swap_refused`` event + counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "PublishedModel",
+    "ModelSource",
+    "ServingEngine",
+    "default_buckets",
+]
+
+
+@dataclasses.dataclass
+class PublishedModel:
+    """One published global model, as read from the recovery store."""
+
+    round: int
+    source: str  # "journal" | "checkpoint"
+    vocab: tuple[str, ...]
+    family: str
+    model_kwargs: dict[str, Any]
+    average: dict[str, np.ndarray]
+    quality: dict[str, Any] | None = None
+
+    @property
+    def flagged(self) -> bool:
+        """True when the coherence guard had a live unhealthy streak at
+        the time this round was journaled (README "Model-quality
+        observability") — the serving plane must not swap it in."""
+        return bool((self.quality or {}).get("flagged"))
+
+
+class ModelSource:
+    """Read-side twin of ``FederatedServer.restore_from_checkpoint``:
+    watches a federation ``save_dir`` for newly published rounds and
+    loads the newest of the round journal and the orbax checkpoint.
+
+    ``family``/``model_kwargs`` are fallbacks for recovery state written
+    before the journal became self-describing; newer state carries both
+    in its ``extra`` record and wins. :meth:`peek` reads only the two
+    JSON halves (cheap enough for a poll loop); :meth:`load` pays the
+    array read.
+    """
+
+    def __init__(
+        self,
+        save_dir: str,
+        family: str = "avitm",
+        model_kwargs: dict[str, Any] | None = None,
+        logger: logging.Logger | None = None,
+        metrics=None,
+    ):
+        import os
+
+        self.directory = os.path.join(os.path.abspath(save_dir), "checkpoints")
+        self.family = family
+        self.model_kwargs = dict(model_kwargs or {})
+        self.logger = logger or logging.getLogger("ModelSource")
+        self.metrics = metrics
+        # Both stores are constructed lazily AND only once the directory
+        # exists: this is a pure READER — RoundJournal/
+        # FederationCheckpointer.__init__ would mkdir the store, and a
+        # serve role pointed at a typo'd save_dir must keep polling an
+        # absent store (ready stays 503), not plant an empty one there.
+        self._journal = None
+        self._ckpt = None
+
+    def _store_exists(self) -> bool:
+        import os
+
+        return os.path.isdir(self.directory)
+
+    def _journal_obj(self):
+        if self._journal is None and self._store_exists():
+            from gfedntm_tpu.train.checkpoint import RoundJournal
+
+            self._journal = RoundJournal(self.directory)
+        return self._journal
+
+    def _checkpointer(self):
+        if self._ckpt is None and self._store_exists():
+            from gfedntm_tpu.train.checkpoint import FederationCheckpointer
+
+            self._ckpt = FederationCheckpointer(self.directory)
+        return self._ckpt
+
+    def _journal_meta(self) -> dict[str, Any] | None:
+        """Journal JSON half, or None; corruption is loud but demotes to
+        the checkpoint (the server's own degradation rule)."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        journal = self._journal_obj()
+        if journal is None:
+            return None
+        try:
+            meta = journal.load_meta()
+        except CheckpointIntegrityError as err:
+            self.logger.error("round journal unusable for serving: %s", err)
+            if self.metrics is not None:
+                self.metrics.registry.counter("serving_source_errors").inc()
+            return None
+        # A finished journal still describes a perfectly servable model —
+        # recovery must not resurrect it, but serving it is the point.
+        return meta
+
+    def peek(self) -> tuple[int, str] | None:
+        """Newest published ``(model_round, source)`` without touching
+        arrays, or ``None`` when nothing is published yet. Both sources
+        are reported on the JOURNAL's scale — the round the model was
+        averaged at: the journal records the last fully-pushed round R
+        directly, while the checkpoint sidecar's ``round`` is the RESUME
+        round (the round training continues FROM), i.e. model round + 1,
+        so it is normalized down by one. Mixing the two scales would
+        both mislabel ``model_round`` in replies and make ``publish``
+        refuse a journal round strictly newer than a checkpoint-sourced
+        slot. Same prefer-newer rule as ``restore_from_checkpoint``."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        jmeta = self._journal_meta()
+        j_round = int(jmeta["round"]) if jmeta is not None else None
+        if j_round is not None and j_round < 0:
+            j_round = None  # finished-stamp placeholder, no arrays
+        ckpt = self._checkpointer()
+        try:
+            cmeta = ckpt.load_meta() if ckpt is not None else None
+        except CheckpointIntegrityError as err:
+            self.logger.error("checkpoint unusable for serving: %s", err)
+            cmeta = None
+        c_model = (
+            max(int(cmeta["round"]) - 1, 0) if cmeta is not None else None
+        )
+        if j_round is None and c_model is None:
+            return None
+        if c_model is None or (j_round is not None and j_round >= c_model):
+            return (j_round, "journal")
+        return (c_model, "checkpoint")
+
+    def load(self) -> PublishedModel | None:
+        """Load the newest published model (arrays included), or ``None``
+        when nothing is published. Integrity failures degrade journal →
+        checkpoint and raise only when neither half is usable."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        newest = self.peek()
+        if newest is None:
+            return None
+        _round, source = newest
+        if source == "journal":
+            try:
+                jstate = self._journal_obj().load(include_finished=True)
+            except CheckpointIntegrityError as err:
+                # For a LIVE reader a halves-disagreement is usually the
+                # server mid-write (npz lands before the JSON) — the next
+                # poll self-heals. Degrade to the checkpoint quietly but
+                # visibly (counter); the server-side recovery path is the
+                # one that treats this state as corruption.
+                self.logger.info(
+                    "journal not readable this poll (%s); degrading to "
+                    "the checkpoint and retrying next poll", err,
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter(
+                        "serving_source_retries"
+                    ).inc()
+                jstate = None
+            if jstate is not None:
+                return self._published_from_meta(
+                    int(jstate["round"]), "journal", jstate,
+                    jstate["average"],
+                )
+        return self._load_checkpoint()
+
+    def _load_checkpoint(self) -> PublishedModel | None:
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        ckpt = self._checkpointer()
+        if ckpt is None:
+            return None
+        try:
+            meta = ckpt.load_meta()
+        except CheckpointIntegrityError:
+            meta = None
+        if meta is None or ckpt.latest_round() is None:
+            return None
+        vocab, family, kwargs = self._model_identity(meta)
+        template = _flat_template(family, vocab, kwargs)
+        try:
+            round_idx, average = ckpt.restore_round(template)
+        except (CheckpointIntegrityError, FileNotFoundError) as err:
+            self.logger.error("checkpoint restore failed for serving: %s", err)
+            if self.metrics is not None:
+                self.metrics.registry.counter("serving_source_errors").inc()
+            return None
+        # Normalize the sidecar's RESUME-round label to the model-round
+        # scale the journal (and every reply/gauge) uses — see peek().
+        return self._published_from_meta(
+            max(int(round_idx) - 1, 0), "checkpoint", meta, average
+        )
+
+    def _model_identity(
+        self, meta: Mapping[str, Any]
+    ) -> tuple[tuple[str, ...], str, dict[str, Any]]:
+        vocab = tuple(meta.get("vocab") or ())
+        if not vocab:
+            raise ValueError(
+                f"recovery state under {self.directory} has no consensus "
+                "vocabulary; the serving plane cannot rebuild the model"
+            )
+        family = meta.get("family") or self.family
+        kwargs = dict(meta.get("model_kwargs") or self.model_kwargs)
+        if not kwargs:
+            raise ValueError(
+                "recovery state predates self-describing journals and no "
+                "model_kwargs were configured; pass the training model "
+                "config to the serve role"
+            )
+        return vocab, family, kwargs
+
+    def _published_from_meta(
+        self, round_idx: int, source: str, meta: Mapping[str, Any],
+        average: dict[str, np.ndarray],
+    ) -> PublishedModel:
+        vocab, family, kwargs = self._model_identity(meta)
+        quality = meta.get("quality")
+        return PublishedModel(
+            round=int(round_idx), source=source, vocab=vocab,
+            family=family, model_kwargs=kwargs,
+            average={k: np.asarray(v) for k, v in average.items()},
+            quality=dict(quality) if isinstance(quality, dict) else None,
+        )
+
+
+def default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Power-of-two bucket sizes up to (and including) ``max_batch`` —
+    the padded batch shapes the serving programs compile for."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+def _flat_template(
+    family: str, vocab: tuple[str, ...], model_kwargs: dict[str, Any]
+):
+    """Flat ``key -> np.ndarray`` view of a freshly built template model's
+    variables — the restore target for checkpoint rounds (covers every
+    possible ``average_keys`` subset)."""
+    from flax.traverse_util import flatten_dict
+
+    from gfedntm_tpu.federation.server import build_template_model
+
+    model = build_template_model(family, len(vocab), model_kwargs)
+    flat = flatten_dict(
+        {"params": model.params, "batch_stats": model.batch_stats}, sep="/"
+    )
+    return {k: np.asarray(v) for k, v in flat.items()}
+
+
+class _ModelSlot:
+    """One immutable serving model: module + applied variables. Requests
+    snapshot the slot reference once per batch, so an engine-level swap
+    can never change state under a running program."""
+
+    __slots__ = (
+        "round", "source", "module", "params", "batch_stats", "vocab",
+        "family", "model_kwargs", "n_components",
+    )
+
+    def __init__(self, pub: PublishedModel, module, params, batch_stats):
+        self.round = pub.round
+        self.source = pub.source
+        self.module = module
+        self.params = params
+        self.batch_stats = batch_stats
+        self.vocab = pub.vocab
+        self.family = pub.family
+        self.model_kwargs = dict(pub.model_kwargs)
+        self.n_components = int(module.n_components)
+
+
+class ServingEngine:
+    """JIT-compiled, bucket-padded, hot-swappable doc→θ inference.
+
+    :meth:`publish` installs a :class:`PublishedModel` (building the
+    template, applying the averaged variables, and pre-warming every
+    bucket program) behind the quality gate; :meth:`infer` answers one
+    BoW batch against whatever slot is installed at that moment. Both are
+    safe to call concurrently: ``publish`` serializes on a lock and
+    installs by atomic rebind, ``infer`` reads the slot exactly once.
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 64,
+        buckets: tuple[int, ...] | None = None,
+        metrics=None,
+        logger: logging.Logger | None = None,
+        quality_gate: bool = True,
+        donate: bool = True,
+        warm_on_publish: bool = True,
+    ):
+        self.max_batch = int(max_batch)
+        self.buckets = tuple(sorted(buckets or default_buckets(max_batch)))
+        if self.buckets[-1] != self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} must equal max_batch "
+                f"{self.max_batch}"
+            )
+        self.metrics = metrics
+        self.logger = logger or logging.getLogger("ServingEngine")
+        self.quality_gate = bool(quality_gate)
+        self.donate = bool(donate)
+        self.warm_on_publish = bool(warm_on_publish)
+        self._slot: _ModelSlot | None = None
+        self._fns: dict[tuple[Any, int], Any] = {}
+        self._publish_lock = threading.Lock()
+
+    # ---- state ------------------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        """Loaded and warm — the ``/ready`` contract (README "Serving")."""
+        return self._slot is not None
+
+    @property
+    def model_round(self) -> int | None:
+        slot = self._slot
+        return slot.round if slot is not None else None
+
+    @property
+    def vocab(self) -> tuple[str, ...] | None:
+        """The serving model's consensus vocabulary (token order = BoW
+        column order), or None before the first publish."""
+        slot = self._slot
+        return slot.vocab if slot is not None else None
+
+    def status(self) -> dict[str, Any]:
+        """JSON-safe view for ``/status``'s ``serving`` key."""
+        slot = self._slot
+        reg = self.metrics.registry if self.metrics is not None else None
+
+        def count(name):
+            m = reg.get(name) if reg is not None else None
+            return int(m.value) if m is not None else 0
+
+        out: dict[str, Any] = {
+            "ready": slot is not None,
+            "quality_gate": self.quality_gate,
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "swaps": count("serving_swaps"),
+            "swaps_refused": count("serving_swaps_refused"),
+        }
+        if slot is not None:
+            out.update(
+                model_round=slot.round,
+                model_source=slot.source,
+                family=slot.family,
+                vocab_size=len(slot.vocab),
+                n_components=slot.n_components,
+            )
+        return out
+
+    # ---- hot-swap ---------------------------------------------------------
+    def publish(self, pub: PublishedModel) -> bool:
+        """Install ``pub`` as the serving model. Returns True when the
+        swap happened; False when the candidate was refused (quality
+        flag) or is not newer than the installed round. Never tears down
+        the installed slot on failure — the last good model keeps
+        serving."""
+        with self._publish_lock:
+            slot = self._slot
+            if slot is not None and pub.round <= slot.round:
+                return False
+            if self.quality_gate and pub.flagged:
+                self.logger.warning(
+                    "refusing to swap in round %d: the coherence guard "
+                    "flagged it (unhealthy streak %s); keeping round %s",
+                    pub.round,
+                    (pub.quality or {}).get("unhealthy_streak"),
+                    slot.round if slot is not None else None,
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter(
+                        "serving_swaps_refused"
+                    ).inc()
+                    self.metrics.log(
+                        "serve_swap_refused", round=pub.round,
+                        reason="coherence_flagged",
+                        kept_round=slot.round if slot is not None else None,
+                    )
+                return False
+            new_slot = self._build_slot(pub)
+            if self.warm_on_publish:
+                # Warm every bucket BEFORE the rebind: the first real
+                # request after a swap must hit a compiled program, not a
+                # compile stall — in-flight and post-swap traffic both
+                # see steady-state latency.
+                self._warm(new_slot)
+            prev_round = slot.round if slot is not None else None
+            self._slot = new_slot
+        if self.metrics is not None:
+            reg = self.metrics.registry
+            reg.gauge("serving_model_round").set(pub.round)
+            if prev_round is None:
+                self.metrics.log(
+                    "serve_model_loaded", round=pub.round, source=pub.source,
+                )
+            else:
+                reg.counter("serving_swaps").inc()
+                self.metrics.log(
+                    "serve_model_swapped", round=pub.round,
+                    prev_round=prev_round, source=pub.source,
+                )
+        self.logger.info(
+            "serving round %d (%s)%s", pub.round, pub.source,
+            "" if prev_round is None else f" (swapped from {prev_round})",
+        )
+        return True
+
+    def _build_slot(self, pub: PublishedModel) -> _ModelSlot:
+        """Template + averaged variables for one published round. When
+        the model identity (family, vocab, kwargs) matches the installed
+        slot, start from ITS variables instead of re-initializing — the
+        non-averaged leaves are identical by construction (deterministic
+        seeded init) and the rebuild is one flat-dict merge."""
+        import jax.numpy as jnp
+        from flax.traverse_util import flatten_dict, unflatten_dict
+
+        slot = self._slot
+        if (
+            slot is not None
+            and slot.family == pub.family
+            and slot.vocab == pub.vocab
+            and slot.model_kwargs == dict(pub.model_kwargs)
+        ):
+            module = slot.module
+            variables = {
+                "params": slot.params, "batch_stats": slot.batch_stats,
+            }
+        else:
+            from gfedntm_tpu.federation.server import build_template_model
+
+            model = build_template_model(
+                pub.family, len(pub.vocab), pub.model_kwargs
+            )
+            module = model.module
+            variables = {
+                "params": model.params, "batch_stats": model.batch_stats,
+            }
+        flat = dict(flatten_dict(variables, sep="/"))
+        unknown = [k for k in pub.average if k not in flat]
+        if unknown:
+            raise ValueError(
+                f"published round {pub.round} carries keys the template "
+                f"does not have (model config drift?): {unknown[:3]}"
+            )
+        for key, value in pub.average.items():
+            flat[key] = jnp.asarray(value, flat[key].dtype)
+        restored = unflatten_dict(flat, sep="/")
+        return _ModelSlot(
+            pub, module, restored["params"], restored.get("batch_stats", {}),
+        )
+
+    def _warm(self, slot: _ModelSlot) -> None:
+        import jax
+
+        vocab_size = len(slot.vocab)
+        ctx_size = self._ctx_size(slot.module)
+        for bucket in self.buckets:
+            x = np.zeros((bucket, vocab_size), np.float32)
+            ctx = (
+                np.zeros((bucket, ctx_size), np.float32) if ctx_size else None
+            )
+            theta = self._theta_fn(slot.module, bucket)(
+                slot.params, slot.batch_stats, x, ctx
+            )
+            jax.block_until_ready(theta)
+
+    @staticmethod
+    def _ctx_size(module) -> int:
+        """Contextual-embedding width a CTM encoder requires per doc
+        (0 for the BoW-only AVITM encoder)."""
+        if getattr(module, "inference_type", "bow") == "bow":
+            return 0
+        return int(getattr(module, "contextual_size", 0))
+
+    # ---- inference --------------------------------------------------------
+    def _theta_fn(self, module, bucket: int):
+        """The jitted encoder-only program for one (module, bucket) pair.
+        Modules are frozen config dataclasses, so an unchanged model
+        identity across swaps reuses both the callable and its compiled
+        executables; the input buffers are donated (accelerator-only) —
+        they are freshly padded per batch and never read back."""
+        import jax
+
+        from gfedntm_tpu.models.networks import DecoderNetwork
+        from gfedntm_tpu.train.steps import donation_argnums
+        from gfedntm_tpu.utils.observability import timed_jit
+
+        key = (module, bucket)
+        fn = self._fns.get(key)
+        if fn is None:
+
+            def serve(params, batch_stats, x_bow, x_ctx):
+                return module.apply(
+                    {"params": params, "batch_stats": batch_stats},
+                    x_bow, x_ctx,
+                    method=DecoderNetwork.get_theta,
+                    noise=0.0,
+                )
+
+            fn = timed_jit(
+                jax.jit(
+                    serve,
+                    donate_argnums=donation_argnums((2, 3), self.donate),
+                ),
+                self.metrics, f"serve_theta_b{bucket}",
+            )
+            self._fns[key] = fn
+        return fn
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest bucket that holds ``rows`` (callers chunk above
+        ``max_batch`` first)."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        raise ValueError(
+            f"batch of {rows} exceeds max_batch {self.max_batch}"
+        )
+
+    def infer(
+        self, x_bow: np.ndarray, x_ctx: np.ndarray | None = None
+    ) -> tuple[np.ndarray, int]:
+        """Answer one ``[B, V]`` BoW batch: returns ``(theta [B, K],
+        model_round)``. Deterministic (posterior-mean θ, eval-mode BN),
+        batch-size invariant under the bucket padding, and pinned to ONE
+        slot for its whole duration — a concurrent hot-swap affects only
+        later batches."""
+        slot = self._slot
+        if slot is None:
+            raise RuntimeError(
+                "serving engine has no model yet (nothing published under "
+                "the watched save_dir)"
+            )
+        x_bow = np.asarray(x_bow, np.float32)
+        if x_bow.ndim != 2:
+            raise ValueError(f"x_bow must be [B, V], got {x_bow.shape}")
+        if x_bow.shape[1] != len(slot.vocab):
+            raise ValueError(
+                f"x_bow has vocab width {x_bow.shape[1]}, the serving "
+                f"model expects {len(slot.vocab)}"
+            )
+        ctx_size = self._ctx_size(slot.module)
+        if ctx_size and x_ctx is None:
+            raise ValueError(
+                f"the serving model is a CTM ({slot.module.inference_type} "
+                f"encoder): each doc needs a [{ctx_size}]-wide contextual "
+                "embedding (x_ctx)"
+            )
+        if x_ctx is not None:
+            x_ctx = np.asarray(x_ctx, np.float32)
+        rows = x_bow.shape[0]
+        outs = []
+        for lo in range(0, rows, self.max_batch):
+            chunk = x_bow[lo:lo + self.max_batch]
+            ctx_chunk = (
+                x_ctx[lo:lo + self.max_batch] if x_ctx is not None else None
+            )
+            outs.append(self._infer_bucket(slot, chunk, ctx_chunk))
+        theta = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        return theta, slot.round
+
+    def _infer_bucket(self, slot, x_bow, x_ctx):
+        b = x_bow.shape[0]
+        bucket = self.bucket_for(b)
+        if bucket != b:
+            pad = np.zeros((bucket, x_bow.shape[1]), np.float32)
+            pad[:b] = x_bow
+            x_bow = pad
+            if x_ctx is not None:
+                cpad = np.zeros((bucket, x_ctx.shape[1]), np.float32)
+                cpad[:b] = x_ctx
+                x_ctx = cpad
+        if self.metrics is not None:
+            reg = self.metrics.registry
+            reg.histogram(
+                "serve_batch_fill",
+                buckets=(0.125, 0.25, 0.5, 0.75, 0.9, 1.0),
+            ).observe(b / bucket)
+            reg.gauge("serving_batch_fill").set(b / bucket)
+            reg.counter("serving_docs").inc(b)
+        theta = self._theta_fn(slot.module, bucket)(
+            slot.params, slot.batch_stats, x_bow, x_ctx
+        )
+        return np.asarray(theta)[:b]

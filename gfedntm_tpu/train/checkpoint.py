@@ -400,15 +400,18 @@ class RoundJournal:
             "delete it to fall back to the latest orbax checkpoint",
         )
 
-    def load(self) -> "dict[str, Any] | None":
+    def load(self, include_finished: bool = False) -> "dict[str, Any] | None":
         """Load the journaled round: a dict with ``round``, ``average``,
         ``aggregator_state``, ``membership``, ``vocab``, and every extra
         key the writer recorded — or ``None`` when no journal exists (or
-        it is marked finished). Integrity failures (corrupt JSON/npz, or
-        a round tag disagreement from a kill between the two writes)
-        raise :class:`CheckpointIntegrityError`."""
+        it is marked finished — ``include_finished=True`` loads it
+        anyway: the SERVING plane wants a cleanly-finished run's final
+        model, which only auto-recovery must never resurrect). Integrity
+        failures (corrupt JSON/npz, or a round tag disagreement from a
+        kill between the two writes) raise
+        :class:`CheckpointIntegrityError`."""
         meta = self.load_meta()
-        if meta is None or meta.get("finished"):
+        if meta is None or (meta.get("finished") and not include_finished):
             return None
         if not os.path.exists(self.state_path):
             raise CheckpointIntegrityError(

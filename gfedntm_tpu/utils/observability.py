@@ -154,6 +154,22 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     # multi-chip data-sharded local training (parallel.sharded
     # .fit_data_sharded / the mesh-enabled federation client)
     "sharded_fit": frozenset({"devices", "docs_per_s"}),
+    # serving plane (hot-swappable doc->topic inference; README "Serving"):
+    # model lifecycle + request-path failures. Per-request successes stay
+    # out of the JSONL stream (they aggregate into the serve_latency_s
+    # histogram and the serving_* counters, surfaced via
+    # metrics_snapshot) — at production QPS one event per request would
+    # dwarf every other stream combined.
+    "serve_model_loaded": frozenset({"round", "source"}),
+    "serve_model_swapped": frozenset({"round", "prev_round"}),
+    "serve_swap_refused": frozenset({"round", "reason"}),
+    "serve_error": frozenset({"reason"}),
+    # closed-loop load generator summary (scripts/serve_bench.py + the
+    # serving e2e tests): one record per measured window, the JSONL
+    # ground truth BENCH_SERVE artifacts are reproduced from.
+    "serve_load_window": frozenset(
+        {"seconds", "docs", "requests", "failures", "docs_per_s"}
+    ),
 }
 
 
@@ -619,6 +635,19 @@ SCALEOUT_EVENTS: tuple[str, ...] = (
     "push_aggregated",
     "relay_joined",
     "relay_preaggregated",
+)
+
+#: Serving-plane events (model load / hot-swap / quality-gated refusal /
+#: request-path errors — README "Serving"). Same reverse-lint contract:
+#: graftlint verifies each keeps an emission call site, so a refactor can
+#: never silently disconnect the swap audit trail BENCH_SERVE
+#: reproducibility (and the zero-dropped-requests claim) depends on.
+SERVING_EVENTS: tuple[str, ...] = (
+    "serve_model_loaded",
+    "serve_model_swapped",
+    "serve_swap_refused",
+    "serve_error",
+    "serve_load_window",
 )
 
 
@@ -1605,7 +1634,13 @@ class OpsServer:
     """Live ops endpoint: a stdlib ``ThreadingHTTPServer`` on a daemon
     thread serving
 
-    - ``/healthz`` — liveness probe (``200 ok``);
+    - ``/healthz`` — liveness probe (``200 ok``): the ops thread exists;
+    - ``/ready`` — readiness probe, distinct from liveness (README
+      "Serving"): 200 only when ``ready_fn`` returns truthy — for the
+      serving plane that means "a model is loaded and the encoder is
+      warm", which a load balancer must gate on before routing traffic;
+      503 otherwise. Without a ``ready_fn`` the route mirrors
+      ``/healthz`` (a process with no warm-up phase is ready when alive);
     - ``/metrics`` — Prometheus text exposition of the registry
       (:func:`render_prometheus`);
     - ``/status`` — JSON from ``status_fn`` (the federation server's live
@@ -1615,14 +1650,23 @@ class OpsServer:
       summary); a ``status_fn`` that takes no ``full`` kwarg is called
       plain — older callers keep working.
 
+    ``routes`` mounts additional POST handlers (the serving plane's JSON
+    ``/infer``): a dict of path -> ``fn(body_bytes, query_string)``
+    returning ``(http_code, content_type, body_bytes)``. Handler
+    exceptions surface as 500s, never kill the serving thread.
+
     Entirely out of the training hot path: no thread is started unless
-    :meth:`start` is called, and handlers only *read* registry snapshots.
+    :meth:`start` is called, and GET handlers only *read* registry
+    snapshots.
     """
 
     def __init__(self, registry: MetricRegistry | None = None,
-                 status_fn=None, host: str = "127.0.0.1", port: int = 0):
+                 status_fn=None, host: str = "127.0.0.1", port: int = 0,
+                 ready_fn=None, routes: dict | None = None):
         self.registry = registry or MetricRegistry()
         self.status_fn = status_fn
+        self.ready_fn = ready_fn
+        self.routes = dict(routes or {})
         self.host = host
         self.port = port
         self._httpd = None
@@ -1641,6 +1685,18 @@ class OpsServer:
                 try:
                     if path == "/healthz":
                         code, ctype, body = 200, "text/plain", b"ok\n"
+                    elif path == "/ready":
+                        # Readiness is not liveness: a serving process is
+                        # alive the moment its ops thread binds, but must
+                        # not receive traffic until a model is loaded and
+                        # warm (README "Serving").
+                        ready = (
+                            bool(ops.ready_fn()) if ops.ready_fn is not None
+                            else True
+                        )
+                        code = 200 if ready else 503
+                        ctype = "text/plain"
+                        body = b"ready\n" if ready else b"not ready\n"
                     elif path == "/metrics":
                         text = render_prometheus(ops.registry.snapshot())
                         code = 200
@@ -1666,6 +1722,25 @@ class OpsServer:
                         ).encode()
                     else:
                         code, ctype, body = 404, "text/plain", b"not found\n"
+                except Exception as err:  # never kill the serving thread
+                    code, ctype = 500, "text/plain"
+                    body = f"error: {err}\n".encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                path, _, query = self.path.partition("?")
+                handler = ops.routes.get(path)
+                try:
+                    if handler is None:
+                        code, ctype, body = 404, "text/plain", b"not found\n"
+                    else:
+                        length = int(self.headers.get("Content-Length", 0))
+                        payload = self.rfile.read(length) if length else b""
+                        code, ctype, body = handler(payload, query)
                 except Exception as err:  # never kill the serving thread
                     code, ctype = 500, "text/plain"
                     body = f"error: {err}\n".encode()

@@ -40,6 +40,15 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
     "scale_bench": (
         "bench", "rev", "configs", "ratios_10k_over_1k", "acceptance",
     ),
+    # scripts/serve_bench.py's BENCH_SERVE artifact object (README
+    # "Serving"): sustained docs/s under closed-loop load at a fixed p99
+    # target, the hot-swap audit (swaps + zero failed in-flight
+    # requests), and the per-second series reproduced from JSONL.
+    "serve_bench": (
+        "bench", "rev", "backend", "target_p99_ms", "sustained_docs_per_s",
+        "qps", "p50_ms", "p99_ms", "swaps", "failures", "series",
+        "acceptance",
+    ),
 }
 
 #: Fields a bench summary must ALSO carry when the named condition key is

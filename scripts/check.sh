@@ -53,7 +53,7 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_metrics.py tests/test_quality_plane.py \
     tests/test_analysis.py tests/test_pacing.py \
     tests/test_survival.py tests/test_scaleout.py \
-    tests/test_multichip.py \
+    tests/test_multichip.py tests/test_serving.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
 

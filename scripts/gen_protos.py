@@ -105,6 +105,29 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     ],
 }
 
+#: message -> [(field_name, tag, type, type_name)] for messages that must
+#: EXIST (added whole if missing — append-only schema evolution for brand
+#: new workloads). `type_name` is the fully-qualified message type for
+#: TYPE_MESSAGE fields ("" for scalars).
+WANTED_MESSAGES: dict[str, list[tuple[str, int, int, str]]] = {
+    # Serving plane (README "Serving"): one doc->topic inference batch.
+    # `bow` is a TensorBundle holding a single dense [B, V] float32 "bow"
+    # record (the same tensor transport training uses); `request_id` is a
+    # client-chosen correlation id echoed in the reply.
+    "InferRequest": [
+        ("bow", 1, F.TYPE_MESSAGE, ".gfedntm.TensorBundle"),
+        ("request_id", 2, F.TYPE_INT64, ""),
+    ],
+    # `theta` carries one dense [B, K] "theta" record; `model_round` names
+    # the federation round of the model that answered (observability for
+    # hot-swap: a client can see which published model served it).
+    "InferReply": [
+        ("theta", 1, F.TYPE_MESSAGE, ".gfedntm.TensorBundle"),
+        ("model_round", 2, F.TYPE_INT64, ""),
+        ("request_id", 3, F.TYPE_INT64, ""),
+    ],
+}
+
 TEMPLATE = '''# -*- coding: utf-8 -*-
 # Generated by scripts/gen_protos.py (descriptor-level evolution; the image
 # has no protoc).  DO NOT EDIT BY HAND — edit WANTED_FIELDS there and rerun.
@@ -137,6 +160,18 @@ def main() -> int:
 
     changed = False
     by_name = {msg.name: msg for msg in fdp.message_type}
+    for msg_name, fields in WANTED_MESSAGES.items():
+        if msg_name in by_name:
+            continue
+        msg = fdp.message_type.add(name=msg_name)
+        for name, tag, ftype, type_name in fields:
+            field = msg.field.add(
+                name=name, number=tag, type=ftype, label=F.LABEL_OPTIONAL,
+            )
+            if type_name:
+                field.type_name = type_name
+        by_name[msg_name] = msg
+        changed = True
     for msg_name, fields in WANTED_FIELDS.items():
         msg = by_name[msg_name]
         have = {f.name for f in msg.field}

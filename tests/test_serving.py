@@ -1,0 +1,739 @@
+"""Serving-plane suite (tier-1): the hot-swappable topic-inference
+service (README "Serving").
+
+Covers the ISSUE 13 satellites + acceptance flow: model-source
+prefer-newer loading, encoder-only inference parity (deterministic,
+batch-size invariant under bucketed padding, matches the training-path
+posterior mean for AVITM and CTM), the quality-gated swap, the
+coalescing batcher, the gRPC/HTTP front doors with ``/ready``
+readiness, the BENCH_SERVE schema, and one end-to-end federation that
+journals rounds while a serving plane hot-swaps through published
+models under live closed-loop load with zero failed requests.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from flax.traverse_util import flatten_dict
+
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation.server import (
+    FederatedServer,
+    build_template_model,
+)
+from gfedntm_tpu.models.networks import DecoderNetwork
+from gfedntm_tpu.serving import (
+    Batcher,
+    ClosedLoopLoadGen,
+    ModelSource,
+    ServingEngine,
+    ServingPlane,
+    default_buckets,
+    make_infer_stub,
+)
+from gfedntm_tpu.train.checkpoint import (
+    FederationCheckpointer,
+    RoundJournal,
+)
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                 "scripts"),
+)
+import bench_schema  # noqa: E402
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+VOCAB = tuple(f"tok{i:02d}" for i in range(30))
+
+
+def _flat_average(family="avitm", vocab=VOCAB, kwargs=MODEL_KWARGS,
+                  scale=1.0):
+    model = build_template_model(family, len(vocab), dict(kwargs))
+    flat = flatten_dict(
+        {"params": model.params, "batch_stats": model.batch_stats}, sep="/"
+    )
+    return {k: np.asarray(v) * scale for k, v in flat.items()}
+
+
+def _extra(family="avitm", kwargs=MODEL_KWARGS, quality=None):
+    extra = {"family": family, "model_kwargs": dict(kwargs)}
+    if quality is not None:
+        extra["quality"] = quality
+    return extra
+
+
+def _journal_round(tmp_path, round_idx, quality=None, scale=1.0):
+    j = RoundJournal(os.path.join(str(tmp_path), "checkpoints"))
+    j.record(
+        round_idx, _flat_average(scale=scale), [], vocab=list(VOCAB),
+        extra=_extra(quality=quality),
+    )
+    return j
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---- model source (journal/checkpoint prefer-newer) -------------------------
+
+class TestModelSource:
+    def test_empty_dir_has_nothing_and_reader_creates_nothing(self, tmp_path):
+        src = ModelSource(str(tmp_path))
+        assert src.peek() is None
+        assert src.load() is None
+        # a pure READER: a typo'd --save_dir must not get a store
+        # planted into it by the watcher
+        assert not os.path.exists(os.path.join(str(tmp_path), "checkpoints"))
+
+    def test_journal_round_loads(self, tmp_path):
+        _journal_round(tmp_path, 5)
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (5, "journal")
+        pub = src.load()
+        assert pub.round == 5 and pub.source == "journal"
+        assert pub.vocab == VOCAB and pub.family == "avitm"
+        assert pub.model_kwargs["n_components"] == 3
+        assert "params/beta" in pub.average
+
+    def test_checkpoint_round_loads_on_model_round_scale(self, tmp_path):
+        """The checkpoint sidecar's `round` is the RESUME round (model
+        round + 1) — the source normalizes it onto the journal's model-
+        round scale so replies/gauges/publish ordering never mix the
+        two."""
+        ckpt = FederationCheckpointer(
+            os.path.join(str(tmp_path), "checkpoints")
+        )
+        ckpt.save_round(
+            7, _flat_average(), [], vocab=list(VOCAB), extra=_extra(),
+        )
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (6, "checkpoint")
+        pub = src.load()
+        assert pub.round == 6 and pub.source == "checkpoint"
+        assert set(pub.average) == set(_flat_average())
+
+    def test_prefer_newer_journal_over_stale_checkpoint(self, tmp_path):
+        ckpt = FederationCheckpointer(
+            os.path.join(str(tmp_path), "checkpoints")
+        )
+        ckpt.save_round(3, _flat_average(), [], vocab=list(VOCAB),
+                        extra=_extra())
+        _journal_round(tmp_path, 9)
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (9, "journal")
+
+    def test_prefer_newer_checkpoint_over_stale_journal(self, tmp_path):
+        _journal_round(tmp_path, 2)
+        ckpt = FederationCheckpointer(
+            os.path.join(str(tmp_path), "checkpoints")
+        )
+        ckpt.save_round(8, _flat_average(), [], vocab=list(VOCAB),
+                        extra=_extra())
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (7, "checkpoint")
+        assert src.load().round == 7
+
+    def test_journal_equal_to_checkpoint_model_round_wins(self, tmp_path):
+        """Checkpoint resume-round C and journal round C-1 label the SAME
+        state; the journal round C (one round newer) must win — before
+        the scale normalization a checkpoint-sourced slot refused it."""
+        ckpt = FederationCheckpointer(
+            os.path.join(str(tmp_path), "checkpoints")
+        )
+        ckpt.save_round(8, _flat_average(), [], vocab=list(VOCAB),
+                        extra=_extra())
+        _journal_round(tmp_path, 8)
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (8, "journal")
+
+    def test_finished_journal_still_serves(self, tmp_path):
+        """A cleanly-finished federation's journal must not be served to
+        auto-RECOVERY, but it is exactly what serving wants — the final
+        model."""
+        j = _journal_round(tmp_path, 6)
+        j.mark_finished()
+        src = ModelSource(str(tmp_path))
+        assert src.peek() == (6, "journal")
+        assert src.load().round == 6
+
+    def test_corrupt_journal_degrades_quietly(self, tmp_path):
+        """Halves-disagreement (the live mid-write race) degrades to the
+        checkpoint with a retry counter, never an exception."""
+        _journal_round(tmp_path, 4)
+        meta_path = os.path.join(
+            str(tmp_path), "checkpoints", RoundJournal.META_NAME
+        )
+        meta = json.load(open(meta_path))
+        meta["round"] = 3  # stale JSON half
+        with open(meta_path, "w") as fh:
+            json.dump(meta, fh)
+        m = MetricsLogger(validate=True)
+        src = ModelSource(str(tmp_path), metrics=m)
+        assert src.load() is None  # no checkpoint to degrade to
+        assert m.registry.counter("serving_source_retries").value == 1
+
+    def test_quality_record_rides_journal(self, tmp_path):
+        _journal_round(
+            tmp_path, 5,
+            quality={"flagged": True, "unhealthy_streak": 2},
+        )
+        pub = ModelSource(str(tmp_path)).load()
+        assert pub.flagged
+        assert pub.quality["unhealthy_streak"] == 2
+
+
+# ---- encoder-only inference parity (satellite) ------------------------------
+
+class TestInferenceParity:
+    def _engine_with(self, family="avitm", kwargs=MODEL_KWARGS):
+        from gfedntm_tpu.serving.engine import PublishedModel
+
+        pub = PublishedModel(
+            round=1, source="journal", vocab=VOCAB, family=family,
+            model_kwargs=dict(kwargs),
+            average=_flat_average(family=family, kwargs=kwargs),
+        )
+        eng = ServingEngine(max_batch=8)
+        assert eng.publish(pub)
+        return eng
+
+    def test_deterministic_no_sampling(self):
+        eng = self._engine_with()
+        x = np.random.default_rng(0).integers(
+            0, 4, size=(5, len(VOCAB))
+        ).astype(np.float32)
+        t1, _ = eng.infer(x)
+        t2, _ = eng.infer(x)
+        np.testing.assert_array_equal(t1, t2)
+
+    def test_batch_size_invariant_under_bucket_padding(self):
+        """The same document yields the same theta whether it travels in
+        a batch of 1 (bucket 1), 3 (bucket 4), or 8 (bucket 8) — padded
+        rows cannot perturb real rows (eval-mode BN uses running stats)."""
+        eng = self._engine_with()
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 4, size=(8, len(VOCAB))).astype(np.float32)
+        full, _ = eng.infer(x)
+        one, _ = eng.infer(x[:1])
+        three, _ = eng.infer(x[:3])
+        np.testing.assert_allclose(one, full[:1], atol=1e-6)
+        np.testing.assert_allclose(three, full[:3], atol=1e-6)
+
+    @pytest.mark.parametrize("family,kwargs", [
+        ("avitm", MODEL_KWARGS),
+        ("ctm", dict(MODEL_KWARGS, contextual_size=12,
+                     inference_type="zeroshot")),
+    ])
+    def test_matches_training_path_posterior_mean(self, family, kwargs):
+        """The serving theta IS softmax(posterior mean): compare against
+        the training-path encoder (`encode_theta`, eval mode, zero
+        noise) run through the module directly — for AVITM and CTM."""
+        import jax.numpy as jnp
+
+        eng = self._engine_with(family=family, kwargs=kwargs)
+        slot = eng._slot
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 4, size=(6, len(VOCAB))).astype(np.float32)
+        ctx = (
+            rng.normal(size=(6, 12)).astype(np.float32)
+            if family == "ctm" else None
+        )
+        theta, _ = eng.infer(x, ctx)
+        out = slot.module.apply(
+            {"params": slot.params, "batch_stats": slot.batch_stats},
+            jnp.asarray(x),
+            jnp.asarray(ctx) if ctx is not None else None,
+            method=DecoderNetwork.encode_theta,
+            train=False, noise=0.0,
+        )
+        np.testing.assert_allclose(
+            theta, np.asarray(out.theta), atol=1e-5
+        )
+        # and softmax(mu) explicitly — no sampling anywhere in the path
+        mu = np.asarray(out.posterior_mean, np.float64)
+        e = np.exp(mu - mu.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(
+            theta, e / e.sum(axis=1, keepdims=True), atol=1e-5
+        )
+
+    def test_get_theta_noise_zero_is_deterministic(self):
+        """models/networks.get_theta with noise=0 needs no rng and equals
+        the posterior-mean theta (the serving contract on the module
+        itself)."""
+        import jax.numpy as jnp
+
+        eng = self._engine_with()
+        slot = eng._slot
+        x = jnp.asarray(
+            np.random.default_rng(3).integers(
+                0, 4, size=(4, len(VOCAB))
+            ).astype(np.float32)
+        )
+        va = {"params": slot.params, "batch_stats": slot.batch_stats}
+        t1 = slot.module.apply(
+            va, x, method=DecoderNetwork.get_theta, noise=0.0
+        )
+        t2 = slot.module.apply(
+            va, x, method=DecoderNetwork.get_theta, noise=0.0
+        )
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+    def test_chunking_above_max_batch(self):
+        eng = self._engine_with()
+        x = np.random.default_rng(4).integers(
+            0, 4, size=(19, len(VOCAB))
+        ).astype(np.float32)
+        theta, _ = eng.infer(x)
+        assert theta.shape == (19, 3)
+        one, _ = eng.infer(x[17:18])
+        np.testing.assert_allclose(one[0], theta[17], atol=1e-6)
+
+    def test_vocab_width_mismatch_is_loud(self):
+        eng = self._engine_with()
+        with pytest.raises(ValueError, match="vocab width"):
+            eng.infer(np.zeros((2, 7), np.float32))
+
+    def test_default_buckets(self):
+        assert default_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+        assert default_buckets(6) == (1, 2, 4, 6)
+        assert default_buckets(1) == (1,)
+
+
+# ---- quality-gated hot swap (satellite) -------------------------------------
+
+class TestQualityGatedSwap:
+    def test_flagged_round_never_swaps_in(self, tmp_path):
+        """A coherence-guard-flagged round is refused: the plane keeps
+        the last good model and emits the counter + event."""
+        m = MetricsLogger(validate=True)
+        _journal_round(tmp_path, 5)
+        src = ModelSource(str(tmp_path))
+        eng = ServingEngine(max_batch=4, metrics=m)
+        assert eng.publish(src.load())
+        assert eng.model_round == 5
+
+        _journal_round(
+            tmp_path, 6,
+            quality={"flagged": True, "unhealthy_streak": 2, "npmi": -0.4},
+        )
+        assert eng.publish(src.load()) is False
+        assert eng.model_round == 5  # last good model keeps serving
+        assert m.registry.counter("serving_swaps_refused").value == 1
+        (ev,) = m.events("serve_swap_refused")
+        assert ev["round"] == 6 and ev["reason"] == "coherence_flagged"
+        assert ev["kept_round"] == 5
+
+        # the NEXT healthy round swaps normally
+        _journal_round(
+            tmp_path, 7,
+            quality={"flagged": False, "unhealthy_streak": 0},
+        )
+        assert eng.publish(src.load())
+        assert eng.model_round == 7
+        (swap,) = m.events("serve_model_swapped")
+        assert swap["round"] == 7 and swap["prev_round"] == 5
+
+    def test_gate_off_swaps_flagged(self, tmp_path):
+        _journal_round(tmp_path, 5)
+        src = ModelSource(str(tmp_path))
+        eng = ServingEngine(max_batch=4, quality_gate=False)
+        assert eng.publish(src.load())
+        _journal_round(tmp_path, 6, quality={"flagged": True})
+        assert eng.publish(src.load())
+        assert eng.model_round == 6
+
+    def test_stale_round_is_not_a_swap(self, tmp_path):
+        _journal_round(tmp_path, 5)
+        pub = ModelSource(str(tmp_path)).load()
+        eng = ServingEngine(max_batch=4)
+        assert eng.publish(pub)
+        assert eng.publish(pub) is False  # same round again
+
+    def test_swap_invisible_to_inflight_requests(self, tmp_path):
+        """A slot reference taken before a swap keeps answering — the
+        atomicity contract at the engine level."""
+        _journal_round(tmp_path, 5)
+        src = ModelSource(str(tmp_path))
+        eng = ServingEngine(max_batch=4)
+        eng.publish(src.load())
+        slot_before = eng._slot
+        _journal_round(tmp_path, 6, scale=0.5)
+        eng.publish(src.load())
+        assert eng._slot is not slot_before  # swapped
+        # the old slot still computes (buffers never torn down under it)
+        x = np.ones((2, len(VOCAB)), np.float32)
+        theta = eng._infer_bucket(slot_before, x, None)
+        assert np.isfinite(theta).all()
+
+
+# ---- coalescing batcher -----------------------------------------------------
+
+class TestBatcher:
+    def test_concurrent_submits_coalesce_and_resolve(self, tmp_path):
+        m = MetricsLogger(validate=True)
+        _journal_round(tmp_path, 1)
+        eng = ServingEngine(max_batch=16, metrics=m)
+        eng.publish(ModelSource(str(tmp_path)).load())
+        b = Batcher(eng, linger_s=0.005, metrics=m)
+        b.start()
+        try:
+            rng = np.random.default_rng(0)
+            xs = [
+                rng.integers(0, 4, size=(2, len(VOCAB))).astype(np.float32)
+                for _ in range(12)
+            ]
+            futs = [b.submit(x) for x in xs]
+            for x, f in zip(xs, futs):
+                theta, round_idx = f.result(timeout=30)
+                assert theta.shape == (2, 3) and round_idx == 1
+                expect, _ = eng.infer(x)
+                np.testing.assert_allclose(theta, expect, atol=1e-6)
+        finally:
+            b.stop()
+        assert m.registry.counter("serving_requests").value == 12
+        assert m.registry.counter("serving_docs").value >= 24
+
+    def test_oversize_request_rejected(self, tmp_path):
+        _journal_round(tmp_path, 1)
+        eng = ServingEngine(max_batch=4)
+        eng.publish(ModelSource(str(tmp_path)).load())
+        b = Batcher(eng)
+        with pytest.raises(ValueError, match="max_batch"):
+            b.submit(np.zeros((5, len(VOCAB)), np.float32))
+
+    def test_wrong_width_request_rejected_alone(self, tmp_path):
+        """A wrong-vocab-width request fails at submit — coalesced into a
+        micro-batch it would poison every co-batched request's future."""
+        _journal_round(tmp_path, 1)
+        eng = ServingEngine(max_batch=8)
+        eng.publish(ModelSource(str(tmp_path)).load())
+        b = Batcher(eng, linger_s=0.01)
+        b.start()
+        try:
+            with pytest.raises(ValueError, match="vocab width"):
+                b.submit(np.zeros((2, 7), np.float32))
+            # a valid request right after still succeeds
+            theta, _ = b.submit(
+                np.ones((2, len(VOCAB)), np.float32)
+            ).result(timeout=30)
+            assert theta.shape == (2, 3)
+        finally:
+            b.stop()
+
+    def test_stop_fails_pending_loudly(self, tmp_path):
+        _journal_round(tmp_path, 1)
+        eng = ServingEngine(max_batch=4)
+        eng.publish(ModelSource(str(tmp_path)).load())
+        b = Batcher(eng)  # never started: submissions just queue
+        fut = b.submit(np.zeros((1, len(VOCAB)), np.float32))
+        b.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            fut.result(timeout=5)
+
+
+# ---- front doors: /ready, HTTP /infer, gRPC Infer ---------------------------
+
+def _http(url, data=None, expect_error=False):
+    try:
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        if not expect_error:
+            raise
+        return err.code, err.read()
+
+
+class TestFrontDoors:
+    def test_ready_distinct_from_healthz_and_http_infer(self, tmp_path):
+        m = MetricsLogger(validate=True)
+        plane = ServingPlane(
+            str(tmp_path), max_batch=8, poll_s=0.1, metrics=m, ops_port=0,
+        )
+        plane.start("[::]:0")
+        try:
+            base = f"http://127.0.0.1:{plane.ops_actual_port}"
+            # alive but NOT ready: nothing published yet
+            assert _http(f"{base}/healthz")[0] == 200
+            code, body = _http(f"{base}/ready", expect_error=True)
+            assert code == 503 and b"not ready" in body
+            # publish round 2 -> watcher picks it up -> ready flips
+            _journal_round(tmp_path, 2)
+            deadline = time.time() + 30
+            while not plane.engine.ready and time.time() < deadline:
+                time.sleep(0.05)
+            assert plane.engine.ready
+            assert _http(f"{base}/ready")[0] == 200
+
+            # HTTP /infer with raw text docs (tokenized against the
+            # serving model's vocabulary)
+            code, body = _http(
+                f"{base}/infer",
+                json.dumps({"docs": ["tok01 tok02 tok01", "tok05"]}).encode(),
+            )
+            assert code == 200
+            out = json.loads(body)
+            theta = np.asarray(out["theta"])
+            assert theta.shape == (2, 3) and out["model_round"] == 2
+            np.testing.assert_allclose(theta.sum(1), 1.0, atol=1e-3)
+
+            # dense bow rows work too
+            code, body = _http(
+                f"{base}/infer",
+                json.dumps(
+                    {"bow": np.ones((1, len(VOCAB))).tolist()}
+                ).encode(),
+            )
+            assert code == 200
+
+            # bad request -> 400 + serve_error event
+            code, body = _http(
+                f"{base}/infer", json.dumps({"nope": 1}).encode(),
+                expect_error=True,
+            )
+            assert code == 400
+            assert m.events("serve_error")
+
+            # /status carries the serving view
+            code, body = _http(f"{base}/status")
+            status = json.loads(body)
+            assert status["serving"]["ready"] is True
+            assert status["serving"]["model_round"] == 2
+            assert status["serving"]["requests"] >= 2
+        finally:
+            plane.stop()
+
+    def test_grpc_infer_roundtrip(self, tmp_path):
+        _journal_round(tmp_path, 3)
+        plane = ServingPlane(str(tmp_path), max_batch=8, poll_s=0.1)
+        plane.start("[::]:0")
+        try:
+            deadline = time.time() + 30
+            while not plane.engine.ready and time.time() < deadline:
+                time.sleep(0.05)
+            infer = make_infer_stub(f"localhost:{plane.bound_port}")
+            x = np.random.default_rng(0).integers(
+                0, 4, size=(4, len(VOCAB))
+            ).astype(np.float32)
+            theta, model_round = infer(x, request_id=11)
+            assert theta.shape == (4, 3) and model_round == 3
+            expect, _ = plane.engine.infer(x)
+            np.testing.assert_allclose(theta, expect, atol=1e-6)
+            infer.channel.close()
+        finally:
+            plane.stop()
+
+
+# ---- journal self-description (server side) ---------------------------------
+
+class TestJournalSelfDescription:
+    def test_state_extra_carries_model_kwargs_and_quality(self):
+        server = FederatedServer(
+            min_clients=1, family="avitm",
+            model_kwargs=dict(MODEL_KWARGS), quality_every=1,
+        )
+        extra = server._state_extra()
+        assert extra["family"] == "avitm"
+        assert extra["model_kwargs"]["n_components"] == 3
+        assert "quality" not in extra  # monitor not constructed yet
+
+        class FakeMonitor:
+            def status(self):
+                return {
+                    "unhealthy_streak": 2,
+                    "last": {"npmi": -0.3, "round": 12},
+                }
+
+        server._quality_mon = FakeMonitor()
+        extra = server._state_extra()
+        assert extra["quality"]["flagged"] is True
+        assert extra["quality"]["unhealthy_streak"] == 2
+        assert extra["quality"]["npmi"] == -0.3
+        server._quality_mon = None
+
+    def test_extra_is_json_able(self):
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=dict(MODEL_KWARGS),
+        )
+        json.dumps(server._state_extra())
+
+
+# ---- BENCH_SERVE schema -----------------------------------------------------
+
+class TestServeBenchSchema:
+    def _artifact(self):
+        return {
+            "bench": "serve", "rev": "r01", "backend": "cpu",
+            "target_p99_ms": 250.0, "sustained_docs_per_s": 100.0,
+            "qps": 10.0, "p50_ms": 5.0, "p99_ms": 50.0, "swaps": 3,
+            "failures": 0, "series": [], "acceptance": {},
+        }
+
+    def test_valid_artifact_passes(self):
+        assert bench_schema.validate(self._artifact(), "serve_bench") == []
+
+    def test_missing_field_fails(self):
+        bad = self._artifact()
+        del bad["swaps"]
+        problems = bench_schema.validate(bad, "serve_bench")
+        assert any("swaps" in p for p in problems)
+
+
+# ---- CLI surface ------------------------------------------------------------
+
+class TestServeCli:
+    def test_parser_accepts_serve_role(self):
+        from gfedntm_tpu.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["--role", "serve", "--save_dir", "out",
+             "--serve_max_batch", "32", "--serve_poll", "0.5",
+             "--serve_duration", "3", "--no_quality_gate"]
+        )
+        assert args.role == "serve"
+        assert args.serve_max_batch == 32
+        assert args.serve_poll == 0.5
+        assert args.serve_duration == 3.0
+        assert args.no_quality_gate is True
+
+    def test_serve_defaults(self):
+        from gfedntm_tpu.cli import build_parser
+
+        args = build_parser().parse_args(["--role", "serve"])
+        assert args.serve_max_batch == 64
+        assert args.serve_linger_ms == 2.0
+        assert args.serve_duration == 0.0
+        assert args.no_quality_gate is False
+
+
+# ---- end to end: live federation + hot-swapping serve + closed loop ---------
+
+def _run_clients(clients):
+    threads = [
+        threading.Thread(target=c.run, daemon=True,
+                         name=f"client{c.client_id}")
+        for c in clients
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+@pytest.mark.chaos
+def test_e2e_hot_swap_under_live_load(tmp_path):
+    """The ISSUE 13 acceptance flow, in-process: a 2-client federation
+    journals rounds while a serving plane polls the same save_dir and
+    hot-swaps through >= 2 published models UNDER a live closed-loop
+    load — zero failed in-flight requests, swap/latency/QPS telemetry in
+    the JSONL stream, and the load generator's summary carries the
+    BENCH_SERVE building blocks."""
+    from gfedntm_tpu.federation.client import Client
+
+    rng = np.random.default_rng(0)
+    words = [f"tok{i:02d}" for i in range(45)]
+    corpora = [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(40)
+        ])
+        for _ in range(2)
+    ]
+    port = _free_port()
+    srv_dir = str(tmp_path / "fed")
+    kwargs = dict(MODEL_KWARGS, num_epochs=20)
+    ms = MetricsLogger(str(tmp_path / "server.jsonl"), validate=True)
+    server = FederatedServer(
+        min_clients=2, family="avitm", model_kwargs=kwargs, max_iters=300,
+        save_dir=srv_dir, metrics=ms, checkpoint_every=0, journal_every=1,
+    )
+    server.start(f"[::]:{port}")
+    mc = MetricsLogger(validate=True)
+    clients = [
+        Client(client_id=c + 1, corpus=corpus,
+               server_address=f"localhost:{port}", max_features=45,
+               save_dir=str(tmp_path / f"c{c + 1}"), metrics=mc)
+        for c, corpus in enumerate(corpora)
+    ]
+    threads = _run_clients(clients)
+
+    mserve = MetricsLogger(
+        str(tmp_path / "serve" / "metrics.jsonl"), validate=True,
+        keep_records=True,
+    )
+    plane = ServingPlane(
+        srv_dir, max_batch=32, poll_s=0.1, metrics=mserve, ops_port=0,
+    )
+    plane.start("[::]:0")
+    try:
+        deadline = time.time() + 120
+        while not plane.engine.ready and time.time() < deadline:
+            time.sleep(0.1)
+        assert plane.engine.ready, "no model ever published"
+        vocab_size = len(plane.engine.vocab)
+
+        infer = make_infer_stub(f"localhost:{plane.bound_port}")
+        # per-worker generators: np.random.Generator is not thread-safe
+        batch_rngs = [np.random.default_rng(7 + i) for i in range(4)]
+
+        def make_batch(worker, seq):
+            return batch_rngs[worker].integers(
+                0, 3, size=(4, vocab_size)
+            ).astype(np.float32)
+
+        gen = ClosedLoopLoadGen(
+            infer, make_batch, concurrency=4, duration_s=6.0,
+            metrics=mserve,
+        )
+        summary = gen.run()
+        infer.channel.close()
+    finally:
+        plane.stop()
+        server.stop()
+        for c in clients:
+            c.shutdown()
+        for t in threads:
+            t.join(timeout=30)
+        ms.close()
+        mc.close()
+        mserve.close()
+
+    # zero failed in-flight requests across every live swap
+    assert summary["failures"] == 0, summary["failure_samples"]
+    assert summary["requests"] > 0
+    # the load itself rode through >= 2 model swaps (>= 3 distinct rounds)
+    assert summary["swaps_observed"] >= 2, summary["model_rounds_seen"]
+    assert summary["docs_per_s"] > 0 and summary["p99_ms"] is not None
+
+    # telemetry: swap audit + latency series reproducible from JSONL alone
+    reg = mserve.registry
+    assert reg.counter("serving_swaps").value >= 2
+    assert reg.get("serve_latency_s").count == summary["requests"]
+    swaps = mserve.events("serve_model_swapped")
+    assert len(swaps) >= 2
+    rounds = [ev["round"] for ev in swaps]
+    assert rounds == sorted(rounds)  # monotone swap trail
+    windows = mserve.events("serve_load_window")
+    assert windows and sum(w["docs"] for w in windows) == summary["docs"]
+    # /status-served serving view stayed coherent
+    status = plane._status()
+    assert status["serving"]["swaps"] >= 2
+    assert status["serving"]["errors"] == 0
