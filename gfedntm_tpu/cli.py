@@ -31,6 +31,7 @@ import argparse
 import json
 import logging
 import os
+import re
 import sys
 from typing import Any
 
@@ -54,7 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
             "Chrome trace-event file (README 'Distributed tracing & ops "
             "endpoint'); 'report <metrics.jsonl>' renders the model-"
             "quality report — coherence/drift trajectory, per-client "
-            "contributions (README 'Model-quality observability')."
+            "contributions (README 'Model-quality observability'); "
+            "'scenarios' runs the scenario matrix — real federations "
+            "under composed non-IID data + fault personas with per-cell "
+            "graceful-degradation contracts (README 'Scenario matrix')."
         ),
     )
     p.add_argument("--id", type=int, default=None,
@@ -295,6 +299,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve role: micro-batch doc cap — requests "
                         "coalesce up to this many docs per compiled "
                         "bucket program (default 64)")
+    p.add_argument("--serve_max_queue", type=int, default=0,
+                   help="serve role: bound on PENDING DOCS in the "
+                        "batcher queue (0 = unbounded). Under sustained "
+                        "overload a full queue sheds each ARRIVING "
+                        "request alone — gRPC RESOURCE_EXHAUSTED / HTTP "
+                        "429, counted as serving_requests_shed — so "
+                        "queue depth and p99 stay bounded while "
+                        "accepted requests never fail")
     p.add_argument("--serve_linger_ms", type=float, default=2.0,
                    help="serve role: how long an idle batcher waits for "
                         "company before dispatching a lone request "
@@ -447,18 +459,17 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     if getattr(args, "chaos", None):
         # Process-level chaos harness hook: scripted faults on the
         # server's client stubs (partition personas, drops, delays).
-        from gfedntm_tpu.federation.resilience import FaultInjector
+        # Validation is eager and shared with the scenario engine's
+        # persona loader — a typo'd spec (unknown method/kind/field,
+        # negative delay) is a startup usage error, never an inert
+        # injector that silently fires nothing.
+        from gfedntm_tpu.federation.resilience import build_fault_injector
 
-        fault_injector = FaultInjector(seed=0, metrics=metrics)
         try:
-            specs = json.loads(args.chaos)
-            for spec in specs:
-                if isinstance(spec.get("code"), str):
-                    import grpc
-
-                    spec["code"] = getattr(grpc.StatusCode, spec["code"])
-                fault_injector.script(spec.pop("method"), **spec)
-        except (ValueError, KeyError, TypeError, AttributeError) as err:
+            fault_injector = build_fault_injector(
+                args.chaos, seed=0, metrics=metrics
+            )
+        except ValueError as err:
             raise SystemExit(f"--chaos: bad fault spec ({err})")
     server = FederatedServer(
         min_clients=args.min_clients_federation,
@@ -648,6 +659,7 @@ def run_serve(args: argparse.Namespace, cfg: GfedConfig) -> int:
         model_kwargs=model_kwargs_from_config(cfg, args.model_type),
         max_batch=getattr(args, "serve_max_batch", 64),
         linger_s=getattr(args, "serve_linger_ms", 2.0) / 1e3,
+        max_queue=getattr(args, "serve_max_queue", 0),
         poll_s=getattr(args, "serve_poll", 1.0),
         quality_gate=not getattr(args, "no_quality_gate", False),
         metrics=metrics,
@@ -938,6 +950,114 @@ def run_report(argv: list[str]) -> int:
     return 0
 
 
+# ---- scenario matrix (`scenarios` subcommand) -------------------------------
+
+def run_scenarios(argv: list[str]) -> int:
+    """``scenarios``: run the scenario matrix — real in-process
+    federations under composed data personas (Dirichlet-α non-IID,
+    vocabulary skew, client-size imbalance), fault personas (slow
+    network, partition, flapping, server crash), policy axes (pacing ×
+    aggregator × robust estimator), and workloads (AVITM, CTM) — and
+    assert each cell's graceful-degradation contracts against its
+    no-fault baseline twin (README "Scenario matrix"). Exits non-zero
+    when any contract is red, so CI can gate on composition, not just
+    on each resilience plane in isolation."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu scenarios",
+        description="Run the scenario matrix and assert per-cell "
+                    "graceful-degradation contracts.",
+    )
+    p.add_argument("--cells", default=None,
+                   help="comma-separated cell names to run (default: the "
+                        "whole matrix); a faulted cell automatically "
+                        "pulls in its no-fault baseline twin")
+    p.add_argument("--list", action="store_true", dest="list_cells",
+                   help="list the matrix cells and exit")
+    p.add_argument("--workdir", default="output/scenarios",
+                   help="per-cell save dirs + the harness metrics.jsonl "
+                        "(default output/scenarios)")
+    p.add_argument("--out", default=None,
+                   help="write the BENCH_SCENARIO artifact JSON here "
+                        "(schema kind 'scenario_bench')")
+    p.add_argument("--fast", action="store_true",
+                   help="shrink every cell (fewer docs/epochs) — the "
+                        "check.sh SCENARIO=1 smoke regime")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.scenarios import (
+        cell_bench_row,
+        default_matrix,
+        emit_artifact,
+        run_matrix,
+    )
+
+    cells = default_matrix()
+    if args.list_cells:
+        for c in cells:
+            print(
+                f"{c.name:28s} workload={c.workload:5s} data={c.data:24s} "
+                f"fault={c.fault:12s} pacing={c.pacing:8s} "
+                f"agg={c.aggregator}"
+                + (f"+{c.robust}" if c.robust else "")
+                + (f" codec={c.wire_codec}" if c.wire_codec != "none"
+                   else "")
+            )
+        return 0
+    if args.cells:
+        wanted = [n.strip() for n in args.cells.split(",") if n.strip()]
+        known = {c.name for c in cells}
+        unknown = [n for n in wanted if n not in known]
+        if unknown:
+            raise SystemExit(
+                f"unknown cell name(s) {unknown}; run with --list to see "
+                "the matrix"
+            )
+        cells = [c for c in cells if c.name in wanted]
+
+    from gfedntm_tpu.utils.observability import MetricsLogger
+
+    os.makedirs(args.workdir, exist_ok=True)
+    metrics = MetricsLogger(
+        os.path.join(args.workdir, "metrics.jsonl"), node="scenarios",
+        validate=True,
+    )
+    try:
+        results = run_matrix(
+            cells, args.workdir, fast=args.fast, metrics=metrics,
+        )
+    finally:
+        metrics.snapshot_registry()
+        metrics.close()
+
+    for res in results:
+        print(json.dumps(cell_bench_row(res), default=float))
+    ok = all(r.ok for r in results)
+    if args.out:
+        # Artifact revision label, matching the BENCH_* convention
+        # ("r01"): taken from the output filename's rNN suffix.
+        m = re.search(r"_r(\d+)\.json$", os.path.basename(args.out))
+        rev = f"r{m.group(1)}" if m else "r00"
+        artifact = emit_artifact(results, rev=rev)
+        out_dir = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(artifact, fh, indent=1, default=float)
+            fh.write("\n")
+        print(f"wrote {args.out}: {len(results)} cells, "
+              f"all_contracts_green={artifact['acceptance']['all_contracts_green']}")
+    if not ok:
+        for res in results:
+            for name, verdict in res.contracts.items():
+                if not verdict["ok"]:
+                    print(
+                        f"contract FAILED: {res.cell.name}.{name}: "
+                        f"{verdict['detail']}",
+                        file=sys.stderr,
+                    )
+        return 1
+    return 0
+
+
 # ---- cross-node trace merge (`trace` subcommand) ----------------------------
 
 def _node_name_for(path: str, records: list[dict[str, Any]]) -> str:
@@ -1008,6 +1128,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_trace(argv[1:])
     if argv and argv[0] == "report":
         return run_report(argv[1:])
+    if argv and argv[0] == "scenarios":
+        return run_scenarios(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -116,28 +116,147 @@ def load_20newsgroups(
     )
 
 
+def _subset(corpus: RawCorpus, idx: np.ndarray) -> RawCorpus:
+    """One client shard of ``corpus`` at the given doc indices."""
+    return RawCorpus(
+        documents=[corpus.documents[i] for i in idx],
+        embeddings=None
+        if corpus.embeddings is None
+        else corpus.embeddings[idx],
+        labels=None
+        if corpus.labels is None
+        else np.asarray(corpus.labels)[idx],
+    )
+
+
+def imbalance_weights(n_clients: int, size_ratio: float) -> np.ndarray:
+    """Geometric client-size weights whose largest/smallest ratio is
+    ``size_ratio`` (1 = balanced) — the 10-100x client-size imbalance
+    persona that stresses Horvitz-Thompson reweighting and sample
+    weighting together (README "Scenario matrix")."""
+    if size_ratio < 1.0:
+        raise ValueError(f"size_ratio must be >= 1, got {size_ratio}")
+    if n_clients == 1 or size_ratio == 1.0:
+        return np.full(n_clients, 1.0 / n_clients)
+    w = size_ratio ** (np.arange(n_clients) / (n_clients - 1))
+    return w / w.sum()
+
+
+def heterogeneous_partition(
+    labels: "np.ndarray | None",
+    n_docs: int,
+    n_clients: int,
+    alpha: float | None = None,
+    size_ratio: float | None = None,
+    seed: int = 0,
+    min_docs: int = 1,
+) -> list[np.ndarray]:
+    """EXACT non-IID partition of ``n_docs`` docs into ``n_clients``
+    index shards: every doc lands on exactly one client and the shard
+    sizes sum to the corpus (multinomial splits, never rounding).
+
+    Two orthogonal, composable axes:
+
+    - ``alpha`` — Dirichlet-α label skew: per label class, client
+      proportions are drawn from Dirichlet(α·1) and the class's docs
+      split by an exact multinomial. α→∞ recovers ~IID mixtures; small α
+      concentrates each class on few clients (the FL heterogeneity
+      benchmark regime, arXiv:2309.13102). Requires ``labels``.
+    - ``size_ratio`` — geometric client-size imbalance with
+      largest/smallest = ratio (:func:`imbalance_weights`).
+
+    When both are set, each class's Dirichlet proportions are tilted by
+    the size weights (renormalized per class), so label skew and size
+    skew compose. ``min_docs`` rebalances deterministically afterwards:
+    starved shards take docs from the largest shard, preserving
+    exactness. Fully seeded — the same inputs give the same partition.
+    """
+    if n_clients < 1:
+        raise ValueError(f"n_clients must be >= 1, got {n_clients}")
+    if alpha is not None and alpha <= 0:
+        raise ValueError(f"alpha must be > 0, got {alpha}")
+    if alpha is not None and labels is None:
+        raise ValueError("Dirichlet-alpha partitioning needs labels")
+    if min_docs * n_clients > n_docs:
+        raise ValueError(
+            f"min_docs={min_docs} x {n_clients} clients exceeds "
+            f"{n_docs} docs"
+        )
+    rng = np.random.default_rng(seed)
+    size_w = (
+        imbalance_weights(n_clients, size_ratio)
+        if size_ratio is not None
+        else np.full(n_clients, 1.0 / n_clients)
+    )
+    if labels is None:
+        labels = np.zeros(n_docs, dtype=np.int64)
+    labels = np.asarray(labels)
+    if len(labels) != n_docs:
+        raise ValueError(
+            f"labels length {len(labels)} != n_docs {n_docs}"
+        )
+    assign = np.full(n_docs, -1, dtype=np.int64)
+    for cls in np.unique(labels):
+        idx = np.flatnonzero(labels == cls)
+        rng.shuffle(idx)
+        p = (
+            rng.dirichlet(np.full(n_clients, float(alpha)))
+            if alpha is not None
+            else np.ones(n_clients)
+        )
+        p = p * size_w
+        p = p / p.sum()
+        counts = rng.multinomial(len(idx), p)
+        for c, part in enumerate(np.split(idx, np.cumsum(counts)[:-1])):
+            assign[part] = c
+    shards = [list(np.flatnonzero(assign == c)) for c in range(n_clients)]
+    # Deterministic min_docs rebalance: starved shards draw from the
+    # current largest shard (its tail docs), so totals stay exact.
+    for c in range(n_clients):
+        while len(shards[c]) < min_docs:
+            donor = max(
+                (k for k in range(n_clients) if k != c),
+                key=lambda k: (len(shards[k]), -k),
+            )
+            if len(shards[donor]) <= min_docs:
+                break  # nothing left to give without starving the donor
+            shards[c].append(shards[donor].pop())
+    return [np.asarray(sorted(s), dtype=np.int64) for s in shards]
+
+
 def partition_corpus(
-    corpus: RawCorpus, n_clients: int, seed: int = 0, iid: bool = True
+    corpus: RawCorpus,
+    n_clients: int,
+    seed: int = 0,
+    iid: bool = True,
+    alpha: float | None = None,
+    size_ratio: float | None = None,
+    min_docs: int = 1,
 ) -> list[RawCorpus]:
-    """Split one corpus into per-client shards. ``iid=True`` shuffles then
-    chunks evenly; ``iid=False`` sorts by label first (label-skewed non-IID,
-    the collab_vs_non_collab regime of fos-partitioned corpora)."""
+    """Split one corpus into per-client shards.
+
+    Default modes (unchanged): ``iid=True`` shuffles then chunks evenly;
+    ``iid=False`` sorts by label first (label-skewed non-IID, the
+    collab_vs_non_collab regime of fos-partitioned corpora).
+
+    Heterogeneity personas (README "Scenario matrix"): ``alpha`` and/or
+    ``size_ratio`` route through :func:`heterogeneous_partition` —
+    exact Dirichlet-α label skew and geometric client-size imbalance,
+    composable and seeded.
+    """
     n = len(corpus)
+    if alpha is not None or size_ratio is not None:
+        shards = heterogeneous_partition(
+            None if corpus.labels is None else np.asarray(corpus.labels),
+            n, n_clients, alpha=alpha, size_ratio=size_ratio, seed=seed,
+            min_docs=min_docs,
+        )
+        return [_subset(corpus, shard) for shard in shards]
     rng = np.random.default_rng(seed)
     if iid or corpus.labels is None:
         order = rng.permutation(n)
     else:
         order = np.argsort(np.asarray(corpus.labels), kind="stable")
-    shards = np.array_split(order, n_clients)
-    out = []
-    for shard in shards:
-        out.append(
-            RawCorpus(
-                documents=[corpus.documents[i] for i in shard],
-                embeddings=None
-                if corpus.embeddings is None
-                else corpus.embeddings[shard],
-                labels=None if corpus.labels is None else np.asarray(corpus.labels)[shard],
-            )
-        )
-    return out
+    return [
+        _subset(corpus, shard) for shard in np.array_split(order, n_clients)
+    ]

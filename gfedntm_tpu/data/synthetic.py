@@ -117,6 +117,50 @@ def generate_synthetic_corpus(
     )
 
 
+def dominant_topics(node: SyntheticNode) -> np.ndarray:
+    """Per-doc dominant-topic labels from the ground-truth doc-topic
+    proportions — the label axis the Dirichlet-α partitioner
+    (:func:`gfedntm_tpu.data.loaders.heterogeneous_partition`) skews."""
+    return np.argmax(np.asarray(node.doc_topics), axis=1)
+
+
+def apply_vocabulary_skew(
+    documents: list[str],
+    client_id: int,
+    private_frac: float,
+    seed: int = 0,
+) -> list[str]:
+    """Pathological vocabulary skew persona: remap a seeded fraction of
+    this client's vocabulary TYPES into a client-private token namespace
+    (``c<id>x<token>``), so the federation's consensus vocabulary becomes
+    a mostly-disjoint union — the regime that stresses vocab consensus
+    and cross-client topic alignment (README "Scenario matrix").
+
+    The privatize decision is per token type (first occurrence order),
+    deterministic for a fixed ``(seed, client_id)`` and document order;
+    every occurrence of a privatized type is rewritten consistently.
+    """
+    if not 0.0 <= private_frac <= 1.0:
+        raise ValueError(
+            f"private_frac must be in [0, 1], got {private_frac}"
+        )
+    rng = np.random.default_rng([int(seed), int(client_id)])
+    mapping: dict[str, str] = {}
+    out = []
+    for doc in documents:
+        toks = []
+        for tok in doc.split():
+            if tok not in mapping:
+                mapping[tok] = (
+                    f"c{client_id}x{tok}"
+                    if rng.random() < private_frac
+                    else tok
+                )
+            toks.append(mapping[tok])
+        out.append(" ".join(toks))
+    return out
+
+
 def save_reference_npz(corpus: SyntheticCorpus, path: str, **meta) -> None:
     """Write the combined-archive format of ``synthetic_all_nodes.npz``
     (generate_synthetic.py:95-96) so reference tooling can read it."""

@@ -206,6 +206,19 @@ class FaultSpec:
         if self.kind not in ("error", "drop", "delay", "corrupt",
                              "partition"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.delay_s < 0:
+            raise ValueError(
+                f"delay_s must be >= 0, got {self.delay_s} (a negative "
+                "delay cannot fire and would make the spec silently inert)"
+            )
+        if self.times < 1:
+            raise ValueError(f"times must be >= 1, got {self.times}")
+        if self.skip < 0:
+            raise ValueError(f"skip must be >= 0, got {self.skip}")
+        if not 0.0 < self.probability <= 1.0:
+            raise ValueError(
+                f"probability must be in (0, 1], got {self.probability}"
+            )
         if self.kind == "drop":
             self.kind, self.code = "error", grpc.StatusCode.UNAVAILABLE
         if self.kind == "partition":
@@ -384,6 +397,115 @@ class FaultInjector:
                 seed=self._rng.randrange(2**32),
             )
         return response
+
+
+# ---- fail-fast fault-spec validation ----------------------------------------
+#
+# A typo'd --chaos spec (unknown method name, unknown field) used to parse
+# into an inert injector that silently never fired — the worst failure
+# mode for a chaos harness, because "the fault never happened" reads as
+# "the system survived it". The CLI --chaos flag and the scenario
+# engine's persona loader both parse through here, so malformed specs
+# fail loudly at startup instead.
+
+#: FaultSpec fields settable from a JSON spec (anything else is a typo).
+_SPEC_FIELDS = frozenset({
+    "method", "kind", "code", "delay_s", "times", "peer", "probability",
+    "payload", "skip",
+})
+
+
+def known_fault_methods() -> frozenset[str]:
+    """Every RPC method name a fault spec can legally target: the union
+    of all services in :data:`gfedntm_tpu.federation.rpc.SERVICES`, plus
+    the ``"*"`` wildcard."""
+    from gfedntm_tpu.federation import rpc
+
+    methods = {m for spec in rpc.SERVICES.values() for m in spec}
+    methods.add("*")
+    return frozenset(methods)
+
+
+def validate_fault_spec(spec: dict) -> dict:
+    """Validate one JSON fault spec eagerly; returns a normalized copy
+    (``code`` strings resolved to ``grpc.StatusCode``) or raises
+    ``ValueError`` naming the problem. Checks the spec SHAPE — unknown
+    keys, missing/unknown ``method``, unknown ``kind``, bad ``code``
+    names — before :class:`FaultSpec` validates the values (negative
+    delays, zero times, out-of-range probability)."""
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"fault spec must be a JSON object, got {type(spec).__name__}"
+        )
+    unknown = set(spec) - _SPEC_FIELDS
+    if unknown:
+        raise ValueError(
+            f"unknown fault-spec field(s) {sorted(unknown)} "
+            f"(known: {sorted(_SPEC_FIELDS)})"
+        )
+    out = dict(spec)
+    method = out.get("method")
+    if not isinstance(method, str) or not method:
+        raise ValueError("fault spec needs a 'method' name (or '*')")
+    known = known_fault_methods()
+    if method not in known:
+        raise ValueError(
+            f"unknown RPC method {method!r} — the spec would never fire "
+            f"(known: {sorted(known)})"
+        )
+    code = out.get("code")
+    if isinstance(code, str):
+        resolved = getattr(grpc.StatusCode, code, None)
+        if not isinstance(resolved, grpc.StatusCode):
+            raise ValueError(f"unknown grpc.StatusCode name {code!r}")
+        out["code"] = resolved
+    elif code is not None and not isinstance(code, grpc.StatusCode):
+        raise ValueError(
+            f"'code' must be a grpc.StatusCode name string, got {code!r}"
+        )
+    # Value-domain validation: construct a throwaway FaultSpec so kind/
+    # delay/times/probability/payload problems surface here, not at the
+    # first (never-arriving) matching call. TypeError covers wrong-TYPED
+    # values (e.g. "delay_s": "0.5" — a JSON string where a number is
+    # expected fails the >= comparison), which must surface as the same
+    # usage error, not a raw traceback.
+    try:
+        FaultSpec(**out)
+    except TypeError as err:
+        raise ValueError(f"bad fault-spec value: {err}")
+    return out
+
+
+def build_fault_injector(
+    specs: "str | list[dict]",
+    seed: int = 0,
+    metrics: Any = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> FaultInjector:
+    """Parse a ``--chaos``-style JSON list (or an already-decoded list)
+    into a scripted :class:`FaultInjector`, validating every spec
+    eagerly (:func:`validate_fault_spec`). Raises ``ValueError`` with a
+    usage-quality message on any malformed spec."""
+    import json
+
+    if isinstance(specs, str):
+        try:
+            specs = json.loads(specs)
+        except json.JSONDecodeError as err:
+            raise ValueError(f"fault specs are not valid JSON: {err}")
+    if not isinstance(specs, list):
+        raise ValueError(
+            f"fault specs must be a JSON list of objects, got "
+            f"{type(specs).__name__}"
+        )
+    injector = FaultInjector(seed=seed, metrics=metrics, sleep=sleep)
+    for i, raw in enumerate(specs):
+        try:
+            spec = validate_fault_spec(raw)
+        except ValueError as err:
+            raise ValueError(f"fault spec #{i}: {err}")
+        injector.script(spec.pop("method"), **spec)
+    return injector
 
 
 def corrupt_bundle(bundle: Any, payload: str, seed: int = 0) -> None:

@@ -1,0 +1,150 @@
+"""Graceful-degradation contracts: per-cell invariants, not just a sweep.
+
+A scenario cell is only green when the federation *degraded
+gracefully* under its personas — the run completed with a finite
+model, quorum semantics never degenerated into lone-straggler
+averaging, crash recovery actually completed, the wire-codec /
+idempotency counters stayed at their clean-run values, and the final
+topic coherence landed within the cell's declared tolerance of its
+no-fault baseline twin. Each contract evaluates from the cell's
+collected JSONL evidence (see :func:`runner.collect_cell_evidence`),
+so a failed contract names observable telemetry, not internal state.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from gfedntm_tpu.scenarios.personas import ScenarioCell
+
+__all__ = ["evaluate_contracts", "quorum_floor"]
+
+#: Counters that must sit at their clean-run (baseline) values in every
+#: cell: a fault persona may slow or skip rounds, but it must never
+#: corrupt the delta-reference discipline or double-count a reply.
+CLEAN_COUNTERS = ("codec_ref_miss", "rpcs_deduplicated")
+
+
+def quorum_floor(cell: ScenarioCell) -> int:
+    """The per-round contributor floor the quorum contract holds the
+    bulk of averaged rounds to: ``ceil(quorum_fraction x denominator)``
+    where the denominator is the cohort size under cohort pacing and
+    the full membership under sync. Async/push pacing aggregates
+    whenever its buffer fills, so the floor is 1 by construction."""
+    policy = cell.pacing.split(":", 1)[0]
+    if policy in ("async", "push"):
+        return 1
+    denom = cell.n_clients
+    if policy == "cohort" and ":" in cell.pacing:
+        denom = min(denom, int(cell.pacing.split(":", 1)[1]))
+    return max(1, math.ceil(cell.quorum_fraction * denom))
+
+
+def _contract(ok: bool, detail: str) -> dict[str, Any]:
+    return {"ok": bool(ok), "detail": detail}
+
+
+def evaluate_contracts(
+    cell: ScenarioCell,
+    evidence: dict[str, Any],
+    baseline: "dict[str, Any] | None" = None,
+) -> dict[str, dict[str, Any]]:
+    """Evaluate every degradation contract for one cell.
+
+    ``evidence`` is the cell's own collected telemetry; ``baseline`` is
+    the evidence of its no-fault twin (None for cells that ARE their
+    own baseline — their comparative contracts reduce to clean-run
+    checks). Returns ``{contract: {"ok": bool, "detail": str}}``.
+    """
+    out: dict[str, dict[str, Any]] = {}
+
+    # 1. The run completed with a finite global model.
+    out["completes"] = _contract(
+        evidence.get("finished", False) and evidence.get("betas_finite",
+                                                         False),
+        f"finished={evidence.get('finished')} "
+        f"betas_finite={evidence.get('betas_finite')} "
+        f"rounds={evidence.get('rounds')}",
+    )
+
+    # 2. Quorum never degenerates: rounds averaged at all, no averaged
+    # round had zero contributors, and the bulk (>= half) of averaged
+    # rounds met the configured quorum floor — late rounds legitimately
+    # shrink as clients finish their epochs, but a fault persona must
+    # not turn the run into lone-straggler averaging.
+    pushes = list(evidence.get("averaged_push_clients") or ())
+    floor = quorum_floor(cell)
+    if pushes:
+        met = sum(1 for n in pushes if n >= floor)
+        quorum_ok = min(pushes) >= 1 and met * 2 >= len(pushes)
+        detail = (
+            f"averaged_rounds={len(pushes)} min_contributors="
+            f"{min(pushes)} floor={floor} met_floor={met}/{len(pushes)} "
+            f"skipped={evidence.get('quorum_skips', 0)}"
+        )
+    else:
+        quorum_ok = False
+        detail = "no averaged rounds at all"
+    out["quorum"] = _contract(quorum_ok, detail)
+
+    # 3. Crash persona: zero-flag autorecovery completed — the
+    # replacement server resumed at (or one round behind, the in-flight
+    # round) the kill point and trained to completion.
+    if cell.fault_persona.kind == "crash":
+        rec = evidence.get("recovery") or {}
+        resumed = rec.get("resumed_round")
+        killed = rec.get("killed_round")
+        rec_ok = (
+            bool(rec.get("recovered"))
+            and resumed is not None
+            and killed is not None
+            and resumed >= killed - 1
+            and evidence.get("finished", False)
+        )
+        out["recovery"] = _contract(
+            rec_ok,
+            f"recovered={rec.get('recovered')} resumed_round={resumed} "
+            f"killed_round={killed}",
+        )
+
+    # 4. Wire-codec / idempotency counters at clean-run values: faults
+    # may cost time, never reference-chain integrity or double counting.
+    base_counters = (baseline or {}).get("counters") or {}
+    counters = evidence.get("counters") or {}
+    mismatches = []
+    for name in CLEAN_COUNTERS:
+        want = float(base_counters.get(name, 0.0))
+        got = float(counters.get(name, 0.0))
+        if got != want:
+            mismatches.append(f"{name}={got:g} (clean-run {want:g})")
+    out["counters_clean"] = _contract(
+        not mismatches,
+        "; ".join(mismatches) if mismatches else ", ".join(
+            f"{n}={float(counters.get(n, 0.0)):g}" for n in CLEAN_COUNTERS
+        ),
+    )
+
+    # 5. Final NPMI within the declared tolerance of the no-fault
+    # baseline: the fault persona may slow convergence, but the model
+    # the federation lands on must stay comparably coherent.
+    npmi = evidence.get("npmi_final")
+    base_npmi = (
+        (baseline or {}).get("npmi_final")
+        if baseline is not None
+        else npmi
+    )
+    if npmi is None or base_npmi is None:
+        out["npmi_tolerance"] = _contract(
+            False,
+            f"npmi={npmi} baseline={base_npmi} — coherence was never "
+            "measured (quality plane off?)",
+        )
+    else:
+        delta = abs(npmi - base_npmi)
+        out["npmi_tolerance"] = _contract(
+            delta <= cell.npmi_tol,
+            f"npmi={npmi:.4f} baseline={base_npmi:.4f} "
+            f"delta={delta:.4f} tol={cell.npmi_tol:g}",
+        )
+    return out

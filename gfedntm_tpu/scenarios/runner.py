@@ -1,0 +1,585 @@
+"""Scenario runner: drive one cell's real federation, collect evidence
+from its JSONL telemetry, assert contracts, and emit bench lines.
+
+Every cell runs the REAL in-process federation — a
+:class:`~gfedntm_tpu.federation.server.FederatedServer` plus N
+:class:`~gfedntm_tpu.federation.client.Client` threads over real gRPC
+sockets on localhost (the chaos-harness regime), with the quality
+plane on (``quality_every=1`` against the cell's reference corpus) so
+per-round NPMI/diversity/drift land in the stream. The crash persona
+is the PR 10 SIGKILL-equivalent: ``server.abort()`` mid-round, then a
+REPLACEMENT server constructed with the same knobs auto-recovers from
+the round journal with zero flags while the clients ride their durable
+session tokens through reconnect.
+
+Cell evidence is collected by reading the JSONL streams back
+(:func:`collect_cell_evidence`), not from live object state — the same
+records ``summarize``/``report`` consume, which is what makes the
+BENCH_SCENARIO artifact reproducible from JSONL alone.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Any
+
+import numpy as np
+
+from gfedntm_tpu.scenarios.contracts import CLEAN_COUNTERS, evaluate_contracts
+from gfedntm_tpu.scenarios.personas import (
+    ScenarioCell,
+    build_corpora,
+    fault_specs_for,
+)
+
+__all__ = [
+    "CellResult",
+    "baseline_of",
+    "collect_cell_evidence",
+    "default_matrix",
+    "emit_artifact",
+    "run_cell",
+    "run_matrix",
+]
+
+_LOG = logging.getLogger("scenarios")
+
+
+# ---- the default matrix -----------------------------------------------------
+
+def default_matrix() -> list[ScenarioCell]:
+    """The shipped scenario matrix (README "Scenario matrix"): every
+    fault persona composed with non-IID data and a spread of policy
+    axes, plus the no-fault twins the degradation contracts compare
+    against. The headline cell — ``dir01-crash-cohort`` — composes
+    Dirichlet-α non-IID data, a mid-run server kill, and cohort pacing
+    over the delta wire codec."""
+    D = "dirichlet:0.1"
+    return [
+        # -- no-fault cells (each is its own baseline) --------------------
+        ScenarioCell("iid-sync-fedavg"),
+        ScenarioCell("dir01-sync-fedavg", data=D),
+        ScenarioCell("dir01-sync-fedadam", data=D, aggregator="fedadam"),
+        ScenarioCell("dir01-cohort-fedyogi", data=D, pacing="cohort:2",
+                     aggregator="fedyogi"),
+        ScenarioCell("vocabskew-sync-median", data="vocabskew:0.5",
+                     robust="median"),
+        ScenarioCell("imbalance20-cohort-fedavg", data="imbalance:20",
+                     pacing="cohort:2", total_docs=160),
+        ScenarioCell("dir01-imbalance100-sync",
+                     data="dirichlet:0.1+imbalance:100", total_docs=200),
+        ScenarioCell("ctm-iid-sync", workload="ctm", num_epochs=2),
+        ScenarioCell("ctm-dir01-cohort", workload="ctm", data=D,
+                     pacing="cohort:2", num_epochs=2),
+        # baselines for the faulted cells below
+        ScenarioCell("iid-cohort-delta", pacing="cohort:2",
+                     wire_codec="delta"),
+        ScenarioCell("iid-sync-delta", wire_codec="delta"),
+        ScenarioCell("dir01-async-fedavg", data=D, pacing="async:2"),
+        ScenarioCell("dir01-cohort-delta", data=D, pacing="cohort:2",
+                     wire_codec="delta"),
+        # -- faulted cells ------------------------------------------------
+        ScenarioCell("dir01-slow-sync", data=D, fault="slow:0.5"),
+        ScenarioCell("iid-partition-cohort", pacing="cohort:2",
+                     wire_codec="delta", fault="partition:3"),
+        ScenarioCell("dir01-flap-async", data=D, pacing="async:2",
+                     fault="flap:4"),
+        ScenarioCell("iid-crash-sync", wire_codec="delta", fault="crash:3"),
+        # HEADLINE: Dirichlet-α non-IID x mid-run server kill x cohort
+        # pacing x delta codec — the composition ROADMAP item 4 names.
+        ScenarioCell("dir01-crash-cohort", data=D, pacing="cohort:2",
+                     wire_codec="delta", fault="crash:3"),
+    ]
+
+
+def baseline_of(cell: ScenarioCell) -> "ScenarioCell | None":
+    """The no-fault twin a faulted cell's comparative contracts need
+    (None when the cell is its own baseline)."""
+    if cell.fault_persona.kind == "none":
+        return None
+    return replace(cell, name=f"{cell.name}-baseline", fault="none")
+
+
+# ---- one cell ---------------------------------------------------------------
+
+@dataclass
+class CellResult:
+    cell: ScenarioCell
+    ok: bool
+    contracts: dict[str, dict[str, Any]]
+    evidence: dict[str, Any]
+    baseline_name: str | None
+    seconds: float
+    workdir: str
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _model_kwargs(cell: ScenarioCell) -> dict[str, Any]:
+    kwargs: dict[str, Any] = dict(
+        n_components=cell.n_components,
+        hidden_sizes=tuple(cell.hidden_sizes),
+        batch_size=cell.batch_size,
+        num_epochs=cell.num_epochs,
+        seed=cell.seed,
+    )
+    if cell.workload == "ctm":
+        kwargs.update(contextual_size=12, inference_type="zeroshot")
+    return kwargs
+
+
+def _server_kwargs(cell: ScenarioCell, save_dir: str,
+                   ref_path: str) -> dict[str, Any]:
+    kwargs = dict(
+        min_clients=cell.n_clients,
+        family=cell.workload,
+        model_kwargs=_model_kwargs(cell),
+        max_iters=cell.max_iters,
+        save_dir=save_dir,
+        local_steps=cell.local_steps,
+        quorum_fraction=cell.quorum_fraction,
+        aggregator=cell.aggregator,
+        robust_aggregator=cell.robust,
+        wire_codec=cell.wire_codec,
+        pacing_policy=cell.pacing,
+        pacing_seed=cell.seed,
+        # Quality plane ON for every cell: per-round NPMI vs the cell's
+        # reference corpus is what the npmi_tolerance contract reads.
+        quality_every=1,
+        quality_ref=ref_path,
+        quality_topn=6,
+        # The journal (not periodic checkpoints) carries crash recovery.
+        checkpoint_every=0,
+        journal_every=1,
+        round_backoff_s=0.2,
+    )
+    kwargs.update(cell.extra_server_kwargs)
+    return kwargs
+
+
+def _await_round(server, round_idx: int, timeout: float) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if server.global_iterations >= round_idx:
+            return
+        if server.training_done.is_set():
+            return  # finished before the target round: kill what's there
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"federation never reached round {round_idx} within {timeout:g}s"
+    )
+
+
+def run_cell(
+    cell: ScenarioCell,
+    workdir: str,
+    baseline_evidence: "dict[str, Any] | None" = None,
+    baseline_name: str | None = None,
+    metrics=None,
+) -> CellResult:
+    """Run one cell end to end and evaluate its contracts.
+
+    ``metrics`` is the harness-level logger the scenario lifecycle
+    events (``scenario_cell_started`` / ``scenario_contract`` /
+    ``scenario_cell_finished``) land on.
+    """
+    from gfedntm_tpu.federation.client import Client
+    from gfedntm_tpu.federation.resilience import build_fault_injector
+    from gfedntm_tpu.federation.server import FederatedServer
+    from gfedntm_tpu.utils.observability import MetricsLogger, read_metrics
+
+    # The per-cell dir is runner-owned output: start from a CLEAN slate.
+    # A rerun into the same --workdir would otherwise append to the
+    # previous run's metrics.jsonl streams (contaminating the evidence
+    # the contracts evaluate — stale healthy spans can outvote a fresh
+    # regression) and leave its round journal where a crash cell's
+    # replacement server would autorecover from the WRONG run.
+    if os.path.isdir(workdir):
+        import shutil
+
+        shutil.rmtree(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    if metrics is not None:
+        metrics.log(
+            "scenario_cell_started", cell=cell.name,
+            workload=cell.workload, pacing=cell.pacing,
+            data=cell.data, fault=cell.fault,
+        )
+    t0 = time.perf_counter()
+    persona = cell.fault_persona
+    corpora, ref_docs = build_corpora(cell)
+    ref_path = os.path.join(workdir, "quality_ref.txt")
+    with open(ref_path, "w") as fh:
+        fh.write("\n".join(ref_docs) + "\n")
+
+    port = _free_port()
+    server_dir = os.path.join(workdir, "server")
+    server_kwargs = _server_kwargs(cell, server_dir, ref_path)
+    stream_paths = [os.path.join(server_dir, "metrics.jsonl")]
+    m_server = MetricsLogger(stream_paths[0], node="server", validate=True)
+    injector_specs = fault_specs_for(persona, cell.n_clients)
+    injector = (
+        build_fault_injector(injector_specs, seed=cell.seed,
+                             metrics=m_server)
+        if injector_specs else None
+    )
+    server = FederatedServer(
+        metrics=m_server, fault_injector=injector, **server_kwargs
+    )
+    server.start(f"[::]:{port}")
+
+    client_metrics = []
+    clients = []
+    for c, corpus in enumerate(corpora):
+        cdir = os.path.join(workdir, f"client{c + 1}")
+        path = os.path.join(cdir, "metrics.jsonl")
+        stream_paths.append(path)
+        cm = MetricsLogger(path, node=f"client{c + 1}", validate=True)
+        client_metrics.append(cm)
+        clients.append(Client(
+            client_id=c + 1,
+            corpus=corpus,
+            server_address=f"localhost:{port}",
+            save_dir=cdir,
+            metrics=cm,
+            liveness_timeout=60.0,
+            watchdog_poll_s=0.2,
+            reconnect_window=180.0,
+            wire_codec="auto",
+        ))
+    threads = [
+        threading.Thread(target=c.run, daemon=True, name=f"cell-client{i}")
+        for i, c in enumerate(clients)
+    ]
+    for t in threads:
+        t.start()
+
+    recovery: dict[str, Any] | None = None
+    finished = False
+    error: str | None = None
+    final_server = server
+    try:
+        if persona.kind == "crash":
+            _await_round(server, persona.crash_round,
+                         timeout=cell.timeout_s / 2)
+            # SIGKILL-equivalent (the PR 10 recipe): abort without any
+            # stop broadcast / finalize, join the abandoned training
+            # thread so its last journal write can't race the
+            # replacement server's recovery reads.
+            server.abort()
+            t = server._train_thread
+            if t is not None:
+                t.join(timeout=120.0)
+            killed_at = server.global_iterations
+            m_server.snapshot_registry()
+            m_server.close()
+            # Replacement process: same construction, ZERO recovery
+            # flags — maybe_autorecover finds the journal on its own.
+            path2 = os.path.join(workdir, "server_recovered",
+                                 "metrics.jsonl")
+            stream_paths.append(path2)
+            m_server2 = MetricsLogger(path2, node="server", validate=True)
+            server2 = FederatedServer(metrics=m_server2, **server_kwargs)
+            resumed = server2.maybe_autorecover()
+            server2.start(f"[::]:{port}")
+            recovery = {
+                "recovered": resumed is not None,
+                "resumed_round": resumed,
+                "killed_round": killed_at,
+                "source": getattr(server2, "_recovered_source", None),
+            }
+            final_server, m_server = server2, m_server2
+        finished = final_server.wait_done(timeout=cell.timeout_s)
+        for t in threads:
+            t.join(timeout=60.0)
+    except Exception as err:  # noqa: BLE001 — a cell failure must not
+        # kill the matrix; it becomes a red "completes" contract with
+        # the error in the evidence.
+        error = f"{type(err).__name__}: {err}"
+        _LOG.exception("cell %s failed", cell.name)
+    finally:
+        try:
+            final_server.stop()
+        except Exception:
+            _LOG.exception("cell %s: server stop failed", cell.name)
+        for c in clients:
+            try:
+                c.shutdown()
+            except Exception:
+                _LOG.exception("cell %s: client shutdown failed", cell.name)
+        m_server.snapshot_registry()
+        m_server.close()
+        for cm in client_metrics:
+            cm.snapshot_registry()
+            cm.close()
+
+    betas = getattr(final_server, "global_betas", None)
+    betas_finite = bool(
+        betas is not None and np.isfinite(np.asarray(betas)).all()
+    )
+    records_by_stream = []
+    for path in stream_paths:
+        try:
+            records_by_stream.append(read_metrics(path))
+        except FileNotFoundError:
+            records_by_stream.append([])
+    evidence = collect_cell_evidence(
+        records_by_stream,
+        finished=finished,
+        betas_finite=betas_finite,
+        rounds=int(getattr(final_server, "global_iterations", 0)),
+        recovery=recovery,
+    )
+    if error is not None:
+        evidence["error"] = error
+    evidence["baseline_npmi"] = (
+        baseline_evidence.get("npmi_final")
+        if baseline_evidence is not None
+        else evidence.get("npmi_final")
+    )
+    contracts = evaluate_contracts(cell, evidence, baseline_evidence)
+    ok = all(c["ok"] for c in contracts.values())
+    seconds = time.perf_counter() - t0
+    if metrics is not None:
+        for name, verdict in contracts.items():
+            metrics.log(
+                "scenario_contract", cell=cell.name, contract=name,
+                ok=verdict["ok"], detail=verdict["detail"],
+            )
+        metrics.log(
+            "scenario_cell_finished", cell=cell.name, ok=ok,
+            seconds=seconds,
+        )
+    return CellResult(
+        cell=cell, ok=ok, contracts=contracts, evidence=evidence,
+        baseline_name=baseline_name, seconds=seconds, workdir=workdir,
+    )
+
+
+# ---- evidence collection (from JSONL alone) ---------------------------------
+
+def collect_cell_evidence(
+    records_by_stream: list[list[dict[str, Any]]],
+    finished: bool = False,
+    betas_finite: bool = False,
+    rounds: int = 0,
+    recovery: "dict[str, Any] | None" = None,
+) -> dict[str, Any]:
+    """Digest a cell's per-node JSONL streams into the evidence dict the
+    contracts evaluate — push-span contributor counts, quorum skips,
+    the clean-run counters, and the quality trajectory. Server streams
+    are recognized by their ``node`` stamp; everything is derived from
+    the records alone (the ``summarize``/``report`` reproducibility
+    contract)."""
+    from gfedntm_tpu.utils.observability import summarize_model_quality
+
+    server_records: list[dict[str, Any]] = []
+    all_records: list[dict[str, Any]] = []
+    for records in records_by_stream:
+        all_records.extend(records)
+        if any(r.get("node") == "server" for r in records[:50]):
+            server_records.extend(records)
+
+    push_clients = [
+        int(r["clients"])
+        for r in server_records
+        if r.get("event") == "span" and r.get("name") == "push"
+        and "clients" in r
+    ]
+    quorum_skips = sum(
+        1 for r in server_records if r.get("event") == "quorum_skip"
+    )
+    # Clean-run counters: the LAST metrics_snapshot of each stream is
+    # its cumulative state; sum across streams (both ends of the wire
+    # count their own misses/dedups).
+    counters = {name: 0.0 for name in CLEAN_COUNTERS}
+    for records in records_by_stream:
+        last = None
+        for r in records:
+            if r.get("event") == "metrics_snapshot":
+                last = r
+        if last is None:
+            continue
+        for name, snap in (last.get("metrics") or {}).items():
+            if name in counters and snap.get("type") == "counter":
+                counters[name] += float(snap.get("value") or 0.0)
+
+    quality = summarize_model_quality(server_records)
+    npmi_final = None
+    for row in quality.get("quality", ()):
+        if row.get("npmi") is not None:
+            npmi_final = float(row["npmi"])
+    return {
+        "finished": bool(finished),
+        "betas_finite": bool(betas_finite),
+        "rounds": int(rounds),
+        "averaged_push_clients": push_clients,
+        "quorum_skips": quorum_skips,
+        "counters": counters,
+        "npmi_final": npmi_final,
+        "quality_rounds": len(quality.get("quality", ())),
+        "recovery": recovery,
+        "server_recovered_events": sum(
+            1 for r in all_records if r.get("event") == "server_recovered"
+        ),
+    }
+
+
+# ---- the matrix -------------------------------------------------------------
+
+def run_matrix(
+    cells: list[ScenarioCell],
+    workdir: str,
+    fast: bool = False,
+    metrics=None,
+) -> list[CellResult]:
+    """Run a list of cells, no-fault baselines first, wiring each
+    faulted cell to its baseline twin's evidence. A faulted cell whose
+    baseline twin is not in the list gets one synthesized
+    (``<name>-baseline``) and run first — every comparison in the
+    artifact is against a cell that actually ran in the same batch."""
+    if fast:
+        cells = [c.shrink() for c in cells]
+    names = [c.name for c in cells]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate cell names in matrix: {names}")
+
+    baselines = [c for c in cells if c.fault_persona.kind == "none"]
+    faulted = [c for c in cells if c.fault_persona.kind != "none"]
+    by_key: dict[tuple, ScenarioCell] = {}
+    for c in baselines:
+        by_key.setdefault(c.policy_key(), c)
+    # Synthesize missing baseline twins (they become real cells).
+    for c in faulted:
+        if c.policy_key() not in by_key:
+            twin = baseline_of(c)
+            baselines.append(twin)
+            by_key[twin.policy_key()] = twin
+
+    results: list[CellResult] = []
+    evidence_by_key: dict[tuple, CellResult] = {}
+    for cell in baselines + faulted:
+        base_res = evidence_by_key.get(cell.policy_key())
+        is_baseline = cell.fault_persona.kind == "none"
+        res = run_cell(
+            cell,
+            os.path.join(workdir, cell.name),
+            baseline_evidence=None if is_baseline else (
+                base_res.evidence if base_res is not None else None
+            ),
+            baseline_name=None if is_baseline or base_res is None
+            else base_res.cell.name,
+            metrics=metrics,
+        )
+        if is_baseline:
+            evidence_by_key.setdefault(cell.policy_key(), res)
+        results.append(res)
+        _LOG.info(
+            "cell %s: %s (%.1fs)", cell.name,
+            "ok" if res.ok else "CONTRACT FAILURE", res.seconds,
+        )
+    return results
+
+
+# ---- bench artifact ---------------------------------------------------------
+
+def _bench_schema():
+    """The shared artifact-shape validator (``scripts/bench_schema.py``
+    — not a package; the scripts add their own dir to sys.path, the
+    library does it here)."""
+    try:
+        import bench_schema
+    except ImportError:
+        import sys
+
+        scripts = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "scripts",
+        )
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        import bench_schema
+    return bench_schema
+
+
+def cell_bench_row(result: CellResult) -> dict[str, Any]:
+    """One cell's standard bench JSON line (``bench_schema`` kind
+    ``"scenario"``), validated at the emission site."""
+    require = _bench_schema().require
+
+    cell = result.cell
+    row = {
+        "metric": "scenario",
+        "cell": cell.name,
+        "workload": cell.workload,
+        "data_persona": cell.data,
+        "fault_persona": cell.fault,
+        "pacing": cell.pacing,
+        "aggregator": cell.aggregator
+        + (f"+{cell.robust}" if cell.robust else ""),
+        "wire_codec": cell.wire_codec,
+        "n_clients": cell.n_clients,
+        "rounds": result.evidence.get("rounds"),
+        "npmi": result.evidence.get("npmi_final"),
+        "baseline_npmi": result.evidence.get("baseline_npmi"),
+        "npmi_tol": cell.npmi_tol,
+        "baseline": result.baseline_name,
+        "counters": result.evidence.get("counters"),
+        "quorum_skips": result.evidence.get("quorum_skips"),
+        "contracts": dict(result.contracts),
+        "ok": result.ok,
+        "seconds": round(result.seconds, 2),
+    }
+    return require(row, "scenario")
+
+
+def emit_artifact(
+    results: list[CellResult], rev: str = "unknown"
+) -> dict[str, Any]:
+    """The BENCH_SCENARIO artifact object (``bench_schema`` kind
+    ``"scenario_bench"``): every cell's bench line plus the acceptance
+    flags the trajectory reviewer keys on."""
+    require = _bench_schema().require
+
+    rows = [cell_bench_row(r) for r in results]
+    headline = None
+    for r in results:
+        cell = r.cell
+        if (
+            cell.fault_persona.kind == "crash"
+            and cell.data_persona.alpha is not None
+            and cell.pacing.startswith("cohort")
+            and r.ok
+        ):
+            headline = cell.name
+    artifact = {
+        "bench": "scenario_matrix",
+        "rev": rev,
+        "generated_by": (
+            "python -m gfedntm_tpu.cli scenarios --out "
+            "BENCH_SCENARIO_rNN.json"
+        ),
+        "cells": rows,
+        "acceptance": {
+            "n_cells": len(rows),
+            "min_cells": 12,
+            "enough_cells": len(rows) >= 12,
+            "all_contracts_green": all(r.ok for r in results),
+            "headline_cell": headline,
+            "headline_green": headline is not None,
+        },
+    }
+    return require(artifact, "scenario_bench")

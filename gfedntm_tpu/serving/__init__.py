@@ -22,6 +22,7 @@ from gfedntm_tpu.serving.loadgen import ClosedLoopLoadGen
 from gfedntm_tpu.serving.service import (
     Batcher,
     InferenceServicer,
+    QueueFullError,
     ServingPlane,
     make_infer_stub,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "InferenceServicer",
     "ModelSource",
     "PublishedModel",
+    "QueueFullError",
     "ServingEngine",
     "ServingPlane",
     "default_buckets",

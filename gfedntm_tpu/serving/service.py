@@ -38,7 +38,14 @@ import numpy as np
 
 from gfedntm_tpu.serving.engine import ModelSource, ServingEngine
 
-__all__ = ["Batcher", "InferenceServicer", "ServingPlane"]
+__all__ = ["Batcher", "InferenceServicer", "QueueFullError", "ServingPlane"]
+
+
+class QueueFullError(RuntimeError):
+    """The batcher's pending queue is at its ``max_queue`` doc bound:
+    the ARRIVING request is shed (gRPC ``RESOURCE_EXHAUSTED``, HTTP
+    429) so queue depth and tail latency stay bounded under sustained
+    overload — queued and in-flight requests are never dropped."""
 
 
 class _Pending:
@@ -68,11 +75,24 @@ class Batcher:
         linger_s: float = 0.002,
         metrics=None,
         logger: logging.Logger | None = None,
+        max_queue: int = 0,
     ):
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         self.engine = engine
         self.linger_s = float(linger_s)
         self.metrics = metrics
         self.logger = logger or logging.getLogger("Batcher")
+        # Load shedding (README "Serving"): bound on PENDING DOCS (not
+        # requests — requests vary in width). 0 = unbounded, the
+        # historical behavior. When an arrival would push the pending
+        # total past the bound it is shed alone via QueueFullError.
+        self.max_queue = int(max_queue)
+        # The bound applies against a NON-EMPTY backlog: a lone request
+        # on an idle queue is always admitted, so a request wider than
+        # max_queue (but within max_batch) is servable rather than shed
+        # with a "retry later" that could never succeed.
+        self._queued_docs = 0  # guarded-by: _cond
         self._queue: "collections.deque[_Pending]" = collections.deque()
         self._cond = threading.Condition()
         self._stopping = False
@@ -104,6 +124,7 @@ class Batcher:
         with self._cond:
             pending = list(self._queue)
             self._queue.clear()
+            self._queued_docs = 0
         for p in pending:
             p.future.set_exception(RuntimeError("serving plane stopped"))
 
@@ -131,10 +152,34 @@ class Batcher:
                 f"model expects {len(vocab)}"
             )
         p = _Pending(x_bow)
+        docs = int(x_bow.shape[0])
         with self._cond:
             if self._stopping:
                 raise RuntimeError("serving plane is stopping")
+            if (
+                self.max_queue
+                and self._queued_docs > 0
+                and self._queued_docs + docs > self.max_queue
+            ):
+                queued = self._queued_docs
+                if self.metrics is not None:
+                    self.metrics.registry.counter(
+                        "serving_requests_shed"
+                    ).inc()
+                    self.metrics.log(
+                        "serve_shed", docs=docs, queued=queued,
+                        max_queue=self.max_queue,
+                    )
+                raise QueueFullError(
+                    f"serving queue full ({queued}/{self.max_queue} "
+                    f"docs pending); retry later"
+                )
             self._queue.append(p)
+            self._queued_docs += docs
+            if self.metrics is not None:
+                self.metrics.registry.gauge("serving_queue_depth").set(
+                    self._queued_docs
+                )
             self._cond.notify()
         return p.future
 
@@ -165,6 +210,11 @@ class Batcher:
                     break
                 batch.append(self._queue.popleft())
                 docs += nxt.x_bow.shape[0]
+            self._queued_docs -= docs
+            if self.metrics is not None:
+                self.metrics.registry.gauge("serving_queue_depth").set(
+                    self._queued_docs
+                )
             return batch
 
     def _run(self) -> None:
@@ -255,6 +305,12 @@ class InferenceServicer:
             theta, model_round = self.batcher.submit(x).result(
                 timeout=self.timeout_s
             )
+        except QueueFullError as err:
+            # Load shed: the queue is at its --serve_max_queue bound.
+            # RESOURCE_EXHAUSTED is the standard gRPC pushback code —
+            # transient by the resilience classification, so polite
+            # clients retry with backoff.
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(err))
         except (ValueError, TypeError) as err:
             # TypeError covers codec.record_to_array's disallowed-dtype
             # rejection — a malformed request, not a retryable outage.
@@ -290,6 +346,7 @@ class ServingPlane:
         model_kwargs: dict[str, Any] | None = None,
         max_batch: int = 64,
         linger_s: float = 0.002,
+        max_queue: int = 0,
         poll_s: float = 1.0,
         quality_gate: bool = True,
         metrics=None,
@@ -311,7 +368,7 @@ class ServingPlane:
         )
         self.batcher = Batcher(
             self.engine, linger_s=linger_s, metrics=metrics,
-            logger=self.logger,
+            logger=self.logger, max_queue=max_queue,
         )
         self.ops_port = ops_port
         self.ops_host = ops_host
@@ -481,6 +538,12 @@ class ServingPlane:
                 raise ValueError("request body must be a JSON object")
             x = self._bow_from_json(payload)
             theta, model_round = self.batcher.submit(x).result(timeout=30.0)
+        except QueueFullError as err:
+            # Load shed (the serve_shed event + shed counter were
+            # already recorded at the rejection site): HTTP 429.
+            return 429, "application/json", json.dumps(
+                {"error": str(err)}
+            ).encode()
         except ValueError as err:
             if self.metrics is not None:
                 self.metrics.registry.counter("serving_errors").inc()
@@ -526,6 +589,11 @@ class ServingPlane:
             serving["batch_fill"] = _val("serving_batch_fill")
             serving["requests"] = int(_val("serving_requests") or 0)
             serving["errors"] = int(_val("serving_errors") or 0)
+            serving["requests_shed"] = int(
+                _val("serving_requests_shed") or 0
+            )
+            serving["queue_depth"] = _val("serving_queue_depth")
+        serving["max_queue"] = self.batcher.max_queue
         serving["watch"] = {
             "directory": self.source.directory,
             "poll_s": self.poll_s,

@@ -170,6 +170,16 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "serve_load_window": frozenset(
         {"seconds", "docs", "requests", "failures", "docs_per_s"}
     ),
+    # serving-plane load shedding (README "Serving"): a full pending
+    # queue sheds the ARRIVING request alone (RESOURCE_EXHAUSTED / 429);
+    # queued and accepted requests are never dropped.
+    "serve_shed": frozenset({"docs", "queued"}),
+    # scenario matrix engine (README "Scenario matrix"): cell lifecycle
+    # + per-cell degradation-contract verdicts — the ground truth the
+    # BENCH_SCENARIO artifact and the SCENARIO=1 smoke stage key on.
+    "scenario_cell_started": frozenset({"cell", "workload", "pacing"}),
+    "scenario_contract": frozenset({"cell", "contract", "ok"}),
+    "scenario_cell_finished": frozenset({"cell", "ok", "seconds"}),
 }
 
 
@@ -648,6 +658,18 @@ SERVING_EVENTS: tuple[str, ...] = (
     "serve_swap_refused",
     "serve_error",
     "serve_load_window",
+    "serve_shed",
+)
+
+#: Scenario-matrix events (cell lifecycle + per-cell degradation-
+#: contract verdicts — README "Scenario matrix"). Same reverse-lint
+#: contract: graftlint verifies each keeps an emission call site, so the
+#: scenario engine can never silently stop recording the contract
+#: verdicts BENCH_SCENARIO reproducibility depends on.
+SCENARIO_EVENTS: tuple[str, ...] = (
+    "scenario_cell_started",
+    "scenario_contract",
+    "scenario_cell_finished",
 )
 
 

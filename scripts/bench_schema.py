@@ -49,6 +49,18 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "qps", "p50_ms", "p99_ms", "swaps", "failures", "series",
         "acceptance",
     ),
+    # gfedntm_tpu/scenarios per-cell line (README "Scenario matrix"):
+    # one real federation run under composed data/fault/policy personas,
+    # with its degradation-contract verdicts.
+    "scenario": (
+        "metric", "cell", "workload", "data_persona", "fault_persona",
+        "pacing", "aggregator", "npmi", "baseline_npmi", "npmi_tol",
+        "contracts", "ok", "seconds",
+    ),
+    # The BENCH_SCENARIO artifact object: every cell's line plus the
+    # acceptance flags (>= 12 cells, all contracts green, the
+    # dirichlet x crash x cohort headline cell present and green).
+    "scenario_bench": ("bench", "rev", "cells", "acceptance"),
 }
 
 #: Fields a bench summary must ALSO carry when the named condition key is

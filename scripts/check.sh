@@ -54,8 +54,22 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_analysis.py tests/test_pacing.py \
     tests/test_survival.py tests/test_scaleout.py \
     tests/test_multichip.py tests/test_serving.py \
+    tests/test_scenarios.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
+
+if [ "${SCENARIO:-0}" = "1" ]; then
+    # Scenario-matrix smoke (README "Scenario matrix"): two fast cells
+    # end-to-end through the real in-process federation — one clean
+    # non-IID cell and one crash-persona cell exercising zero-flag
+    # autorecovery — with every degradation contract asserted. The full
+    # >= 12-cell matrix is the BENCH_SCENARIO artifact run:
+    #   python -m gfedntm_tpu.cli scenarios --out BENCH_SCENARIO_rNN.json
+    echo "== scenario-matrix smoke (SCENARIO=1) =="
+    env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli scenarios --fast \
+        --cells dir01-sync-fedavg,iid-crash-sync \
+        --workdir "$(mktemp -d)" || exit 1
+fi
 
 if [ "${MULTICHIP:-0}" = "1" ]; then
     # Fast multi-chip gate (README "Multi-chip training & bench

@@ -863,6 +863,46 @@ class TestSelfRun:
         )
         assert any(f.rule_name == "precision-pin" for f in found)
 
+    def test_seeded_scenario_emission_strip_fails(self, tmp_path):
+        # ISSUE 14 acceptance regression: stripping a SCENARIO_EVENTS
+        # emission site from the scenario runner must exit 1 — the
+        # contract-verdict telemetry BENCH_SCENARIO reproducibility
+        # depends on can never be silently disconnected.
+        import os
+
+        from gfedntm_tpu.analysis.runner import repo_root
+        from gfedntm_tpu.utils.observability import (
+            EVENT_SCHEMAS,
+            SCENARIO_EVENTS,
+        )
+
+        live = os.path.join(
+            repo_root(), "gfedntm_tpu/scenarios/runner.py"
+        )
+        src = open(live).read()
+        assert '"scenario_contract"' in src
+        seeded = src.replace('"scenario_contract"',
+                             '"scenario_cell_started"')
+        contract = telemetry_contract(
+            events=dict(EVENT_SCHEMAS),
+            required={"SCENARIO_EVENTS": tuple(SCENARIO_EVENTS)},
+        )
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE), seeded,
+            name="runner_seeded.py", options=contract,
+        )
+        assert any(
+            "scenario_contract" in f.message
+            and "no .log() emission site" in f.message
+            for f in found
+        ), [f.message for f in found]
+        # the live module is clean under the same contract
+        clean = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE), src,
+            name="runner_live.py", options=contract,
+        )
+        assert clean == [], [f.render() for f in clean]
+
     def test_seeded_lockfree_registry_mutation_fails(self, tmp_path):
         import os
 

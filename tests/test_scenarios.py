@@ -1,0 +1,695 @@
+"""Scenario-matrix engine suite (README "Scenario matrix").
+
+Covers the ISSUE 14 tentpole + satellites: the exact Dirichlet-α /
+size-imbalance partitioner (per-client counts sum to the corpus,
+α→∞ ~IID, small α concentrates, seeded determinism), the vocabulary-
+skew generator, persona spec parsing with fail-fast validation (shared
+with the ``--chaos`` CLI flag), the degradation contracts, the bench
+schema kinds, and end-to-end cells driving the real in-process
+federation — including a CTM cell under cohort pacing with the quality
+plane on, and a slow-marked crash-persona cell exercising zero-flag
+autorecovery inside the scenario engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.loaders import (
+    RawCorpus,
+    heterogeneous_partition,
+    imbalance_weights,
+    partition_corpus,
+)
+from gfedntm_tpu.data.synthetic import (
+    apply_vocabulary_skew,
+    dominant_topics,
+    generate_synthetic_corpus,
+)
+from gfedntm_tpu.federation.resilience import (
+    FaultSpec,
+    build_fault_injector,
+    known_fault_methods,
+    validate_fault_spec,
+)
+from gfedntm_tpu.scenarios import (
+    ScenarioCell,
+    baseline_of,
+    build_corpora,
+    cell_bench_row,
+    collect_cell_evidence,
+    default_matrix,
+    evaluate_contracts,
+    fault_specs_for,
+    parse_data_persona,
+    parse_fault_persona,
+    run_cell,
+)
+from gfedntm_tpu.scenarios.contracts import quorum_floor
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
+                 "scripts"),
+)
+import bench_schema  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# the Dirichlet-α / imbalance partitioner (acceptance: exact + tested)
+# ---------------------------------------------------------------------------
+
+class TestHeterogeneousPartition:
+    LABELS = np.random.default_rng(0).integers(0, 6, 300)
+
+    def _assert_exact(self, shards, n_docs):
+        allidx = np.concatenate(shards)
+        assert len(allidx) == n_docs
+        assert len(np.unique(allidx)) == n_docs  # every doc exactly once
+
+    def test_dirichlet_is_exact(self):
+        for alpha in (0.02, 0.5, 10.0, 1e6):
+            shards = heterogeneous_partition(
+                self.LABELS, 300, 4, alpha=alpha, seed=3
+            )
+            self._assert_exact(shards, 300)
+
+    def test_alpha_inf_recovers_iid(self):
+        """α→∞: near-uniform shard sizes AND near-global class mixture
+        per shard."""
+        shards = heterogeneous_partition(
+            self.LABELS, 300, 4, alpha=1e7, seed=1
+        )
+        sizes = [len(s) for s in shards]
+        assert max(sizes) - min(sizes) < 60  # ~75 each, multinomial noise
+        global_frac = np.bincount(self.LABELS, minlength=6) / 300
+        for shard in shards:
+            frac = np.bincount(self.LABELS[shard], minlength=6) / len(shard)
+            assert np.abs(frac - global_frac).max() < 0.2
+
+    def test_small_alpha_concentrates_classes(self):
+        shards = heterogeneous_partition(
+            self.LABELS, 300, 4, alpha=0.02, seed=1
+        )
+        self._assert_exact(shards, 300)
+        fracs = []
+        for cls in np.unique(self.LABELS):
+            cls_idx = np.flatnonzero(self.LABELS == cls)
+            counts = [np.isin(s, cls_idx).sum() for s in shards]
+            fracs.append(max(counts) / max(sum(counts), 1))
+        # most of each class lands on ONE client
+        assert np.mean(fracs) > 0.7
+
+    def test_seeded_determinism(self):
+        a = heterogeneous_partition(self.LABELS, 300, 4, alpha=0.1, seed=9)
+        b = heterogeneous_partition(self.LABELS, 300, 4, alpha=0.1, seed=9)
+        assert all((x == y).all() for x, y in zip(a, b))
+        c = heterogeneous_partition(self.LABELS, 300, 4, alpha=0.1, seed=10)
+        assert any((x.shape != y.shape) or (x != y).any()
+                   for x, y in zip(a, c))
+
+    def test_size_imbalance_exact_and_ratioed(self):
+        shards = heterogeneous_partition(
+            None, 4000, 4, size_ratio=20.0, seed=2
+        )
+        self._assert_exact(shards, 4000)
+        sizes = sorted(len(s) for s in shards)
+        # multinomial noise around the geometric targets: the realized
+        # spread must reflect the ratio's order of magnitude
+        assert sizes[-1] / max(sizes[0], 1) > 8.0
+
+    def test_dirichlet_composes_with_imbalance(self):
+        shards = heterogeneous_partition(
+            self.LABELS, 300, 4, alpha=0.1, size_ratio=50.0, seed=5
+        )
+        self._assert_exact(shards, 300)
+
+    def test_min_docs_rebalance(self):
+        shards = heterogeneous_partition(
+            self.LABELS, 300, 5, alpha=0.01, size_ratio=100.0, seed=0,
+            min_docs=6,
+        )
+        self._assert_exact(shards, 300)
+        assert all(len(s) >= 6 for s in shards)
+
+    def test_imbalance_weights_ratio(self):
+        w = imbalance_weights(4, 25.0)
+        assert abs(w.sum() - 1.0) < 1e-12
+        assert abs(w[-1] / w[0] - 25.0) < 1e-9
+        assert imbalance_weights(3, 1.0) == pytest.approx([1 / 3] * 3)
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            heterogeneous_partition(None, 10, 2, alpha=0.5)  # no labels
+        with pytest.raises(ValueError):
+            heterogeneous_partition(self.LABELS, 300, 2, alpha=0.0)
+        with pytest.raises(ValueError):
+            heterogeneous_partition(None, 10, 11, min_docs=1)
+        with pytest.raises(ValueError):
+            imbalance_weights(3, 0.5)
+        with pytest.raises(ValueError):
+            heterogeneous_partition(self.LABELS[:10], 300, 2, alpha=1.0)
+
+    def test_partition_corpus_routes_and_aligns(self):
+        """The RawCorpus wrapper keeps documents/embeddings/labels
+        row-aligned through a heterogeneous split."""
+        n = 60
+        labels = np.arange(n) % 3
+        corpus = RawCorpus(
+            documents=[f"doc {i}" for i in range(n)],
+            embeddings=np.arange(n, dtype=np.float32)[:, None],
+            labels=labels,
+        )
+        shards = partition_corpus(
+            corpus, 3, seed=4, alpha=0.2, size_ratio=5.0
+        )
+        assert sum(len(s) for s in shards) == n
+        for shard in shards:
+            for doc, emb, lab in zip(
+                shard.documents, shard.embeddings, shard.labels
+            ):
+                i = int(doc.split()[1])
+                assert emb[0] == i and lab == i % 3
+
+    def test_partition_corpus_default_unchanged(self):
+        corpus = RawCorpus(documents=[f"d{i}" for i in range(20)])
+        shards = partition_corpus(corpus, 4, seed=0)
+        assert [len(s) for s in shards] == [5, 5, 5, 5]
+
+
+class TestVocabularySkew:
+    DOCS = ["wd1 wd2 wd3 wd1", "wd2 wd4", "wd1 wd5 wd5"]
+
+    def test_zero_frac_is_identity(self):
+        assert apply_vocabulary_skew(self.DOCS, 1, 0.0) == self.DOCS
+
+    def test_full_frac_privatizes_every_type(self):
+        skewed = apply_vocabulary_skew(self.DOCS, 2, 1.0)
+        for doc in skewed:
+            assert all(t.startswith("c2x") for t in doc.split())
+
+    def test_consistent_per_type_and_deterministic(self):
+        a = apply_vocabulary_skew(self.DOCS, 1, 0.5, seed=3)
+        b = apply_vocabulary_skew(self.DOCS, 1, 0.5, seed=3)
+        assert a == b
+        # every occurrence of a type maps the same way
+        mapping = {}
+        for orig, new in zip(self.DOCS, a):
+            for o, n in zip(orig.split(), new.split()):
+                assert mapping.setdefault(o, n) == n
+        # different clients privatize different (seeded) subsets
+        c = apply_vocabulary_skew(self.DOCS, 9, 0.5, seed=3)
+        assert not any(t.startswith("c1x") for d in c for t in d.split())
+
+    def test_bad_frac_rejected(self):
+        with pytest.raises(ValueError):
+            apply_vocabulary_skew(self.DOCS, 1, 1.5)
+
+    def test_dominant_topics_labels(self):
+        corpus = generate_synthetic_corpus(
+            vocab_size=50, n_topics=4, n_docs=30, n_nodes=1,
+            frozen_topics=4, seed=0, materialize_docs=False,
+        )
+        labels = dominant_topics(corpus.nodes[0])
+        assert labels.shape == (30,)
+        assert labels.min() >= 0 and labels.max() < 4
+
+
+# ---------------------------------------------------------------------------
+# persona specs + fail-fast fault validation (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPersonaParsing:
+    def test_data_persona_composition(self):
+        p = parse_data_persona("dirichlet:0.1+imbalance:20+vocabskew:0.5")
+        assert p.alpha == 0.1 and p.size_ratio == 20.0
+        assert p.vocab_skew == 0.5
+        assert parse_data_persona("iid").alpha is None
+        assert parse_data_persona("").spec == "iid"
+
+    def test_data_persona_rejects_typos(self):
+        for bad in ("dirchlet:0.1", "dirichlet:0", "imbalance:0.5",
+                    "vocabskew:2", "dirichlet:x", "dirichlet"):
+            with pytest.raises(ValueError):
+                parse_data_persona(bad)
+
+    def test_fault_persona_parse(self):
+        assert parse_fault_persona("none").kind == "none"
+        assert parse_fault_persona("crash:3").crash_round == 3
+        assert parse_fault_persona("slow:0.5").value == 0.5
+        for bad in ("crashy:1", "crash", "crash:0", "crash:1.5",
+                    "slow:-1", "flap:2.5"):
+            with pytest.raises(ValueError):
+                parse_fault_persona(bad)
+
+    def test_fault_personas_lower_to_valid_specs(self):
+        for spec in ("slow:0.5", "partition:3", "flap:4"):
+            persona = parse_fault_persona(spec)
+            lowered = fault_specs_for(persona, 3)
+            assert lowered
+            injector = build_fault_injector(lowered)
+            assert injector.pending() > 0
+        assert fault_specs_for(parse_fault_persona("crash:2"), 3) == []
+        assert fault_specs_for(parse_fault_persona("none"), 3) == []
+
+
+class TestFaultSpecValidation:
+    def test_unknown_method_rejected(self):
+        with pytest.raises(ValueError, match="unknown RPC method"):
+            validate_fault_spec({"method": "TranStep", "kind": "error"})
+
+    def test_known_methods_cover_services(self):
+        known = known_fault_methods()
+        assert {"TrainStep", "ApplyAggregate", "PushUpdate", "Infer",
+                "*"} <= known
+
+    def test_unknown_kind_and_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            validate_fault_spec({"method": "TrainStep", "kind": "explode"})
+        with pytest.raises(ValueError, match="unknown fault-spec field"):
+            validate_fault_spec({"method": "TrainStep", "dely_s": 1.0})
+
+    def test_negative_delay_and_bad_counts_rejected(self):
+        with pytest.raises(ValueError, match="delay_s"):
+            FaultSpec(method="TrainStep", kind="delay", delay_s=-0.5)
+        with pytest.raises(ValueError, match="times"):
+            FaultSpec(method="TrainStep", times=0)
+        with pytest.raises(ValueError, match="probability"):
+            FaultSpec(method="TrainStep", probability=0.0)
+
+    def test_code_name_resolution(self):
+        out = validate_fault_spec({
+            "method": "TrainStep", "kind": "error", "code": "ABORTED",
+        })
+        import grpc
+
+        assert out["code"] is grpc.StatusCode.ABORTED
+        with pytest.raises(ValueError, match="StatusCode"):
+            validate_fault_spec({
+                "method": "TrainStep", "code": "NOT_A_CODE",
+            })
+
+    def test_wrong_typed_value_is_usage_error_not_traceback(self):
+        """A JSON string where a number is expected must surface as the
+        same ValueError usage error the CLI turns into SystemExit, not a
+        raw TypeError traceback."""
+        with pytest.raises(ValueError, match="bad fault-spec value"):
+            validate_fault_spec({
+                "method": "TrainStep", "kind": "delay", "delay_s": "0.5",
+            })
+        with pytest.raises(ValueError, match="fault spec #0"):
+            build_fault_injector(
+                '[{"method": "TrainStep", "kind": "delay", '
+                '"delay_s": "0.5"}]'
+            )
+
+    def test_builder_json_and_index_in_error(self):
+        with pytest.raises(ValueError, match="not valid JSON"):
+            build_fault_injector("[{")
+        with pytest.raises(ValueError, match="fault spec #1"):
+            build_fault_injector(json.dumps([
+                {"method": "TrainStep"},
+                {"method": "Nope"},
+            ]))
+        with pytest.raises(ValueError, match="JSON list"):
+            build_fault_injector('{"method": "TrainStep"}')
+
+    def test_cli_chaos_flag_fails_fast(self, tmp_path):
+        """A typo'd --chaos spec exits with a usage error at startup —
+        never an inert injector."""
+        from gfedntm_tpu.cli import build_parser, run_server
+
+        args = build_parser().parse_args([
+            "--id", "0", "--save_dir", str(tmp_path),
+            "--chaos", '[{"method": "TranStep", "kind": "drop"}]',
+        ])
+        from gfedntm_tpu.config import GfedConfig
+
+        with pytest.raises(SystemExit, match="--chaos"):
+            run_server(args, GfedConfig())
+
+    def test_cli_chaos_flag_accepts_valid_spec_shape(self):
+        """The documented partition example parses through the shared
+        validator."""
+        spec = [{"method": "*", "kind": "partition", "peer": "client2",
+                 "delay_s": 5}]
+        injector = build_fault_injector(json.dumps(spec))
+        assert injector.pending() == 1
+
+
+# ---------------------------------------------------------------------------
+# cells, contracts, evidence
+# ---------------------------------------------------------------------------
+
+def _evidence(**over):
+    base = dict(
+        finished=True,
+        betas_finite=True,
+        rounds=8,
+        averaged_push_clients=[3, 3, 2, 2, 1],
+        quorum_skips=1,
+        counters={"codec_ref_miss": 0.0, "rpcs_deduplicated": 0.0},
+        npmi_final=-0.30,
+        quality_rounds=8,
+        recovery=None,
+    )
+    base.update(over)
+    return base
+
+
+class TestContracts:
+    CELL = ScenarioCell("t", quorum_fraction=0.5, npmi_tol=0.1)
+
+    def test_all_green_without_baseline(self):
+        verdicts = evaluate_contracts(self.CELL, _evidence())
+        assert all(v["ok"] for v in verdicts.values())
+        assert "recovery" not in verdicts  # no crash persona
+
+    def test_unfinished_or_nonfinite_fails(self):
+        v = evaluate_contracts(self.CELL, _evidence(finished=False))
+        assert not v["completes"]["ok"]
+        v = evaluate_contracts(self.CELL, _evidence(betas_finite=False))
+        assert not v["completes"]["ok"]
+
+    def test_quorum_degeneration_fails(self):
+        # majority of averaged rounds below the floor = degenerate
+        v = evaluate_contracts(
+            self.CELL, _evidence(averaged_push_clients=[1, 1, 1, 3])
+        )
+        assert not v["quorum"]["ok"]
+        v = evaluate_contracts(
+            self.CELL, _evidence(averaged_push_clients=[])
+        )
+        assert not v["quorum"]["ok"]
+
+    def test_quorum_floor_per_pacing(self):
+        assert quorum_floor(ScenarioCell("a")) == 2  # ceil(.5 * 3)
+        assert quorum_floor(ScenarioCell("b", pacing="cohort:2")) == 1
+        assert quorum_floor(ScenarioCell("c", pacing="async:2")) == 1
+        assert quorum_floor(
+            ScenarioCell("d", n_clients=4, quorum_fraction=0.75)
+        ) == 3
+
+    def test_counter_drift_fails_against_baseline(self):
+        baseline = _evidence()
+        v = evaluate_contracts(
+            self.CELL,
+            _evidence(counters={"codec_ref_miss": 2.0,
+                                "rpcs_deduplicated": 0.0}),
+            baseline,
+        )
+        assert not v["counters_clean"]["ok"]
+        assert "codec_ref_miss" in v["counters_clean"]["detail"]
+
+    def test_npmi_tolerance_vs_baseline(self):
+        baseline = _evidence(npmi_final=-0.25)
+        # delta 0.15 > tol 0.1: violation
+        v = evaluate_contracts(
+            self.CELL, _evidence(npmi_final=-0.40), baseline
+        )
+        assert not v["npmi_tolerance"]["ok"]
+        # delta 0.05 <= tol 0.1: within the declared tolerance
+        v = evaluate_contracts(
+            self.CELL, _evidence(npmi_final=-0.30), baseline
+        )
+        assert v["npmi_tolerance"]["ok"]
+
+    def test_missing_npmi_fails(self):
+        v = evaluate_contracts(self.CELL, _evidence(npmi_final=None))
+        assert not v["npmi_tolerance"]["ok"]
+
+    def test_crash_recovery_contract(self):
+        cell = ScenarioCell("t", fault="crash:3")
+        good = _evidence(recovery={
+            "recovered": True, "resumed_round": 3, "killed_round": 3,
+        })
+        v = evaluate_contracts(cell, good)
+        assert v["recovery"]["ok"]
+        for bad in (
+            None,
+            {"recovered": False, "resumed_round": None, "killed_round": 3},
+            {"recovered": True, "resumed_round": 1, "killed_round": 4},
+        ):
+            v = evaluate_contracts(cell, _evidence(recovery=bad))
+            assert not v["recovery"]["ok"], bad
+
+
+class TestCollectEvidence:
+    def _records(self):
+        t = 1000.0
+        server = [
+            {"event": "span", "time": t, "node": "server", "name": "push",
+             "span_id": "a", "parent_id": None, "seconds": 0.1,
+             "clients": 3},
+            {"event": "span", "time": t, "node": "server", "name": "push",
+             "span_id": "b", "parent_id": None, "seconds": 0.1,
+             "clients": 2},
+            {"event": "span", "time": t, "node": "server", "name": "poll",
+             "span_id": "c", "parent_id": None, "seconds": 0.1,
+             "clients": 9},
+            {"event": "quorum_skip", "time": t, "node": "server",
+             "round": 2, "got": 1, "needed": 2},
+            {"event": "quality_computed", "time": t, "node": "server",
+             "round": 1, "npmi": -0.4, "diversity": 0.8},
+            {"event": "quality_computed", "time": t, "node": "server",
+             "round": 2, "npmi": -0.3, "diversity": 0.8},
+            {"event": "server_recovered", "time": t, "node": "server",
+             "round": 2, "source": "journal"},
+            {"event": "metrics_snapshot", "time": t, "node": "server",
+             "metrics": {
+                 "codec_ref_miss": {"type": "counter", "value": 1.0},
+                 "other": {"type": "counter", "value": 9.0},
+             }},
+        ]
+        client = [
+            {"event": "metrics_snapshot", "time": t, "node": "client1",
+             "metrics": {
+                 "codec_ref_miss": {"type": "counter", "value": 0.5},
+                 "rpcs_deduplicated": {"type": "counter", "value": 2.0},
+             }},
+        ]
+        return [server, client]
+
+    def test_collection(self):
+        ev = collect_cell_evidence(
+            self._records(), finished=True, betas_finite=True, rounds=4,
+        )
+        assert ev["averaged_push_clients"] == [3, 2]  # push spans only
+        assert ev["quorum_skips"] == 1
+        assert ev["counters"]["codec_ref_miss"] == 1.5  # summed streams
+        assert ev["counters"]["rpcs_deduplicated"] == 2.0
+        assert ev["npmi_final"] == -0.3  # last round's value
+        assert ev["quality_rounds"] == 2
+        assert ev["server_recovered_events"] == 1
+
+    def test_only_last_snapshot_counts(self):
+        records = self._records()
+        records[0].append({
+            "event": "metrics_snapshot", "time": 1001.0, "node": "server",
+            "metrics": {
+                "codec_ref_miss": {"type": "counter", "value": 4.0},
+            },
+        })
+        ev = collect_cell_evidence(records)
+        assert ev["counters"]["codec_ref_miss"] == 4.5
+
+
+class TestMatrixAndSchema:
+    def test_default_matrix_shape(self):
+        cells = default_matrix()
+        names = [c.name for c in cells]
+        assert len(cells) >= 12
+        assert len(set(names)) == len(names)
+        # the acceptance headline: dirichlet data x crash fault x cohort
+        assert any(
+            c.data_persona.alpha is not None
+            and c.fault_persona.kind == "crash"
+            and c.pacing.startswith("cohort")
+            for c in cells
+        )
+        # every fault persona kind appears
+        kinds = {c.fault_persona.kind for c in cells}
+        assert {"none", "slow", "partition", "flap", "crash"} <= kinds
+        # both workloads appear
+        assert {c.workload for c in cells} == {"avitm", "ctm"}
+        # every faulted cell has its no-fault baseline twin in-matrix
+        keys = {c.policy_key() for c in cells
+                if c.fault_persona.kind == "none"}
+        for c in cells:
+            if c.fault_persona.kind != "none":
+                assert c.policy_key() in keys, c.name
+
+    def test_baseline_of(self):
+        cell = ScenarioCell("x", fault="crash:3")
+        twin = baseline_of(cell)
+        assert twin.fault == "none"
+        assert twin.policy_key() == cell.policy_key()
+        assert baseline_of(twin) is None
+
+    def test_shrink_keeps_crash_reachable(self):
+        cell = ScenarioCell("x", fault="crash:5").shrink()
+        assert cell.fault_persona.crash_round <= 2
+        assert cell.total_docs < ScenarioCell("x").total_docs
+
+    def test_cell_rejects_bad_axes(self):
+        with pytest.raises(ValueError):
+            ScenarioCell("x", workload="lda")
+        with pytest.raises(ValueError):
+            ScenarioCell("x", data="dirchlet:1")
+        with pytest.raises(ValueError):
+            ScenarioCell("x", fault="crashy:1")
+
+    def test_bench_schema_kinds(self):
+        row = {
+            "metric": "scenario", "cell": "c", "workload": "avitm",
+            "data_persona": "iid", "fault_persona": "none",
+            "pacing": "sync", "aggregator": "fedavg", "npmi": -0.3,
+            "baseline_npmi": -0.3, "npmi_tol": 0.35, "contracts": {},
+            "ok": True, "seconds": 1.0,
+        }
+        assert bench_schema.validate(row, "scenario") == []
+        bad = dict(row)
+        del bad["contracts"]
+        assert bench_schema.validate(bad, "scenario")
+        artifact = {
+            "bench": "scenario_matrix", "rev": "abc", "cells": [row],
+            "acceptance": {},
+        }
+        assert bench_schema.validate(artifact, "scenario_bench") == []
+
+    def test_build_corpora_personas(self):
+        cell = ScenarioCell(
+            "x", data="dirichlet:0.1+imbalance:10+vocabskew:0.6",
+            total_docs=90,
+        )
+        corpora, ref_docs = build_corpora(cell)
+        assert len(corpora) == cell.n_clients
+        assert sum(len(c) for c in corpora) == 90
+        assert len(ref_docs) == 90
+        sizes = sorted(len(c) for c in corpora)
+        assert sizes[-1] > sizes[0]  # imbalance
+        # vocab skew: client-private namespaces present and disjoint
+        tok1 = {t for d in corpora[0].documents for t in d.split()}
+        assert any(t.startswith("c1x") for t in tok1)
+        assert not any(t.startswith("c2x") for t in tok1)
+        # reference corpus is the pre-skew pooled corpus
+        assert not any(
+            t.startswith("c") for d in ref_docs for t in d.split()
+        )
+
+    def test_build_corpora_ctm_embeddings(self):
+        corpora, _ = build_corpora(
+            ScenarioCell("x", workload="ctm", total_docs=60)
+        )
+        for c in corpora:
+            assert c.embeddings is not None
+            assert c.embeddings.shape == (len(c.documents), 12)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end cells (real in-process federation over gRPC)
+# ---------------------------------------------------------------------------
+
+def _run_named_cell(name, tmp_path, metrics=None):
+    cells = {c.name: c for c in default_matrix()}
+    return run_cell(
+        cells[name].shrink(), str(tmp_path / name), metrics=metrics,
+    )
+
+
+@pytest.mark.chaos
+def test_cell_e2e_fast_iid_sync(tmp_path):
+    """One fast cell end to end: the federation runs, every contract is
+    green, the scenario lifecycle events land on the harness stream,
+    the bench row validates against the schema — and a RERUN into the
+    same workdir starts from a clean slate (a reused dir must not
+    append to the prior run's streams: stale evidence could outvote a
+    fresh regression in the contract checks)."""
+    from dataclasses import replace
+
+    metrics = MetricsLogger(
+        str(tmp_path / "harness.jsonl"), node="scenarios", validate=True,
+        keep_records=True,
+    )
+    cells = {c.name: c for c in default_matrix()}
+    cell = replace(
+        cells["iid-sync-fedavg"].shrink(), num_epochs=1, total_docs=36,
+    )
+    res = run_cell(cell, str(tmp_path / cell.name), metrics=metrics)
+    metrics.close()
+    assert res.ok, res.contracts
+    assert res.evidence["npmi_final"] is not None
+    assert res.evidence["quality_rounds"] >= 1
+    row = cell_bench_row(res)
+    assert bench_schema.validate(row, "scenario") == []
+    started = metrics.events("scenario_cell_started")
+    finished = metrics.events("scenario_cell_finished")
+    contracts = metrics.events("scenario_contract")
+    assert len(started) == 1 and len(finished) == 1
+    assert finished[0]["ok"] is True
+    assert {c["contract"] for c in contracts} == set(res.contracts)
+
+    # Rerun into the SAME workdir: evidence must cover this run alone.
+    res2 = run_cell(cell, str(tmp_path / cell.name))
+    assert res2.ok, res2.contracts
+    assert len(res2.evidence["averaged_push_clients"]) == len(
+        res.evidence["averaged_push_clients"]
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cell_e2e_ctm_cohort_quality(tmp_path):
+    """Satellite: CTM as a federated scenario under cohort pacing with
+    the quality plane on — finite betas and a rendered quality report.
+    Slow-marked for the tier-1 budget; the net-path twin lives in
+    test_federation_net.py and the SCENARIO=1 stage drives cells
+    end-to-end."""
+    from gfedntm_tpu.utils.observability import (
+        format_quality_report,
+        read_metrics,
+        summarize_model_quality,
+    )
+
+    res = _run_named_cell("ctm-dir01-cohort", tmp_path)
+    assert res.ok, res.contracts
+    assert res.evidence["betas_finite"]
+    records = read_metrics(
+        os.path.join(res.workdir, "server", "metrics.jsonl")
+    )
+    summary = summarize_model_quality(records)
+    assert summary["quality"], "no quality rounds recorded"
+    report = format_quality_report(summary)
+    assert "npmi" in report.lower() or "coherence" in report.lower()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cell_e2e_crash_persona_autorecovers(tmp_path):
+    """The crash persona inside the scenario engine: mid-run server
+    kill, replacement autorecovers from the journal, clients ride
+    session tokens, contracts green including recovery."""
+    res = _run_named_cell("iid-crash-sync", tmp_path)
+    assert res.ok, res.contracts
+    rec = res.evidence["recovery"]
+    assert rec["recovered"] and rec["source"] == "journal"
+    assert res.evidence["server_recovered_events"] >= 1
+    assert res.evidence["counters"]["codec_ref_miss"] == 0.0
+
+
+def test_scenarios_cli_list_and_unknown_cell(capsys, tmp_path):
+    from gfedntm_tpu.cli import run_scenarios
+
+    assert run_scenarios(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "dir01-crash-cohort" in out
+    with pytest.raises(SystemExit, match="unknown cell"):
+        run_scenarios([
+            "--cells", "no-such-cell", "--workdir", str(tmp_path),
+        ])

@@ -448,6 +448,163 @@ class TestBatcher:
             fut.result(timeout=5)
 
 
+class _SlowEngine:
+    """Stub engine with a fixed per-batch service time: makes offered
+    load > capacity deterministic without tuning real JIT timings."""
+
+    max_batch = 16
+    vocab = None
+
+    def __init__(self, service_s=0.03, n_components=3):
+        self.service_s = service_s
+        self.n_components = n_components
+
+    def infer(self, x):
+        time.sleep(self.service_s)
+        return (
+            np.full((x.shape[0], self.n_components), 1.0 / 3, np.float32),
+            5,
+        )
+
+
+class TestLoadShedding:
+    """ISSUE 14 satellite: the pending queue is bounded by
+    --serve_max_queue (docs); overload sheds the ARRIVING request alone
+    with RESOURCE_EXHAUSTED/429 while accepted requests never fail."""
+
+    def test_overload_sheds_bounded_queue_zero_accepted_failures(self):
+        from gfedntm_tpu.serving import QueueFullError
+
+        m = MetricsLogger(validate=True)
+        b = Batcher(
+            _SlowEngine(service_s=0.02), linger_s=0.0, metrics=m,
+            max_queue=8,
+        )
+        b.start()
+        sheds = 0
+        latencies = []
+        failures = []
+        lock = threading.Lock()
+
+        def worker():
+            nonlocal sheds
+            # Closed loop: one request in flight per worker; a shed is
+            # counted and immediately retried with fresh pressure.
+            for _ in range(12):
+                t0 = time.perf_counter()
+                try:
+                    fut = b.submit(np.ones((2, 10), np.float32))
+                except QueueFullError:
+                    with lock:
+                        sheds += 1
+                    continue
+                try:
+                    theta, rnd = fut.result(timeout=30)
+                    assert theta.shape == (2, 3) and rnd == 5
+                    with lock:
+                        latencies.append(time.perf_counter() - t0)
+                except Exception as err:  # pragma: no cover - the bug
+                    with lock:
+                        failures.append(err)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        b.stop()
+
+        assert not failures, failures
+        assert latencies, "no requests were accepted at all"
+        assert sheds > 0, "overload never shed — the bound is inert"
+        # zero accepted-request failures + shed accounting line up
+        assert m.registry.counter("serving_requests_shed").value == sheds
+        shed_events = m.events("serve_shed")
+        assert len(shed_events) == sheds
+        # queue depth stayed bounded: every shed observed <= max_queue
+        # pending docs, and the live gauge never exceeded the bound
+        assert all(ev["queued"] <= 8 for ev in shed_events)
+        assert m.registry.get("serving_queue_depth").value <= 8
+        # p99 bounded: a bounded queue bounds the wait (8 queued docs +
+        # one in-flight batch at 30 ms service time is well under 2 s)
+        assert float(np.percentile(latencies, 99)) < 2.0
+
+    def test_grpc_infer_maps_shed_to_resource_exhausted(self):
+        import grpc
+
+        from gfedntm_tpu.federation import codec
+        from gfedntm_tpu.federation.protos import federated_pb2 as pb
+        from gfedntm_tpu.serving import InferenceServicer, QueueFullError
+
+        class _FullBatcher:
+            engine = _SlowEngine()
+
+            def submit(self, x):
+                raise QueueFullError("serving queue full")
+
+        class _Abort(Exception):
+            pass
+
+        class _Ctx:
+            code = None
+
+            def abort(self, code, details):
+                self.code = code
+                raise _Abort(details)
+
+        servicer = InferenceServicer(_FullBatcher())
+        req = pb.InferRequest(request_id=1)
+        req.bow.tensors.append(
+            codec.array_to_record("bow", np.ones((1, 4), np.float32))
+        )
+        ctx = _Ctx()
+        with pytest.raises(_Abort, match="queue full"):
+            servicer.Infer(req, ctx)
+        assert ctx.code is grpc.StatusCode.RESOURCE_EXHAUSTED
+
+    def test_http_infer_maps_shed_to_429(self, tmp_path):
+        from gfedntm_tpu.serving import QueueFullError
+
+        plane = ServingPlane(str(tmp_path), max_queue=4)
+
+        class _FullBatcher:
+            engine = plane.engine
+            max_queue = 4
+
+            def submit(self, x):
+                raise QueueFullError("serving queue full (4/4)")
+
+        plane.batcher = _FullBatcher()
+        status, ctype, body = plane._http_infer(
+            json.dumps({"bow": [[1, 0, 2]]}).encode(), ""
+        )
+        assert status == 429
+        assert "queue full" in json.loads(body)["error"]
+
+    def test_oversized_request_on_idle_queue_is_served_not_shed(self):
+        """A request wider than max_queue (but within max_batch) must be
+        admitted when the queue is idle — shedding it with 'retry later'
+        would be a permanently unservable retry loop."""
+        b = Batcher(_SlowEngine(service_s=0.0), linger_s=0.0, max_queue=4)
+        b.start()
+        try:
+            theta, rnd = b.submit(
+                np.ones((8, 10), np.float32)
+            ).result(timeout=30)
+            assert theta.shape == (8, 3) and rnd == 5
+        finally:
+            b.stop()
+
+    def test_max_queue_validation_and_cli_flag(self):
+        with pytest.raises(ValueError, match="max_queue"):
+            Batcher(_SlowEngine(), max_queue=-1)
+        from gfedntm_tpu.cli import build_parser
+
+        args = build_parser().parse_args(["--serve_max_queue", "256"])
+        assert args.serve_max_queue == 256
+        assert build_parser().parse_args([]).serve_max_queue == 0
+
+
 # ---- front doors: /ready, HTTP /infer, gRPC Infer ---------------------------
 
 def _http(url, data=None, expect_error=False):
