@@ -255,6 +255,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="server mode: serve /metrics (Prometheus), "
                         "/healthz, and /status on this HTTP port "
                         "(0 = ephemeral; default: disabled, no thread)")
+    p.add_argument("--slo", type=str, default=None,
+                   help="SLO spec JSON (a file path or inline): declarative "
+                        "objectives over any metric, evaluated live by the "
+                        "server/serve roles (alerts at /alerts + alert_* "
+                        "events; README 'Fleet telemetry & SLOs')")
+    p.add_argument("--fleet_max_nodes", type=int, default=512,
+                   help="server mode: FleetRegistry cardinality guard — "
+                        "max telemetry-reporting nodes tracked")
+    p.add_argument("--fleet_max_series", type=int, default=512,
+                   help="server mode: max telemetry series kept per node")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="capture a jax.profiler trace into this directory "
                         "(server/client: around the --profile_rounds "
@@ -435,6 +445,21 @@ def _load_corpora(args: argparse.Namespace):
 
 # ---- roles -----------------------------------------------------------------
 
+def _slo_specs_from_args(args: argparse.Namespace):
+    """Parse ``--slo`` (file path or inline JSON) into validated specs;
+    a malformed spec is a startup usage error, never a silently inert
+    alerting plane."""
+    spec = getattr(args, "slo", None)
+    if not spec:
+        return None
+    from gfedntm_tpu.utils.slo import load_slo_specs
+
+    try:
+        return load_slo_specs(spec)
+    except ValueError as err:
+        raise SystemExit(f"--slo: {err}")
+
+
 def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
     """``--id 0``: network federation server (``main.py:27-95``)."""
     from gfedntm_tpu.federation.server import FederatedServer
@@ -500,6 +525,9 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         journal_every=getattr(args, "journal_every", 1),
         fault_injector=fault_injector,
         ops_port=getattr(args, "ops_port", None),
+        slo_specs=_slo_specs_from_args(args),
+        fleet_max_nodes=getattr(args, "fleet_max_nodes", 512),
+        fleet_max_series=getattr(args, "fleet_max_series", 512),
         profiler=profiler,
         quality_every=getattr(args, "quality_every", 0),
         quality_ref=getattr(args, "quality_ref", None),
@@ -664,6 +692,7 @@ def run_serve(args: argparse.Namespace, cfg: GfedConfig) -> int:
         quality_gate=not getattr(args, "no_quality_gate", False),
         metrics=metrics,
         ops_port=getattr(args, "ops_port", None),
+        slo_specs=_slo_specs_from_args(args),
     )
     # Distinct default base from the client (50051+id) and relay
     # (51051+id) schemes so a co-hosted serving plane never collides.
@@ -1119,6 +1148,66 @@ def run_trace(argv: list[str]) -> int:
     return 0
 
 
+def run_slo(argv: list[str]) -> int:
+    """``slo --slo <spec> <metrics.jsonl>...``: evaluate SLO specs
+    offline against recorded telemetry — the per-node
+    ``metrics_snapshot`` streams replay in global time order through the
+    SAME FleetRegistry + SLOEngine the live planes run, so an objective
+    that holds live holds here and vice versa. Exits 1 when any spec
+    ever fired (the ``--assert-monotone-coherence`` CI-gate pattern,
+    generalized to arbitrary declarative objectives)."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu slo",
+        description="Evaluate SLO specs offline from recorded "
+                    "metrics.jsonl streams (exit 1 if any alert fired).",
+    )
+    p.add_argument("paths", nargs="+",
+                   help="per-node metrics.jsonl files (server + relays + "
+                        "clients; snapshots merge exactly like the live "
+                        "fleet view)")
+    p.add_argument("--slo", required=True,
+                   help="SLO spec JSON: a file path or inline JSON (list "
+                        "of specs, or {'slos': [...]})")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the final alert states as JSON")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.utils.slo import evaluate_stream, load_slo_specs
+
+    try:
+        specs = load_slo_specs(args.slo)
+    except ValueError as err:
+        raise SystemExit(f"--slo: {err}")
+    if not specs:
+        raise SystemExit("--slo: no specs to evaluate")
+    node_records, _first = _read_node_records(args.paths)
+    engine = evaluate_stream(node_records, specs)
+    status = engine.status()
+    if args.json_out:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
+        )
+        with open(args.json_out, "w") as fh:
+            json.dump(status, fh, indent=1, default=float)
+    fired = engine.ever_fired()
+    for alert in status["alerts"]:
+        verdict = "FIRED" if alert["alert"] in fired else "ok"
+        value = alert["value"]
+        shown = f"{value:.6g}" if isinstance(value, float) else value
+        print(
+            f"{verdict:>5}  {alert['alert']}: {alert['objective']} "
+            f"(last value {shown}, final state {alert['state']})"
+        )
+    if fired:
+        print(
+            f"SLO check FAILED: {len(fired)} alert(s) fired "
+            f"({', '.join(sorted(fired))})", file=sys.stderr,
+        )
+        return 1
+    print(f"SLO check passed ({len(specs)} objective(s) held)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1130,6 +1219,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_report(argv[1:])
     if argv and argv[0] == "scenarios":
         return run_scenarios(argv[1:])
+    if argv and argv[0] == "slo":
+        return run_slo(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -34,6 +34,7 @@ from gfedntm_tpu.federation.compression import (
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.federated.stepper import FederatedStepper
+from gfedntm_tpu.utils import observability
 from gfedntm_tpu.utils.observability import span
 
 #: Adaptive liveness-window constants (README "Crash recovery &
@@ -109,6 +110,18 @@ class FederatedClientServicer:
         # this client in the average.
         self._last_step_seq = 0  # guarded-by: _lock
         self._last_step_reply: pb.StepReply | None = None  # guarded-by: _lock
+        # Fleet telemetry shipping (README "Fleet telemetry & SLOs"): each
+        # StepReply piggybacks a delta-encoded registry report. Built and
+        # attached under _lock (one report per reply; the replay cache
+        # re-ships a replayed reply's original bytes verbatim, which the
+        # server's replace-semantics ingest absorbs idempotently).
+        self.shipper = (
+            observability.TelemetryShipper(
+                registry=metrics.registry,
+                node=metrics.node or f"client{client_id}",
+            )
+            if metrics is not None else None
+        )
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
         """The round's local step(s); reply with the post-step shared
@@ -188,6 +201,8 @@ class FederatedClientServicer:
                 base_round=self._applied_round + 1,
                 seq=seq,
             )
+            if self.shipper is not None:
+                reply.telemetry = self.shipper.build()
             if seq:
                 self._last_step_seq = seq
                 self._last_step_reply = reply
@@ -711,6 +726,10 @@ class Client:
                             else "none"
                         ),
                         session_token=self.session_token,
+                        # A FULL report: every delta shipped into the dead
+                        # connection may be lost, so the rejoin
+                        # resynchronizes the fleet view in one RPC.
+                        telemetry=self._full_telemetry(),
                     ),
                     timeout=10.0,
                 )
@@ -948,6 +967,16 @@ class Client:
                 codec=self._codec.codec_id,
             )
 
+    def _full_telemetry(self) -> bytes:
+        """A full (non-delta) telemetry report for join/rejoin RPCs —
+        empty bytes when this client runs un-instrumented."""
+        if self.metrics is None:
+            return b""
+        node = self.metrics.node or f"client{self.client_id}"
+        return observability.encode_telemetry_report(
+            {node: self.metrics.registry.snapshot()}, full=True,
+        )
+
     def serve_training(self) -> None:
         """Start the in-client servicer and signal readiness
         (``__start_client_server`` + ``__send_ready_for_training``,
@@ -981,6 +1010,7 @@ class Client:
                     else "none"
                 ),
                 session_token=self.session_token,
+                telemetry=self._full_telemetry(),
             )
         )
         if ack.code == 2:

@@ -705,6 +705,7 @@ class SyncEngine(RoundEngine):
                         bytes_pushed=self.push_bytes(aggs, replies)
                     )
             s.global_iterations = iteration + 1
+            s._fleet_tick(iteration)
             self._maybe_checkpoint(iteration)
             if m is not None and iteration % 50 == 0:
                 # Periodic snapshot alongside the progress event so even a
@@ -1063,6 +1064,7 @@ class AsyncEngine(RoundEngine):
                     clients=len(replies),
                 )
         s.global_iterations = iteration + 1
+        s._fleet_tick(iteration)
         self._maybe_checkpoint(iteration)
         if m is not None and iteration % 50 == 0:
             m.snapshot_registry(rounds=iteration + 1)
@@ -1270,6 +1272,7 @@ class PushEngine(AsyncEngine):
                     ),
                 )
         s.global_iterations = iteration + 1
+        s._fleet_tick(iteration)
         # The round is complete the moment the chain advances — replies
         # deliver it; journal now so a crash replays at most this round.
         s._journal_round(iteration)

@@ -64,7 +64,11 @@ from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.federation.server import build_template_model
-from gfedntm_tpu.utils.observability import span
+from gfedntm_tpu.utils.observability import (
+    FleetRegistry,
+    TelemetryShipper,
+    span,
+)
 
 
 class RelayNode:
@@ -116,6 +120,17 @@ class RelayNode:
             max_update_norm=max_update_norm if sanitize else None,
             metrics=metrics, logger=self.logger,
         )
+
+        # Fleet telemetry (README "Fleet telemetry & SLOs"): the relay IS
+        # the hierarchical pre-aggregation tier. A shard-local
+        # FleetRegistry absorbs members' piggybacked reports, and the
+        # upstream shipper sends ONE merged "relayN:shard" node entry
+        # (plus the relay's own registry) riding the StepReply it already
+        # answers — the root's telemetry cardinality stays O(relays),
+        # never O(clients), and the merge is exact (monotone counters +
+        # fixed-bucket histograms compose losslessly).
+        self.fleet = FleetRegistry(metrics=metrics)
+        self._shipper = TelemetryShipper(nodes_fn=self._telemetry_nodes)
 
         # Serializes the whole train/apply data plane (the root never
         # overlaps calls to one client, but the lock makes it a fact).
@@ -449,6 +464,10 @@ class RelayNode:
             if reply is None:
                 self._note_member_failure(rec, round_idx, exc, "TrainStep")
                 continue
+            if reply.telemetry:
+                # Members' piggybacked reports land in the SHARD-local
+                # fleet view; the upstream reply carries their merge.
+                self.fleet.ingest_bytes(reply.telemetry)
             answered.append((rec, reply))
 
         if self._uplink_down is not None:
@@ -528,7 +547,20 @@ class RelayNode:
             ),
             base_round=self._applied_round + 1,
             seq=int(request.seq),
+            telemetry=self._shipper.build(),
         )
+
+    def _telemetry_nodes(self) -> dict:
+        """The relay's upstream report sources: its own registry plus the
+        shard's pre-reduced merge as a single synthetic node."""
+        nodes: dict = {}
+        if self.metrics is not None:
+            node = self.metrics.node or f"relay{self.relay_id}"
+            nodes[node] = self.metrics.registry.snapshot()
+        shard = self.fleet.merged()
+        if shard:
+            nodes[f"relay{self.relay_id}:shard"] = shard
+        return nodes
 
     def _current_global(self) -> dict[str, np.ndarray]:
         return (

@@ -79,6 +79,7 @@ from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.train.guardian import DivergenceGuardian
 from gfedntm_tpu.models.ctm import CTM
 from gfedntm_tpu.utils.observability import (
+    FleetRegistry,
     OpsServer,
     RoundProfiler,
     StragglerDetector,
@@ -164,6 +165,9 @@ class FederatedServer:
         pacing_seed: int = 0,
         journal_every: int = 1,
         reconnect_grace_s: float = 120.0,
+        slo_specs=None,
+        fleet_max_nodes: int = 512,
+        fleet_max_series: int = 512,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -381,6 +385,25 @@ class FederatedServer:
             z_threshold=straggler_z,
         )
 
+        # Fleet telemetry plane + SLO engine (README "Fleet telemetry &
+        # SLOs"): clients piggyback delta-encoded registry reports on the
+        # replies/pushes/rejoins they already send; the FleetRegistry
+        # holds the per-node latest behind a cardinality guard, and the
+        # pacing engines tick the SLO state machine once per aggregation
+        # (_fleet_tick) — no extra threads, no extra round-trips.
+        self.fleet = FleetRegistry(
+            metrics=metrics, max_nodes=fleet_max_nodes,
+            max_series_per_node=fleet_max_series,
+        )
+        if slo_specs:
+            from gfedntm_tpu.utils.slo import SLOEngine
+
+            self.slo = SLOEngine(
+                slo_specs, snapshot_fn=self.fleet.merged, metrics=metrics,
+            )
+        else:
+            self.slo = None
+
         # Model-quality observability plane (README "Model-quality
         # observability"): with quality_every > 0, every quality round
         # extracts topic words from the global beta, computes NPMI
@@ -467,6 +490,8 @@ class FederatedServer:
                 ),
                 status_fn=self._status,
                 host=self.ops_host, port=self.ops_port,
+                fleet=self.fleet,
+                alerts_fn=self.slo.status if self.slo is not None else None,
             )
             self.ops_actual_port = self._ops_server.start()
             self.logger.info(
@@ -602,6 +627,18 @@ class FederatedServer:
             # coherence/diversity/drift ring buffer + per-client
             # contribution EWMAs; None when the plane is off.
             "model_quality": self._model_quality_status(full=full),
+            # Fleet telemetry plane (README "Fleet telemetry & SLOs"):
+            # headline counts only — the bounded deep view is
+            # /status.fleet, live alert detail is /alerts.
+            "fleet": {
+                "nodes": len(self.fleet.node_snapshots()),
+                "reports_invalid": count("fleet_reports_invalid"),
+                "reports_dropped": count("fleet_reports_dropped"),
+                "alerts_firing": (
+                    self.slo.status()["firing"]
+                    if self.slo is not None else None
+                ),
+            },
         }
 
     def _model_quality_status(self, full: bool = False) -> dict[str, Any] | None:
@@ -1188,6 +1225,11 @@ class FederatedServer:
             request.client_id, request.session_token
         )
         self.federation.connect_ready(request.client_id, request.address)
+        if request.telemetry:
+            # Rejoin resync (README "Fleet telemetry & SLOs"): the joining
+            # client's FULL registry report rides the ready it already
+            # sends, healing any deltas lost while it was away.
+            self.fleet.ingest_bytes(request.telemetry)
         ack_code, ack_detail = 0, "ready recorded"
         if kind == "restore":
             self.logger.info(
@@ -1355,6 +1397,13 @@ class FederatedServer:
         else:
             if seq:
                 self._push_seen[cid] = seq
+            if request.telemetry:
+                # Piggybacked telemetry (README "Fleet telemetry & SLOs"):
+                # deltas ride the push the client already streamed. Only
+                # non-duplicate pushes ingest — a replayed push re-ships
+                # the same bytes (replace-semantics would make re-ingest
+                # harmless, but skipping keeps report ages honest).
+                self.fleet.ingest_bytes(request.telemetry)
             self.federation.update_progress(
                 cid, int(request.current_mb), int(request.current_epoch),
                 float(request.loss), finished=bool(request.finished),
@@ -1578,6 +1627,22 @@ class FederatedServer:
                 ),
             )
 
+    def _fleet_tick(self, iteration: int) -> None:
+        """Per-aggregation telemetry housekeeping (README "Fleet telemetry
+        & SLOs"): fold the server's OWN registry into the fleet view (so
+        fleet-merged series include coordinator-side metrics), then run
+        one SLO evaluation pass over the merged snapshot. Called from the
+        pacing engines' aggregation points — no dedicated thread; alert
+        latency is bounded by round cadence, which is exactly the clock
+        the objectives are written against."""
+        if self.metrics is not None:
+            node = self.metrics.node or "server"
+            self.fleet.ingest(
+                node, self.metrics.registry.snapshot(), full=True,
+            )
+        if self.slo is not None:
+            self.slo.evaluate()
+
     def _awaiting_reconnect_grace(self) -> bool:
         """True while the post-recovery grace window is open AND some
         restored member has not reconnected — the round engines keep the
@@ -1725,6 +1790,12 @@ class FederatedServer:
                 continue
             if seq:
                 self._reply_seen[rec.client_id] = seq
+            if reply.telemetry:
+                # Piggybacked telemetry (README "Fleet telemetry & SLOs"):
+                # the node's metric deltas ride the poll reply it already
+                # sent. Post-dedup only — a replayed reply re-ships the
+                # same report bytes, so one ingest per observation.
+                self.fleet.ingest_bytes(reply.telemetry)
             deduped.append((rec, reply))
 
         if self.wire_codec.identity:

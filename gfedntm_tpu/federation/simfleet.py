@@ -41,6 +41,7 @@ from gfedntm_tpu.federation.compression import (
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.server import FederatedServer
+from gfedntm_tpu.utils import observability
 
 __all__ = [
     "SharedDecode",
@@ -90,7 +91,15 @@ class SimClientServicer:
     ``steps`` bounds the client's local budget: the reply accompanying
     its last budgeted step carries ``finished=True`` so runs terminate
     exactly like a real fleet. ``noise`` scales the per-step parameter
-    drift (rng seeded per client, deterministic)."""
+    drift (rng seeded per client, deterministic).
+
+    ``metrics`` opts the sim client into the fleet telemetry plane
+    (README "Fleet telemetry & SLOs"): each local round observes a
+    DETERMINISTIC synthetic ``local_step_s`` sample (a function of
+    (client_id, step), so e2e tests can compare the server's fleet-merged
+    histogram bucket-for-bucket against an offline merge of the clients'
+    JSONL snapshots) and the reply piggybacks the node's delta-encoded
+    report exactly like a real client."""
 
     def __init__(
         self,
@@ -101,6 +110,7 @@ class SimClientServicer:
         wire_codec: "str | WireCodec | None" = None,
         shared_decode: SharedDecode | None = None,
         seed: int = 0,
+        metrics=None,
     ):
         self.client_id = int(client_id)
         self.nr_samples = float(nr_samples)
@@ -116,6 +126,14 @@ class SimClientServicer:
             else None
         )
         self._shared_decode = shared_decode
+        self.metrics = metrics
+        self._shipper = (
+            observability.TelemetryShipper(
+                registry=metrics.registry,
+                node=metrics.node or f"client{client_id}",
+            )
+            if metrics is not None else None
+        )
         self._applied: dict[str, np.ndarray] | None = None
         self._applied_round = -1
         self._step = 0
@@ -150,6 +168,13 @@ class SimClientServicer:
         self._step += 1
         if self._step >= self.steps:
             self.finished = True
+        if self.metrics is not None:
+            # Deterministic synthetic step time (NOT wall clock): the
+            # telemetry e2e asserts exact bucket-count equality between
+            # the live fleet merge and the offline JSONL merge.
+            self.metrics.registry.histogram("local_step_s").observe(
+                0.001 * (1 + (self.client_id + self._step) % 7)
+            )
         if self._uplink is not None:
             shared = self._uplink.encode(snap)
         else:
@@ -165,6 +190,9 @@ class SimClientServicer:
             base_round=self._applied_round + 1,
             seq=seq,
             session_token=self.session_token,
+            telemetry=(
+                self._shipper.build() if self._shipper is not None else b""
+            ),
         )
 
     # -- servicer face (the loopback stub calls these) ------------------------
@@ -276,13 +304,16 @@ def make_sim_fleet(
     client_codec: bool = False,
     seed: int = 0,
     logger: logging.Logger | None = None,
+    client_metrics=None,
     **server_kw: Any,
 ) -> "tuple[SimFleetServer, dict[int, SimClientServicer], dict[str, np.ndarray]]":
     """Build a registered, training-ready simulated fleet: a tiny AVITM
     template, N sim clients (identity-codec clients share one decode),
     and a :class:`SimFleetServer` with every client connected + ready
     (the training thread is live on return). ``client_codec=False`` keeps
-    per-client state O(1) (requires the identity codec server-side)."""
+    per-client state O(1) (requires the identity codec server-side).
+    ``client_metrics`` (``cid -> MetricsLogger | None``) opts sim clients
+    into telemetry shipping (see :class:`SimClientServicer`)."""
     from gfedntm_tpu.data.vocab import Vocabulary
     from gfedntm_tpu.federation.server import build_template_model
 
@@ -304,6 +335,7 @@ def make_sim_fleet(
             cid, steps=steps,
             wire_codec=codec_spec if client_codec else None,
             shared_decode=shared, seed=seed,
+            metrics=client_metrics(cid) if client_metrics else None,
         )
         for cid in range(1, n_clients + 1)
     }

@@ -125,7 +125,26 @@ def evaluate_contracts(
         ),
     )
 
-    # 5. Final NPMI within the declared tolerance of the no-fault
+    # 5. Declared SLOs held: the cell's recorded telemetry, replayed
+    # through the offline evaluator (the `slo` CLI's engine), never
+    # drove any of the cell's objectives to firing. Only present when
+    # the cell declares specs — an SLO-less cell has no such contract.
+    if cell.slo:
+        slo_ev = evidence.get("slo") or {}
+        fired = list(slo_ev.get("fired") or ())
+        alerts = slo_ev.get("alerts") or []
+        out["slo"] = _contract(
+            bool(alerts) and not fired,
+            (
+                f"fired={fired}" if fired
+                else "; ".join(
+                    f"{a['alert']}: {a['objective']} ({a['state']})"
+                    for a in alerts
+                ) or "no SLO evidence collected"
+            ),
+        )
+
+    # 6. Final NPMI within the declared tolerance of the no-fault
     # baseline: the fault persona may slow convergence, but the model
     # the federation lands on must stay comparably coherent.
     npmi = evidence.get("npmi_final")

@@ -222,6 +222,12 @@ class ScenarioCell:
     seed: int = 0
     timeout_s: float = 420.0
     extra_server_kwargs: dict = field(default_factory=dict)
+    # SLO contract (README "Fleet telemetry & SLOs"): declarative
+    # objectives (SLOSpec dicts) the cell's recorded telemetry must hold
+    # — evaluated offline from the cell's JSONL evidence through the same
+    # engine the live planes run; any spec that ever fires is a red
+    # "slo" contract.
+    slo: tuple = ()
 
     def __post_init__(self):
         if self.workload not in ("avitm", "ctm"):
@@ -229,6 +235,12 @@ class ScenarioCell:
         # Parse eagerly: a typo'd persona fails at matrix build time.
         parse_data_persona(self.data)
         parse_fault_persona(self.fault)
+        if self.slo:
+            from gfedntm_tpu.utils.slo import SLOSpec
+
+            for spec in self.slo:
+                if not isinstance(spec, SLOSpec):
+                    SLOSpec.from_dict(dict(spec))
 
     @property
     def data_persona(self) -> DataPersona:

@@ -164,6 +164,10 @@ def _server_kwargs(cell: ScenarioCell, save_dir: str,
         journal_every=1,
         round_backoff_s=0.2,
     )
+    if cell.slo:
+        # The live engine runs the same specs the offline contract
+        # replays — alert_* events land in the cell's server stream.
+        kwargs["slo_specs"] = list(cell.slo)
     kwargs.update(cell.extra_server_kwargs)
     return kwargs
 
@@ -340,6 +344,7 @@ def run_cell(
         betas_finite=betas_finite,
         rounds=int(getattr(final_server, "global_iterations", 0)),
         recovery=recovery,
+        slo_specs=cell.slo or None,
     )
     if error is not None:
         evidence["error"] = error
@@ -375,6 +380,7 @@ def collect_cell_evidence(
     betas_finite: bool = False,
     rounds: int = 0,
     recovery: "dict[str, Any] | None" = None,
+    slo_specs=None,
 ) -> dict[str, Any]:
     """Digest a cell's per-node JSONL streams into the evidence dict the
     contracts evaluate — push-span contributor counts, quorum skips,
@@ -420,6 +426,24 @@ def collect_cell_evidence(
     for row in quality.get("quality", ()):
         if row.get("npmi") is not None:
             npmi_final = float(row["npmi"])
+    slo: "dict[str, Any] | None" = None
+    if slo_specs:
+        # SLO contract evidence (README "Fleet telemetry & SLOs"): replay
+        # the recorded snapshots through the offline evaluator — the same
+        # FleetRegistry + SLOEngine the live planes run.
+        from gfedntm_tpu.utils.slo import evaluate_stream
+
+        node_records: dict[str, list[dict[str, Any]]] = {}
+        for i, records in enumerate(records_by_stream):
+            for r in records:
+                node_records.setdefault(
+                    str(r.get("node") or f"stream{i}"), []
+                ).append(r)
+        engine = evaluate_stream(node_records, list(slo_specs))
+        slo = {
+            "fired": engine.ever_fired(),
+            "alerts": engine.status()["alerts"],
+        }
     return {
         "finished": bool(finished),
         "betas_finite": bool(betas_finite),
@@ -430,6 +454,7 @@ def collect_cell_evidence(
         "npmi_final": npmi_final,
         "quality_rounds": len(quality.get("quality", ())),
         "recovery": recovery,
+        "slo": slo,
         "server_recovered_events": sum(
             1 for r in all_records if r.get("event") == "server_recovered"
         ),

@@ -361,6 +361,16 @@ class ServingEngine:
         self._slot: _ModelSlot | None = None
         self._fns: dict[tuple[Any, int], Any] = {}
         self._publish_lock = threading.Lock()
+        if metrics is not None:
+            from gfedntm_tpu.utils.observability import DeviceMemoryMonitor
+
+            # Swap/warm is where serving's device footprint moves (two
+            # model slots live during the rebind, fresh warm compiles):
+            # sample accelerator memory there so the per-device gauges
+            # track the swap's high-water mark, not just steady state.
+            self._device_mem = DeviceMemoryMonitor(metrics.registry)
+        else:
+            self._device_mem = None
 
     # ---- state ------------------------------------------------------------
     @property
@@ -445,6 +455,8 @@ class ServingEngine:
                 self._warm(new_slot)
             prev_round = slot.round if slot is not None else None
             self._slot = new_slot
+        if self._device_mem is not None:
+            self._device_mem.sample()
         if self.metrics is not None:
             reg = self.metrics.registry
             reg.gauge("serving_model_round").set(pub.round)

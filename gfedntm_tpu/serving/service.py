@@ -354,10 +354,27 @@ class ServingPlane:
         ops_port: int | None = None,
         ops_host: str = "127.0.0.1",
         grpc_workers: int = 16,
+        slo_specs=None,
     ):
         self.logger = logger or logging.getLogger("ServingPlane")
         self.metrics = metrics
         self.poll_s = float(poll_s)
+        if slo_specs:
+            from gfedntm_tpu.utils.slo import SLOEngine
+
+            # The serving plane evaluates its OWN registry (serve latency
+            # / shed / error objectives) on the watcher's poll cadence —
+            # same engine, same alert lifecycle as the federation root.
+            self.slo = SLOEngine(
+                slo_specs,
+                snapshot_fn=(
+                    metrics.registry.snapshot if metrics is not None
+                    else dict
+                ),
+                metrics=metrics,
+            )
+        else:
+            self.slo = None
         self.source = ModelSource(
             save_dir, family=family, model_kwargs=model_kwargs,
             logger=self.logger, metrics=metrics,
@@ -408,6 +425,7 @@ class ServingPlane:
                 host=self.ops_host, port=self.ops_port,
                 ready_fn=lambda: self.engine.ready,
                 routes={"/infer": self._http_infer},
+                alerts_fn=self.slo.status if self.slo is not None else None,
             )
             self.ops_actual_port = self._ops_server.start()
             if self.metrics is not None:
@@ -463,6 +481,10 @@ class ServingPlane:
                     self.metrics.registry.counter(
                         "serving_source_errors"
                     ).inc()
+            if self.slo is not None:
+                # SLO tick on the watcher's clock: alert latency is
+                # bounded by poll_s, and no extra thread exists.
+                self.slo.evaluate()
             if self._stopping.wait(self.poll_s):
                 return
 
@@ -594,6 +616,8 @@ class ServingPlane:
             )
             serving["queue_depth"] = _val("serving_queue_depth")
         serving["max_queue"] = self.batcher.max_queue
+        if self.slo is not None:
+            serving["alerts_firing"] = self.slo.status()["firing"]
         serving["watch"] = {
             "directory": self.source.directory,
             "poll_s": self.poll_s,

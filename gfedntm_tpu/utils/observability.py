@@ -68,6 +68,7 @@ import os
 import re
 import threading
 import time
+import zlib
 from typing import Any, Iterator
 
 # ---- event schema -----------------------------------------------------------
@@ -180,6 +181,15 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "scenario_cell_started": frozenset({"cell", "workload", "pacing"}),
     "scenario_contract": frozenset({"cell", "contract", "ok"}),
     "scenario_cell_finished": frozenset({"cell", "ok", "seconds"}),
+    # fleet telemetry plane + SLO/alerting engine (README "Fleet telemetry
+    # & SLOs"): alert lifecycle transitions from the pending→firing→
+    # resolved state machine, plus the FleetRegistry cardinality guard's
+    # report-withholding record (a report over the node/series cap is
+    # dropped observably, never silently).
+    "alert_pending": frozenset({"alert", "metric", "threshold"}),
+    "alert_firing": frozenset({"alert", "metric", "threshold"}),
+    "alert_resolved": frozenset({"alert"}),
+    "fleet_overflow": frozenset({"node", "reason"}),
 }
 
 
@@ -672,6 +682,18 @@ SCENARIO_EVENTS: tuple[str, ...] = (
     "scenario_cell_finished",
 )
 
+#: Fleet-telemetry / SLO plane events (alert state-machine transitions +
+#: the FleetRegistry cardinality guard — README "Fleet telemetry & SLOs").
+#: Same reverse-lint contract: graftlint verifies each keeps an emission
+#: call site, so the alerting plane (which the `slo` CI gate and the
+#: /alerts endpoint both key on) can never be silently disconnected.
+FLEET_EVENTS: tuple[str, ...] = (
+    "alert_pending",
+    "alert_firing",
+    "alert_resolved",
+    "fleet_overflow",
+)
+
 
 def new_trace_id() -> str:
     """A fresh 16-hex-char trace id (one federation training run)."""
@@ -947,6 +969,403 @@ class DeviceMemoryMonitor:
                 ).set(peak)
 
 
+# ---- fleet telemetry plane (README "Fleet telemetry & SLOs") ----------------
+
+def merge_metric_snapshots(
+    a: dict[str, Any], b: dict[str, Any]
+) -> dict[str, Any]:
+    """Merge two snapshot dicts of the SAME metric from different nodes.
+
+    The merge is exact by construction: counters are monotone (values
+    add), gauges are last-write-wins (``b`` wins when it carries a value),
+    and histograms are fixed-bucket (identical edges ⇒ bucket-wise count
+    addition loses nothing). This one primitive backs the relay tier's
+    upstream pre-reduction, the server's :class:`FleetRegistry`, and the
+    offline ``summarize`` cross-node merge, so live and post-hoc fleet
+    views can never drift apart. Raises ``ValueError`` on a type or
+    bucket-layout mismatch."""
+    ta, tb = a.get("type"), b.get("type")
+    if ta != tb:
+        raise ValueError(f"cannot merge snapshot types {ta!r} and {tb!r}")
+    if ta == "counter":
+        return {"type": "counter",
+                "value": float(a.get("value") or 0.0)
+                + float(b.get("value") or 0.0)}
+    if ta == "gauge":
+        return {"type": "gauge",
+                "value": b["value"] if b.get("value") is not None
+                else a.get("value")}
+    if ta == "histogram":
+        if list(a["edges"]) != list(b["edges"]):
+            raise ValueError(
+                "cannot merge histograms with different bucket edges"
+            )
+        out: dict[str, Any] = {
+            "type": "histogram",
+            "count": a.get("count", 0) + b.get("count", 0),
+            "sum": a.get("sum", 0.0) + b.get("sum", 0.0),
+            "edges": list(a["edges"]),
+            "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+        }
+        # Empty histograms omit min/max (Histogram.snapshot contract).
+        mins = [s["min"] for s in (a, b) if "min" in s]
+        maxs = [s["max"] for s in (a, b) if "max" in s]
+        if mins:
+            out["min"], out["max"] = min(mins), max(maxs)
+        return out
+    raise ValueError(f"cannot merge unknown snapshot type {ta!r}")
+
+
+def merge_node_snapshots(
+    nodes: "dict[str, dict[str, Any]]"
+) -> dict[str, Any]:
+    """Merge per-node registry snapshots (``{node: {metric: snapshot}}``)
+    into one fleet-wide snapshot dict via :func:`merge_metric_snapshots`.
+    A metric whose snapshots are unmergeable across nodes (type or bucket
+    mismatch — a fleet running mixed code) is dropped from the merged view
+    rather than poisoning the scrape; iteration order is node-sorted so
+    gauge last-write-wins resolution is deterministic."""
+    merged: dict[str, Any] = {}
+    dropped: set[str] = set()
+    for node in sorted(nodes):
+        for name, snap in nodes[node].items():
+            if name in dropped:
+                continue
+            cur = merged.get(name)
+            if cur is None:
+                merged[name] = dict(snap)
+                continue
+            try:
+                merged[name] = merge_metric_snapshots(cur, snap)
+            except (ValueError, KeyError, TypeError):
+                del merged[name]
+                dropped.add(name)
+    return merged
+
+
+def encode_telemetry_report(
+    nodes: "dict[str, dict[str, Any]]", full: bool
+) -> bytes:
+    """Serialize one telemetry report (``{node: {metric: snapshot}}``) to
+    the compact zlib+JSON wire form carried in the ``telemetry`` proto
+    fields. ``full`` tells the receiver to REPLACE each included node's
+    series (healing any deltas lost to partitions) instead of patching."""
+    return zlib.compress(json.dumps(
+        {"nodes": nodes, "full": bool(full)}, default=float,
+    ).encode())
+
+
+def decode_telemetry_report(data: bytes) -> dict[str, Any]:
+    """Parse a wire telemetry report; raises ``ValueError`` on garbage
+    (truncated zlib stream, non-JSON, wrong shape)."""
+    try:
+        report = json.loads(zlib.decompress(data).decode())
+    except Exception as err:
+        raise ValueError(f"bad telemetry report: {err}")
+    if not isinstance(report, dict) or not isinstance(
+        report.get("nodes"), dict
+    ):
+        raise ValueError("bad telemetry report: missing 'nodes' mapping")
+    return report
+
+
+class TelemetryShipper:
+    """Builds the delta-encoded telemetry reports a node piggybacks on
+    RPCs it already makes (StepReply / PushUpdate / rejoin — zero extra
+    round-trips).
+
+    Registry snapshots are cumulative, so each :meth:`build` ships only
+    the metrics whose snapshot CHANGED since the last ship (usually a
+    handful of counters/histograms per round); every ``full_every``-th
+    ship is a full snapshot, which re-synchronizes a receiver that missed
+    deltas to a partition or crash — shipping is best-effort by design
+    and the periodic full report is the loss-healing mechanism. Returns
+    ``b""`` when nothing changed (the proto field stays empty and costs
+    nothing on the wire).
+
+    ``nodes_fn`` generalizes the source to multi-node reports: a relay
+    ships its own registry PLUS its shard's pre-reduced merge in one
+    report (see :class:`FleetRegistry`). Not thread-safe — call from the
+    single thread that builds the carrying RPC reply.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None,
+                 node: str = "", nodes_fn=None, full_every: int = 10):
+        if nodes_fn is None:
+            if registry is None:
+                raise ValueError("need a registry or a nodes_fn")
+            reg, name = registry, node
+
+            def nodes_fn():
+                return {name: reg.snapshot()}
+
+        self._nodes_fn = nodes_fn
+        self.full_every = max(1, int(full_every))
+        self._ships = 0
+        self._last: dict[str, dict[str, Any]] = {}
+
+    def build(self) -> bytes:
+        """The next report's wire bytes (``b""`` = nothing changed)."""
+        nodes = self._nodes_fn()
+        full = self._ships % self.full_every == 0
+        self._ships += 1
+        if full:
+            payload = nodes
+        else:
+            payload = {}
+            for node, metrics in nodes.items():
+                prev = self._last.get(node, {})
+                changed = {
+                    name: snap for name, snap in metrics.items()
+                    if prev.get(name) != snap
+                }
+                if changed:
+                    payload[node] = changed
+        self._last = {n: dict(m) for n, m in nodes.items()}
+        if not payload:
+            return b""
+        return encode_telemetry_report(payload, full)
+
+
+class FleetRegistry:
+    """Server-side store of per-node registry snapshots: the live,
+    federation-wide metrics view.
+
+    Reports arrive via :meth:`ingest_bytes` (the wire form), are patched
+    per-node with replace-semantics (cumulative snapshots ⇒ ingesting the
+    same report twice is a no-op, so RPC replays deduplicate naturally),
+    and merge on demand into one fleet snapshot (:meth:`merged`) via the
+    exact merge primitive. A cardinality guard bounds both the node count
+    and the per-node series count — an adversarial or runaway client can
+    at worst have its OWN report withheld (counted in the
+    ``fleet_reports_dropped`` counter + one ``fleet_overflow`` event per
+    offending node, never silently)."""
+
+    def __init__(self, metrics: "MetricsLogger | None" = None,
+                 max_nodes: int = 512, max_series_per_node: int = 512):
+        self.metrics = metrics
+        self.max_nodes = int(max_nodes)
+        self.max_series_per_node = int(max_series_per_node)
+        self._nodes: dict[str, dict[str, Any]] = {}
+        self._last_report: dict[str, float] = {}
+        self._overflow_seen: set[tuple[str, str]] = set()
+        self._lock = threading.Lock()
+
+    def _overflow(self, node: str, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.registry.counter("fleet_reports_dropped").inc()
+            key = (node, reason)
+            if key not in self._overflow_seen:
+                self._overflow_seen.add(key)
+                self.metrics.log("fleet_overflow", node=node, reason=reason)
+
+    def ingest_bytes(self, data: bytes) -> bool:
+        """Ingest one wire report; corrupt bytes are counted
+        (``fleet_reports_invalid``), never raised — a garbled telemetry
+        payload must not perturb the round loop carrying it."""
+        if not data:
+            return False
+        try:
+            report = decode_telemetry_report(bytes(data))
+        except ValueError:
+            if self.metrics is not None:
+                self.metrics.registry.counter("fleet_reports_invalid").inc()
+            return False
+        ok = False
+        full = bool(report.get("full"))
+        for node in sorted(report["nodes"]):
+            metrics = report["nodes"][node]
+            if isinstance(metrics, dict):
+                ok = self.ingest(str(node), metrics, full=full) or ok
+        return ok
+
+    def ingest(self, node: str, metrics: dict[str, Any],
+               full: bool = False) -> bool:
+        """Patch (or, with ``full``, replace) one node's series."""
+        overflow_reason = None
+        with self._lock:
+            cur = self._nodes.get(node)
+            if cur is None:
+                if len(self._nodes) >= self.max_nodes:
+                    overflow_reason = "max_nodes"
+                else:
+                    cur = self._nodes[node] = {}
+            if cur is not None:
+                if full:
+                    cur.clear()
+                for name in sorted(metrics):
+                    if (name not in cur
+                            and len(cur) >= self.max_series_per_node):
+                        overflow_reason = "max_series_per_node"
+                        break
+                    cur[name] = metrics[name]
+                self._last_report[node] = time.time()
+        if overflow_reason is not None:
+            self._overflow(node, overflow_reason)
+        return overflow_reason is None
+
+    def node_snapshots(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {node: dict(m) for node, m in self._nodes.items()}
+
+    def merged(self) -> dict[str, Any]:
+        """The fleet-wide merged snapshot (one dict, same shape as a
+        :meth:`MetricRegistry.snapshot` — every downstream consumer of
+        single-registry snapshots works on it unchanged)."""
+        return merge_node_snapshots(self.node_snapshots())
+
+    def summary(self, top_k: int = 8) -> dict[str, Any]:
+        """Bounded fleet summary for ``/status.fleet``: totals plus the
+        top-k nodes by series count and the top-k busiest merged
+        histograms — the response size is O(top_k) regardless of fleet
+        size (the StragglerDetector top-k pattern)."""
+        now = time.time()
+        with self._lock:
+            sizes = {node: len(m) for node, m in self._nodes.items()}
+            ages = {node: now - t for node, t in self._last_report.items()}
+        top_nodes = heapq.nlargest(
+            top_k, sizes.items(), key=lambda kv: (kv[1], str(kv[0]))
+        )
+        merged = self.merged()
+        hists = [
+            (name, snap) for name, snap in merged.items()
+            if snap.get("type") == "histogram" and snap.get("count")
+        ]
+        top_hists = heapq.nlargest(
+            top_k, hists, key=lambda kv: (kv[1]["count"], kv[0])
+        )
+        return {
+            "nodes": len(sizes),
+            "series": sum(sizes.values()),
+            "merged_series": len(merged),
+            "top_nodes": [
+                {"node": node, "series": n,
+                 "report_age_s": round(ages.get(node, 0.0), 3)}
+                for node, n in top_nodes
+            ],
+            "histograms": {
+                name: _hist_stats(snap) for name, snap in top_hists
+            },
+        }
+
+
+def render_fleet_prometheus(
+    nodes: "dict[str, dict[str, Any]]", prefix: str = "gfedntm",
+    max_series: int = 256,
+) -> str:
+    """Prometheus exposition of a fleet view: ``<prefix>_fleet_*``
+    families carry the exact cross-node merge, ``<prefix>_node_*``
+    families carry the per-node series with a ``node`` label (plus the
+    usual ``key`` label). Distinct family prefixes keep both valid in one
+    scrape alongside the process's own ``<prefix>_*`` registry. The
+    per-node section shares the cardinality-cap discipline of
+    :func:`render_prometheus`: each family exports its first
+    ``max_series`` (node, key) pairs sorted (stable across scrapes) plus
+    an overflow counter for the withheld remainder."""
+    out = [render_prometheus(
+        merge_node_snapshots(nodes), prefix=f"{prefix}_fleet",
+        max_series=max_series,
+    )]
+
+    families: dict[str, list[tuple[str, str, dict[str, Any]]]] = {}
+    for node, metrics in nodes.items():
+        for name, snap in metrics.items():
+            base, _, key = name.partition("/")
+            families.setdefault(_prom_name(base), []).append(
+                (node, key, snap)
+            )
+    overflow: dict[str, int] = {}
+    lines: list[str] = []
+    for base in sorted(families):
+        series = sorted(families[base], key=lambda t: (t[0], t[1]))
+        if max_series and len(series) > max_series:
+            overflow[base] = len(series) - max_series
+            series = series[:max_series]
+        kind = series[0][2].get("type")
+        full = f"{prefix}_node_{base}"
+        if kind == "counter":
+            full += "_total"
+        if kind not in ("counter", "gauge", "histogram"):
+            continue
+        lines.append(f"# TYPE {full} {kind}")
+        for node, key, snap in series:
+            if snap.get("type") != kind:
+                continue  # cross-node type mismatch: skip, never 500
+            label_parts = [f'node="{_prom_label(node)}"']
+            if key:
+                label_parts.append(f'key="{_prom_label(key)}"')
+            label = "{" + ",".join(label_parts) + "}"
+            if kind == "counter":
+                lines.append(f"{full}{label} {snap['value']}")
+            elif kind == "gauge":
+                if snap["value"] is not None:
+                    lines.append(f"{full}{label} {snap['value']}")
+            else:
+                base_label = ",".join(label_parts)
+                cum = 0
+                for edge, count in zip(snap["edges"], snap["counts"]):
+                    cum += count
+                    lines.append(
+                        f'{full}_bucket{{{base_label},le="{edge}"}} {cum}'
+                    )
+                cum += snap["counts"][-1]
+                lines.append(
+                    f'{full}_bucket{{{base_label},le="+Inf"}} {cum}'
+                )
+                lines.append(f"{full}_sum{label} {snap['sum']}")
+                lines.append(f"{full}_count{label} {snap['count']}")
+    if overflow:
+        full = f"{prefix}_node_series_overflow_total"
+        lines.append(f"# TYPE {full} counter")
+        for base in sorted(overflow):
+            lines.append(
+                f'{full}{{family="{_prom_label(base)}"}} {overflow[base]}'
+            )
+    if lines:
+        out.append("\n".join(lines) + "\n")
+    return "".join(out)
+
+
+#: Process start reference for the ``process_uptime_s`` gauge.
+_PROCESS_START_TIME = time.time()
+
+
+def sample_process_metrics(registry: MetricRegistry) -> None:
+    """Refresh the process self-gauges (``process_rss_bytes``,
+    ``process_uptime_s``, ``process_threads``) — stdlib only, sampled per
+    ops scrape so every plane that serves ``/metrics`` exposes them
+    without per-plane wiring. Makes the BENCH_SCALE flat-RSS claim
+    scrapeable live instead of only measurable via subprocess
+    ``ru_maxrss``."""
+    rss = None
+    try:
+        # Current RSS (not the rusage high-water mark) when /proc exists.
+        with open("/proc/self/statm") as fh:
+            rss = int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    # graftlint: disable=exception-hygiene -- platform probe: no /proc
+    # (macOS) falls back to the rusage peak below
+    except Exception:
+        try:
+            import resource
+            import sys
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # ru_maxrss (the peak, the best available without /proc) is
+            # bytes on macOS, KiB elsewhere.
+            scale = 1 if sys.platform == "darwin" else 1024
+            rss = int(ru.ru_maxrss) * scale
+        # graftlint: disable=exception-hygiene -- no resource module
+        # (non-POSIX): the gauge is simply absent
+        except Exception:
+            rss = None
+    if rss is not None:
+        registry.gauge("process_rss_bytes").set(rss)
+    registry.gauge("process_uptime_s").set(
+        time.time() - _PROCESS_START_TIME
+    )
+    registry.gauge("process_threads").set(threading.active_count())
+
+
 # ---- run summaries (the `summarize` CLI subcommand's engine) ----------------
 
 def read_metrics(path: str) -> list[dict[str, Any]]:
@@ -1102,7 +1521,8 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
     stragglers: dict[Any, dict] = {}
     compile_events: list[dict[str, Any]] = []
     rpc_errors: list[dict[str, Any]] = []
-    last_snapshots: dict[str, dict] = {}
+    per_node_snapshots: dict[str, dict[str, dict]] = {}
+    alerts: dict[str, dict[str, Any]] = {}
     summary_event: dict[str, Any] | None = None
 
     for r in records:
@@ -1141,14 +1561,36 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
         elif event == "rpc" and not r.get("ok", True):
             rpc_errors.append(r)
         elif event == "metrics_snapshot":
-            # Registries are cumulative, so the LAST snapshot mentioning a
-            # metric carries its totals.
+            # Registries are cumulative, so — PER NODE — the last snapshot
+            # mentioning a metric carries its totals. Keying by name alone
+            # would let a multi-node stream's nodes clobber each other
+            # (client7's local_step_s overwriting client3's); nodes merge
+            # exactly below instead.
+            node_snaps = per_node_snapshots.setdefault(
+                str(r.get("node") or ""), {}
+            )
             for name, snap in (r.get("metrics") or {}).items():
-                last_snapshots[name] = snap
+                node_snaps[name] = snap
+        elif event in ("alert_pending", "alert_firing", "alert_resolved"):
+            state = event[len("alert_"):]
+            a = alerts.setdefault(
+                str(r.get("alert")),
+                {"pending": 0, "firing": 0, "resolved": 0,
+                 "last_state": "ok", "metric": r.get("metric")},
+            )
+            a[state] += 1
+            a["last_state"] = state
+            if r.get("metric") is not None:
+                a["metric"] = r.get("metric")
         elif event == "summary":
             summary_event = {
                 k: v for k, v in r.items() if k not in ("event", "time")
             }
+
+    # Fleet totals: counters sum, gauges last-wins, histograms add
+    # bucket-wise — the same primitive the live FleetRegistry merge uses,
+    # so offline summaries and /metrics can never disagree.
+    last_snapshots = merge_node_snapshots(per_node_snapshots)
 
     step_time = {
         name: _hist_stats(snap)
@@ -1194,6 +1636,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> dict[str, Any]:
         "rpc_errors": len(rpc_errors),
         "counters": counters,
         "gauges": gauges,
+        "alerts": alerts,
         "compile": compile_events,
         "summary": summary_event,
         "data_plane": collect_data_plane(records),
@@ -1346,6 +1789,17 @@ def format_report(s: dict[str, Any]) -> str:
         for cid, n in sorted(dp.get("quarantines", {}).items()):
             lines.append(f"  quarantined: client {cid} x{n}")
 
+    if s.get("alerts"):
+        lines.append("")
+        lines.append("SLO alerts:")
+        for name, a in sorted(s["alerts"].items()):
+            metric = f" on {a['metric']}" if a.get("metric") else ""
+            lines.append(
+                f"  {name}{metric}: fired x{a['firing']} "
+                f"(pending x{a['pending']}, resolved x{a['resolved']}), "
+                f"last state {a['last_state']}"
+            )
+
     enc = s["counters"].get("codec_encoded_bytes")
     dec = s["counters"].get("codec_decoded_bytes")
     if enc is not None or dec is not None:
@@ -1385,9 +1839,19 @@ def summarize_model_quality(
     quality: dict[int, dict[str, Any]] = {}
     last_gauges: dict[str, float] = {}
     topics_last: list[list[str]] | None = None
+    alerts: dict[str, dict[str, Any]] = {}
     for r in records:
         event = r.get("event")
-        if event == "quality_computed":
+        if event in ("alert_pending", "alert_firing", "alert_resolved"):
+            state = event[len("alert_"):]
+            a = alerts.setdefault(
+                str(r.get("alert")),
+                {"pending": 0, "firing": 0, "resolved": 0,
+                 "last_state": "ok", "metric": r.get("metric")},
+            )
+            a[state] += 1
+            a["last_state"] = state
+        elif event == "quality_computed":
             row = quality.setdefault(int(r.get("round", -1)), {})
             row.update(
                 npmi=r.get("npmi"), diversity=r.get("diversity"),
@@ -1428,6 +1892,7 @@ def summarize_model_quality(
             "cos_min": last_gauges.get("contribution_pairwise_cos_min"),
         },
         "topics": topics_last,
+        "alerts": alerts,
         "data_plane": collect_data_plane(records),
     }
 
@@ -1542,6 +2007,17 @@ def format_quality_report(s: dict[str, Any]) -> str:
                if restored is not None else "")
             + ")"
         )
+
+    if s.get("alerts"):
+        lines.append("")
+        lines.append("SLO alerts:")
+        for name, a in sorted(s["alerts"].items()):
+            metric = f" on {a['metric']}" if a.get("metric") else ""
+            lines.append(
+                f"  {name}{metric}: fired x{a['firing']} "
+                f"(pending x{a['pending']}, resolved x{a['resolved']}), "
+                f"last state {a['last_state']}"
+            )
 
     if s.get("topics"):
         lines.append("")
@@ -1677,6 +2153,16 @@ class OpsServer:
     returning ``(http_code, content_type, body_bytes)``. Handler
     exceptions surface as 500s, never kill the serving thread.
 
+    Fleet telemetry (README "Fleet telemetry & SLOs"): passing a
+    :class:`FleetRegistry` as ``fleet`` extends ``/metrics`` with the
+    fleet-merged ``<prefix>_fleet_*`` families plus node-labeled
+    ``<prefix>_node_*`` series, and mounts ``/status.fleet`` (the bounded
+    top-k :meth:`FleetRegistry.summary`). An ``alerts_fn`` mounts
+    ``/alerts`` (the SLO engine's live alert states). Every ``/metrics``
+    scrape also refreshes the process self-gauges
+    (:func:`sample_process_metrics`), so each ops plane exposes
+    ``gfedntm_process_{rss_bytes,uptime_s,threads}`` for free.
+
     Entirely out of the training hot path: no thread is started unless
     :meth:`start` is called, and GET handlers only *read* registry
     snapshots.
@@ -1684,11 +2170,14 @@ class OpsServer:
 
     def __init__(self, registry: MetricRegistry | None = None,
                  status_fn=None, host: str = "127.0.0.1", port: int = 0,
-                 ready_fn=None, routes: dict | None = None):
+                 ready_fn=None, routes: dict | None = None,
+                 fleet: "FleetRegistry | None" = None, alerts_fn=None):
         self.registry = registry or MetricRegistry()
         self.status_fn = status_fn
         self.ready_fn = ready_fn
         self.routes = dict(routes or {})
+        self.fleet = fleet
+        self.alerts_fn = alerts_fn
         self.host = host
         self.port = port
         self._httpd = None
@@ -1720,10 +2209,25 @@ class OpsServer:
                         ctype = "text/plain"
                         body = b"ready\n" if ready else b"not ready\n"
                     elif path == "/metrics":
+                        sample_process_metrics(ops.registry)
                         text = render_prometheus(ops.registry.snapshot())
+                        if ops.fleet is not None:
+                            text += render_fleet_prometheus(
+                                ops.fleet.node_snapshots()
+                            )
                         code = 200
                         ctype = "text/plain; version=0.0.4"
                         body = text.encode()
+                    elif path == "/status.fleet" and ops.fleet is not None:
+                        code, ctype = 200, "application/json"
+                        body = json.dumps(
+                            ops.fleet.summary(), default=str, indent=1,
+                        ).encode()
+                    elif path == "/alerts" and ops.alerts_fn is not None:
+                        code, ctype = 200, "application/json"
+                        body = json.dumps(
+                            ops.alerts_fn(), default=str, indent=1,
+                        ).encode()
                     elif path == "/status":
                         full = "full=1" in query.split("&")
                         if ops.status_fn is None:

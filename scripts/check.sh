@@ -55,8 +55,43 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_survival.py tests/test_scaleout.py \
     tests/test_multichip.py tests/test_serving.py \
     tests/test_scenarios.py \
+    tests/test_fleet_telemetry.py tests/test_slo.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
+
+# SLO CLI gate (README "Fleet telemetry & SLOs"): the offline `slo`
+# subcommand must pass a known-good stream (exit 0) and fail a seeded
+# violation (exit 1) — the CI-gate contract itself is what's checked,
+# from synthetic fixtures generated inline so the stage needs no
+# checked-in artifacts.
+echo "== slo CLI gate =="
+SLO_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu python - "$SLO_TMP" <<'PY' || exit 1
+import json, sys
+tmp = sys.argv[1]
+def stream(path, errors):
+    with open(path, "w") as fh:
+        for t, err in enumerate(errors):
+            fh.write(json.dumps({
+                "event": "metrics_snapshot", "time": 1000.0 + t,
+                "node": "server",
+                "metrics": {"serving_errors":
+                            {"type": "counter", "value": float(err)}},
+            }) + "\n")
+stream(f"{tmp}/good.jsonl", [0, 0, 0, 0])
+stream(f"{tmp}/bad.jsonl", [0, 5, 9, 12])
+with open(f"{tmp}/slo.json", "w") as fh:
+    json.dump([{"name": "no-serve-errors", "metric": "serving_errors",
+                "agg": "value", "op": "<=", "threshold": 0.0}], fh)
+PY
+env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli slo \
+    --slo "$SLO_TMP/slo.json" "$SLO_TMP/good.jsonl" || exit 1
+if env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli slo \
+    --slo "$SLO_TMP/slo.json" "$SLO_TMP/bad.jsonl" >/dev/null 2>&1; then
+    echo "slo CLI failed to flag a seeded SLO violation" >&2
+    exit 1
+fi
+rm -rf "$SLO_TMP"
 
 if [ "${SCENARIO:-0}" = "1" ]; then
     # Scenario-matrix smoke (README "Scenario matrix"): two fast cells

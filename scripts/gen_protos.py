@@ -50,9 +50,15 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # re-enter the federation as the SAME live process (registry record,
     # straggler EWMA, and push-ack/codec posture restored) instead of
     # being treated as a fresh rejoin.
+    # `telemetry` (README "Fleet telemetry & SLOs"): a rejoining client
+    # piggybacks a FULL delta-encoded MetricRegistry report, so the
+    # server's FleetRegistry resynchronizes the node's series in the same
+    # RPC that restores its session — no extra round-trips, best-effort
+    # (an empty field costs nothing on the wire).
     "JoinRequest": [
         ("codec_id", 3, F.TYPE_STRING),
         ("session_token", 4, F.TYPE_STRING),
+        ("telemetry", 5, F.TYPE_BYTES),
     ],
     # Pacing negotiation (README "Hierarchical federation & wire
     # efficiency"): the server advertises its round pacing policy
@@ -98,10 +104,17 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # (push pacing): the server only buffers an update whose token matches
     # the member's current durable session — a stale process's pushes are
     # turned away instead of entering the average.
+    # `telemetry` piggybacks the node's delta-encoded MetricRegistry
+    # report on replies it already sends (polls AND client-initiated
+    # pushes reuse this message) — the fleet telemetry plane's shipping
+    # path (README "Fleet telemetry & SLOs"). Loss-tolerant: a dropped
+    # reply drops its deltas, and the shipper's periodic full report
+    # heals the receiver.
     "StepReply": [
         ("base_round", 8, F.TYPE_INT64),
         ("seq", 9, F.TYPE_INT64),
         ("session_token", 10, F.TYPE_STRING),
+        ("telemetry", 11, F.TYPE_BYTES),
     ],
 }
 

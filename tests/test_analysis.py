@@ -211,6 +211,28 @@ class TestTelemetryContract:
             options=telemetry_contract(spans=("round",)),
         ) == []
 
+    def test_fleet_events_reverse_lint_catches_disconnect(self, tmp_path):
+        """ISSUE 16: the FLEET_EVENTS group is reverse-linted — a
+        refactor that disconnects an alert-lifecycle emission (e.g.
+        routing it through a variable event name, invisible to the
+        literal-only scanner) must fail the lint, not pass silently."""
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            'metrics.log("alert_pending", alert="a")\n',
+            options=telemetry_contract(
+                events={
+                    "alert_pending": frozenset({"alert"}),
+                    "alert_firing": frozenset({"alert"}),
+                },
+                required={"FLEET_EVENTS": ("alert_pending",
+                                           "alert_firing")},
+            ),
+        )
+        assert len(found) == 1
+        assert "FLEET_EVENTS" in found[0].message
+        assert "'alert_firing'" in found[0].message
+        assert "no .log() emission site" in found[0].message
+
     def test_scanner_selfcheck_fires_on_zero_sites(self, tmp_path):
         found = lint_src(
             tmp_path, TelemetryContractRule(paths=EVERYWHERE),

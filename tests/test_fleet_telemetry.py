@@ -1,0 +1,489 @@
+"""Fleet telemetry plane tests (ISSUE 16): the exact cross-node merge
+primitive, delta-encoded piggyback shipping (loss-tolerant by periodic
+full reports), the server-side FleetRegistry (replay dedup, corrupt-bytes
+hygiene, cardinality guard, bounded /status.fleet summary), fleet
+Prometheus exposition, the relay tier's pre-reduced shard report, and the
+acceptance e2e: a live simulated federation whose server-side fleet-merged
+histogram equals the offline merge of the clients' own JSONL snapshots
+bucket-for-bucket — under sync, cohort, and push pacing.
+"""
+
+import json
+import urllib.request
+import zlib
+
+import pytest
+
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.relay import RelayNode
+from gfedntm_tpu.federation.simfleet import make_sim_fleet
+from gfedntm_tpu.utils.observability import (
+    FleetRegistry,
+    MetricRegistry,
+    MetricsLogger,
+    OpsServer,
+    TelemetryShipper,
+    decode_telemetry_report,
+    encode_telemetry_report,
+    merge_metric_snapshots,
+    merge_node_snapshots,
+    read_metrics,
+    render_fleet_prometheus,
+    summarize_metrics,
+)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _observe_series(registry, values):
+    h = registry.histogram("local_step_s")
+    for v in values:
+        h.observe(v)
+
+
+# ---- exact merge primitive ---------------------------------------------------
+
+class TestMergePrimitive:
+    def test_counters_sum(self):
+        out = merge_metric_snapshots(
+            {"type": "counter", "value": 3.0},
+            {"type": "counter", "value": 4.5},
+        )
+        assert out == {"type": "counter", "value": 7.5}
+
+    def test_gauges_last_write_wins_but_none_never_clobbers(self):
+        a = {"type": "gauge", "value": 1.0}
+        b = {"type": "gauge", "value": 2.0}
+        none = {"type": "gauge", "value": None}
+        assert merge_metric_snapshots(a, b)["value"] == 2.0
+        assert merge_metric_snapshots(a, none)["value"] == 1.0
+        assert merge_metric_snapshots(none, b)["value"] == 2.0
+
+    def test_histograms_add_bucket_wise_exactly(self):
+        ra, rb = MetricRegistry(), MetricRegistry()
+        _observe_series(ra, [0.001, 0.002, 5.0])
+        _observe_series(rb, [0.002, 0.5])
+        a = ra.snapshot()["local_step_s"]
+        b = rb.snapshot()["local_step_s"]
+        out = merge_metric_snapshots(a, b)
+        assert out["count"] == 5
+        assert out["sum"] == pytest.approx(a["sum"] + b["sum"])
+        assert out["counts"] == [
+            x + y for x, y in zip(a["counts"], b["counts"])
+        ]
+        assert out["min"] == min(a["min"], b["min"])
+        assert out["max"] == max(a["max"], b["max"])
+
+    def test_empty_histogram_merge_keeps_min_max_contract(self):
+        ra, rb = MetricRegistry(), MetricRegistry()
+        ra.histogram("h")  # never observed: snapshot omits min/max
+        rb.histogram("h")
+        empty = ra.snapshot()["h"]
+        assert "min" not in empty
+        both_empty = merge_metric_snapshots(empty, rb.snapshot()["h"])
+        assert both_empty["count"] == 0 and "min" not in both_empty
+        rb.histogram("h").observe(0.01)
+        one_sided = merge_metric_snapshots(empty, rb.snapshot()["h"])
+        assert one_sided["min"] == one_sided["max"] == 0.01
+
+    def test_mismatches_raise(self):
+        with pytest.raises(ValueError):
+            merge_metric_snapshots(
+                {"type": "counter", "value": 1.0},
+                {"type": "gauge", "value": 1.0},
+            )
+        h = {"type": "histogram", "count": 0, "sum": 0.0,
+             "edges": [1.0], "counts": [0, 0]}
+        g = {"type": "histogram", "count": 0, "sum": 0.0,
+             "edges": [2.0], "counts": [0, 0]}
+        with pytest.raises(ValueError):
+            merge_metric_snapshots(h, g)
+
+    def test_node_merge_drops_unmergeable_and_is_deterministic(self):
+        nodes = {
+            "client2": {"m": {"type": "counter", "value": 1.0},
+                        "g": {"type": "gauge", "value": 2.0}},
+            "client1": {"m": {"type": "gauge", "value": 9.0},
+                        "g": {"type": "gauge", "value": 1.0}},
+        }
+        merged = merge_node_snapshots(nodes)
+        # mixed-type metric dropped, never poisons the view
+        assert "m" not in merged
+        # node-sorted iteration: client2's gauge write wins
+        assert merged["g"]["value"] == 2.0
+
+
+# ---- wire form + delta shipper ----------------------------------------------
+
+class TestTelemetryShipper:
+    def test_wire_roundtrip_and_garbage_rejection(self):
+        nodes = {"client1": {"c": {"type": "counter", "value": 2.0}}}
+        data = encode_telemetry_report(nodes, full=True)
+        report = decode_telemetry_report(data)
+        assert report["nodes"] == nodes and report["full"] is True
+        for garbage in (b"\x00junk", zlib.compress(b"[1,2]"),
+                        zlib.compress(b"{}")):
+            with pytest.raises(ValueError):
+                decode_telemetry_report(garbage)
+
+    def test_ships_only_changed_metrics_and_empty_when_idle(self):
+        reg = MetricRegistry()
+        reg.counter("a").inc()
+        reg.counter("b").inc()
+        shipper = TelemetryShipper(registry=reg, node="client1")
+        first = decode_telemetry_report(shipper.build())
+        assert first["full"] is True
+        assert set(first["nodes"]["client1"]) == {"a", "b"}
+        # idle: the proto field stays empty, costing nothing on the wire
+        assert shipper.build() == b""
+        reg.counter("b").inc()
+        delta = decode_telemetry_report(shipper.build())
+        assert delta["full"] is False
+        assert set(delta["nodes"]["client1"]) == {"b"}
+
+    def test_periodic_full_report_heals_lost_deltas(self):
+        reg = MetricRegistry()
+        shipper = TelemetryShipper(registry=reg, node="client1",
+                                   full_every=4)
+        fleet = FleetRegistry()
+        for i in range(9):
+            reg.counter("steps").inc()
+            reg.gauge("last").set(float(i))
+            data = shipper.build()
+            # a lossy network: every other delta report vanishes; full
+            # reports (ships 0, 4, 8) happen to survive here, which is
+            # exactly the healing mechanism under test
+            if i % 2 == 0:
+                fleet.ingest_bytes(data)
+        # the surviving ship at i=8 was a FULL report: receiver state
+        # converged to the sender's registry despite the losses
+        assert fleet.node_snapshots()["client1"] == reg.snapshot()
+
+
+# ---- FleetRegistry -----------------------------------------------------------
+
+class TestFleetRegistry:
+    def test_replayed_report_is_a_no_op(self):
+        reg = MetricRegistry()
+        reg.counter("steps").inc(3)
+        data = encode_telemetry_report(
+            {"client1": reg.snapshot()}, full=False
+        )
+        fleet = FleetRegistry()
+        assert fleet.ingest_bytes(data)
+        once = fleet.merged()
+        # an RPC replay re-delivers the same report: replace semantics
+        # make the second ingest a no-op, never a double count
+        assert fleet.ingest_bytes(data)
+        assert fleet.merged() == once
+        assert once["steps"]["value"] == 3.0
+
+    def test_corrupt_bytes_counted_never_raised(self):
+        m = MetricsLogger(validate=True)
+        fleet = FleetRegistry(metrics=m)
+        assert fleet.ingest_bytes(b"\x99not-a-report") is False
+        assert fleet.ingest_bytes(b"") is False  # empty field: not an error
+        assert m.registry.counter("fleet_reports_invalid").value == 1
+        assert fleet.node_snapshots() == {}
+
+    def test_node_cardinality_guard_is_loud_once_per_node(self):
+        m = MetricsLogger(validate=True)
+        fleet = FleetRegistry(metrics=m, max_nodes=2)
+        snap = {"c": {"type": "counter", "value": 1.0}}
+        assert fleet.ingest("client1", snap)
+        assert fleet.ingest("client2", snap)
+        assert not fleet.ingest("client3", snap)
+        assert not fleet.ingest("client3", snap)
+        assert len(fleet.node_snapshots()) == 2
+        assert m.registry.counter("fleet_reports_dropped").value == 2
+        # one fleet_overflow event per (node, reason), not per report
+        events = m.events("fleet_overflow")
+        assert len(events) == 1
+        assert events[0]["node"] == "client3"
+        assert events[0]["reason"] == "max_nodes"
+
+    def test_series_cardinality_guard(self):
+        m = MetricsLogger(validate=True)
+        fleet = FleetRegistry(metrics=m, max_series_per_node=2)
+        ok = fleet.ingest("client1", {
+            f"m{i}": {"type": "counter", "value": 1.0} for i in range(5)
+        })
+        assert not ok
+        assert len(fleet.node_snapshots()["client1"]) == 2
+        # the admitted series still update in place under the cap
+        assert fleet.ingest(
+            "client1", {"m0": {"type": "counter", "value": 7.0}}
+        )
+        assert fleet.node_snapshots()["client1"]["m0"]["value"] == 7.0
+        assert m.events("fleet_overflow")[0]["reason"] == \
+            "max_series_per_node"
+
+    def test_summary_stays_bounded_at_1k_nodes(self):
+        fleet = FleetRegistry(max_nodes=2048)
+        reg = MetricRegistry()
+        _observe_series(reg, [0.01, 0.02])
+        snap = reg.snapshot()
+        for i in range(1000):
+            fleet.ingest(f"client{i}", snap)
+        summary = fleet.summary()
+        assert summary["nodes"] == 1000
+        assert summary["series"] == 1000 * len(snap)
+        assert len(summary["top_nodes"]) == 8
+        assert len(summary["histograms"]) <= 8
+        # the /status.fleet payload is O(top_k), not O(fleet)
+        assert len(json.dumps(summary)) < 8192
+        merged = fleet.merged()
+        assert merged["local_step_s"]["count"] == 2000
+
+
+# ---- fleet Prometheus exposition --------------------------------------------
+
+class TestFleetPrometheus:
+    def test_fleet_and_node_families_with_labels(self):
+        ra, rb = MetricRegistry(), MetricRegistry()
+        ra.counter("steps").inc(2)
+        _observe_series(ra, [0.01])
+        rb.counter("steps").inc(3)
+        _observe_series(rb, [0.02])
+        text = render_fleet_prometheus(
+            {"client1": ra.snapshot(), "client2": rb.snapshot()}
+        )
+        # exact cross-node merge in the fleet families
+        assert "gfedntm_fleet_steps_total 5.0" in text
+        assert "gfedntm_fleet_local_step_s_count 2" in text
+        # per-node series carry the node label
+        assert 'gfedntm_node_steps_total{node="client1"} 2.0' in text
+        assert 'gfedntm_node_steps_total{node="client2"} 3.0' in text
+        assert 'gfedntm_node_local_step_s_count{node="client1"} 1' in text
+
+    def test_node_series_cap_exports_overflow_counter(self):
+        nodes = {
+            f"client{i}": {"steps": {"type": "counter", "value": 1.0}}
+            for i in range(6)
+        }
+        text = render_fleet_prometheus(nodes, max_series=4)
+        assert text.count("gfedntm_node_steps_total{") == 4
+        assert ('gfedntm_node_series_overflow_total{family="steps"} 2'
+                in text)
+
+
+# ---- ops endpoints -----------------------------------------------------------
+
+class TestFleetOpsEndpoints:
+    def test_metrics_status_fleet_and_alerts_routes(self):
+        reg = MetricRegistry()
+        reg.counter("rounds").inc()
+        fleet = FleetRegistry()
+        fleet.ingest("client1", {"steps": {"type": "counter",
+                                           "value": 4.0}})
+        ops = OpsServer(
+            registry=reg, fleet=fleet,
+            alerts_fn=lambda: {"alerts": [], "firing": 0},
+        )
+        port = ops.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            code, body = _get(f"{base}/metrics")
+            text = body.decode()
+            assert code == 200
+            assert "gfedntm_rounds_total 1.0" in text
+            assert "gfedntm_fleet_steps_total 4.0" in text
+            assert 'gfedntm_node_steps_total{node="client1"} 4.0' in text
+            code, body = _get(f"{base}/status.fleet")
+            assert code == 200
+            assert json.loads(body)["nodes"] == 1
+            code, body = _get(f"{base}/alerts")
+            assert code == 200
+            assert json.loads(body) == {"alerts": [], "firing": 0}
+        finally:
+            ops.stop()
+
+
+# ---- offline summarize: cross-node correctness ------------------------------
+
+class TestSummarizeCrossNode:
+    def test_same_metric_name_across_nodes_merges_not_clobbers(self):
+        records = []
+        for node, values in (("client1", [0.01, 0.02]),
+                             ("client2", [0.02, 0.03, 0.04])):
+            m = MetricsLogger(node=node)
+            _observe_series(m.registry, values)
+            m.registry.counter("steps").inc(len(values))
+            records.append(m.snapshot_registry())
+        s = summarize_metrics(records)
+        assert s["step_time"]["local_step_s"]["count"] == 5
+        assert s["counters"]["steps"] == 5.0
+
+
+# ---- relay tier: pre-reduced shard report -----------------------------------
+
+class TestRelayShardReport:
+    def test_relay_merged_shard_report_equals_flat_merge(self):
+        # Socketless: the relay's telemetry pipeline is plain objects —
+        # members' piggybacked reports land in the shard FleetRegistry,
+        # and the upstream shipper sends ONE merged relayN:shard entry.
+        relay = RelayNode(relay_id=3, upstream_address="unused:0",
+                          min_members=2)
+        members = {}
+        for cid in (1, 2):
+            m = MetricsLogger(node=f"client{cid}")
+            _observe_series(m.registry, [0.001 * (cid + k)
+                                         for k in range(4)])
+            m.registry.counter("steps").inc(4)
+            members[cid] = m
+            shipper = TelemetryShipper(registry=m.registry,
+                                       node=f"client{cid}")
+            relay.fleet.ingest_bytes(shipper.build())
+
+        root = FleetRegistry()
+        root.ingest_bytes(relay._shipper.build())
+        # root cardinality is O(relays): one shard node, never members
+        assert set(root.node_snapshots()) == {"relay3:shard"}
+        flat = merge_node_snapshots({
+            f"client{cid}": m.registry.snapshot()
+            for cid, m in members.items()
+        })
+        merged = root.merged()
+        assert merged["steps"]["value"] == flat["steps"]["value"] == 8.0
+        assert merged["local_step_s"] == flat["local_step_s"]
+
+
+# ---- live-fleet acceptance e2e ----------------------------------------------
+
+def _run_fleet_and_compare(tmp_path, pacing, n_clients=3, steps=4,
+                           drive_push=False, expect_total=True,
+                           fault_injector=None):
+    """Run a simulated federation with telemetry-shipping clients and
+    assert the server's live fleet-merged ``local_step_s`` equals the
+    offline merge of the clients' own JSONL snapshots bucket-for-bucket
+    (the 'live and post-hoc views can never disagree' contract)."""
+    loggers = {
+        cid: MetricsLogger(
+            path=str(tmp_path / f"client{cid}.jsonl"),
+            node=f"client{cid}", validate=True,
+        )
+        for cid in range(1, n_clients + 1)
+    }
+    server_m = MetricsLogger(validate=True, node="server")
+    server, servicers, template = make_sim_fleet(
+        n_clients, steps=steps, pacing_policy=pacing, max_iters=steps + 2,
+        save_dir=str(tmp_path / "srv"), checkpoint_every=0,
+        journal_every=0, metrics=server_m,
+        client_metrics=lambda cid: loggers[cid],
+        fault_injector=fault_injector,
+    )
+    try:
+        if drive_push:
+            seqs = dict.fromkeys(servicers, 0)
+            while not server.training_done.is_set():
+                for cid, servicer in servicers.items():
+                    if servicer.finished:
+                        continue
+                    seqs[cid] += 1
+                    update = servicer.build_update(template, seq=seqs[cid])
+                    agg = server.PushUpdate(update, None)
+                    servicer.apply(agg)
+                    # a stub-level retry replays the identical request:
+                    # seq dedup must keep the telemetry single-counted
+                    server.PushUpdate(update, None)
+        assert server.wait_done(timeout=120)
+    finally:
+        server.stop()
+
+    fleet_nodes = server.fleet.node_snapshots()
+    for cid in servicers:
+        assert f"client{cid}" in fleet_nodes, (
+            f"client{cid} never reached the fleet view: "
+            f"{sorted(fleet_nodes)}"
+        )
+    live = server.fleet.merged()["local_step_s"]
+
+    # Offline ground truth: each client dumps its cumulative registry to
+    # its own JSONL; summarize-style per-node last-snapshot merge.
+    per_node = {}
+    for cid, m in loggers.items():
+        m.snapshot_registry()
+        m.close()
+        records = read_metrics(str(tmp_path / f"client{cid}.jsonl"))
+        snaps = [r for r in records if r["event"] == "metrics_snapshot"]
+        per_node[f"client{cid}"] = snaps[-1]["metrics"]
+    offline = merge_node_snapshots(per_node)["local_step_s"]
+
+    assert live["edges"] == offline["edges"]
+    assert live["counts"] == offline["counts"], (
+        f"live fleet merge diverged from offline JSONL merge under "
+        f"{pacing}: {live['counts']} != {offline['counts']}"
+    )
+    if expect_total:
+        assert live["count"] == offline["count"] == n_clients * steps
+    else:
+        # cohort rotation polls clients unevenly before max_iters ends
+        # the run — the exactness contract is live == offline, not a
+        # fixed population total
+        assert live["count"] == offline["count"] > 0
+    assert live["sum"] == pytest.approx(offline["sum"])
+    assert (live["min"], live["max"]) == (offline["min"], offline["max"])
+    # the duplicate-push replays were deduplicated, never double-ingested
+    if drive_push:
+        assert server_m.registry.counter("rpcs_deduplicated").value > 0
+
+
+class TestLiveFleetE2E:
+    def test_sync_pacing_live_merge_equals_offline_merge(self, tmp_path):
+        _run_fleet_and_compare(tmp_path, "sync")
+
+    def test_cohort_pacing_live_merge_equals_offline_merge(self, tmp_path):
+        # cohort:2 polls a rotating subset per round, so reports arrive
+        # piecemeal across rounds — the cumulative-snapshot shipping must
+        # still converge to the exact totals by the final round
+        _run_fleet_and_compare(tmp_path, "cohort:2", steps=4,
+                               expect_total=False)
+
+    def test_push_pacing_with_replays_live_merge_equals_offline(
+        self, tmp_path
+    ):
+        _run_fleet_and_compare(tmp_path, "push:2", drive_push=True)
+
+    def test_partition_persona_loses_polls_not_training_or_exactness(
+        self, tmp_path
+    ):
+        """Chaos persona: a client partitioned for a few polls (scripted
+        UNAVAILABLE before the wire) must not perturb the round loop —
+        the run still completes — and the fleet view must stay EXACTLY
+        consistent with the clients' own JSONL: a failed poll never
+        executed the step, so no observation can go missing or double."""
+        from gfedntm_tpu.federation.resilience import FaultInjector
+
+        injector = FaultInjector(seed=0)
+        injector.script("TrainStep", kind="error", times=2,
+                        peer="client2")
+        _run_fleet_and_compare(
+            tmp_path, "sync", steps=4, expect_total=False,
+            fault_injector=injector,
+        )
+        assert injector.fired, "the partition persona never fired"
+
+    def test_status_fleet_section_reports_population(self, tmp_path):
+        loggers = {
+            cid: MetricsLogger(node=f"client{cid}") for cid in (1, 2)
+        }
+        server_m = MetricsLogger(validate=True, node="server")
+        server, servicers, template = make_sim_fleet(
+            2, steps=3, pacing_policy="sync", max_iters=5,
+            save_dir=str(tmp_path), checkpoint_every=0, journal_every=0,
+            metrics=server_m, client_metrics=lambda cid: loggers[cid],
+        )
+        try:
+            assert server.wait_done(timeout=120)
+        finally:
+            server.stop()
+        status = server._status()
+        fleet = status["fleet"]
+        # server's own registry plus both clients
+        assert fleet["nodes"] == 3
+        assert fleet["reports_invalid"] == 0.0
+        assert fleet["reports_dropped"] == 0.0
+        assert fleet["alerts_firing"] is None  # no SLO specs configured

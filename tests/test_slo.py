@@ -1,0 +1,404 @@
+"""SLO engine + alerting tests (ISSUE 16): spec validation, the
+pending→firing→resolved state machine (dwell, silent pending clears,
+no-data hold), windowed/rate measurement over cumulative snapshots, the
+offline stream evaluator the ``slo`` CLI gate runs, alert sections in
+summarize/report, the live ``/alerts`` endpoint, and the acceptance e2e:
+a real load-shed storm on the serving Batcher drives a shed-rate SLO
+through the full alert lifecycle while a no-storm twin stays green.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import main as cli_main
+from gfedntm_tpu.utils.observability import (
+    MetricsLogger,
+    OpsServer,
+    format_report,
+    summarize_metrics,
+)
+from gfedntm_tpu.utils.slo import (
+    SLOEngine,
+    SLOSpec,
+    evaluate_stream,
+    load_slo_specs,
+)
+
+
+def _spec(**over):
+    base = dict(name="errs", metric="serving_errors", agg="value",
+                op="<=", threshold=0.0)
+    base.update(over)
+    return base
+
+
+# ---- spec validation ---------------------------------------------------------
+
+class TestSLOSpec:
+    def test_valid_spec_and_objective_text(self):
+        spec = SLOSpec.from_dict(_spec(
+            name="p99", metric="serve_latency_s", agg="p99", op="<=",
+            threshold=0.25, window_s=60, for_s=10,
+        ))
+        assert spec.objective() == "p99(serve_latency_s) over 60s <= 0.25"
+
+    @pytest.mark.parametrize("bad", [
+        _spec(agg="p42"),
+        _spec(op="=="),
+        _spec(agg="rate"),  # rate needs window_s > 0
+        _spec(name=""),
+        _spec(typo=1),  # unknown key
+        {"name": "x", "metric": "m"},  # missing op/threshold
+    ])
+    def test_invalid_specs_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            SLOSpec.from_dict(bad)
+
+    def test_duplicate_names_rejected_at_engine_build(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine([_spec(), _spec()], snapshot_fn=dict)
+
+    def test_load_specs_inline_file_and_wrapper(self, tmp_path):
+        inline = json.dumps([_spec()])
+        assert load_slo_specs(inline)[0].name == "errs"
+        wrapped = json.dumps({"slos": [_spec(name="a"), _spec(name="b")]})
+        assert [s.name for s in load_slo_specs(wrapped)] == ["a", "b"]
+        path = tmp_path / "slo.json"
+        path.write_text(inline)
+        assert load_slo_specs(str(path))[0].metric == "serving_errors"
+        with pytest.raises(ValueError):
+            load_slo_specs("not json at all")
+        with pytest.raises(ValueError):
+            load_slo_specs(json.dumps({"no_slos_key": True}) + "x")
+
+
+# ---- state machine -----------------------------------------------------------
+
+class TestAlertStateMachine:
+    def test_full_lifecycle_with_dwell_and_events(self):
+        m = MetricsLogger(validate=True, node="server")
+        snap = {"serving_errors": {"type": "counter", "value": 0.0}}
+        engine = SLOEngine(
+            [_spec(for_s=5.0)], snapshot_fn=lambda: snap, metrics=m,
+        )
+        assert engine.evaluate(now=100.0) == []
+        # violation enters pending, does NOT fire inside the dwell
+        snap["serving_errors"]["value"] = 3.0
+        trs = engine.evaluate(now=101.0)
+        assert trs == [{"alert": "errs", "from": "ok", "to": "pending"}]
+        assert engine.evaluate(now=103.0) == []  # still pending
+        assert engine.ever_fired() == []
+        # dwell elapsed → firing
+        trs = engine.evaluate(now=106.5)
+        assert trs == [{"alert": "errs", "from": "pending",
+                        "to": "firing"}]
+        assert engine.status()["firing"] == 1
+        assert m.registry.gauge("slo_alerts_firing").value == 1.0
+        # objective met again → resolved
+        snap["serving_errors"]["value"] = 0.0
+        trs = engine.evaluate(now=110.0)
+        assert trs == [{"alert": "errs", "from": "firing",
+                        "to": "resolved"}]
+        assert engine.ever_fired() == ["errs"]
+        # the JSONL trail carries the whole lifecycle
+        assert len(m.events("alert_pending")) == 1
+        firing = m.events("alert_firing")
+        assert len(firing) == 1
+        assert firing[0]["pending_s"] == pytest.approx(5.5)
+        assert firing[0]["objective"] == "value(serving_errors) <= 0"
+        assert len(m.events("alert_resolved")) == 1
+
+    def test_short_violation_clears_pending_silently(self):
+        m = MetricsLogger(validate=True, node="server")
+        snap = {"serving_errors": {"type": "counter", "value": 0.0}}
+        engine = SLOEngine(
+            [_spec(for_s=10.0)], snapshot_fn=lambda: snap, metrics=m,
+        )
+        engine.evaluate(now=0.0)
+        snap["serving_errors"]["value"] = 1.0
+        engine.evaluate(now=1.0)
+        snap["serving_errors"]["value"] = 0.0
+        trs = engine.evaluate(now=2.0)
+        assert trs == [{"alert": "errs", "from": "pending", "to": "ok"}]
+        # pending is not an alert yet: no resolved event, nothing fired
+        assert m.events("alert_resolved") == []
+        assert engine.ever_fired() == []
+
+    def test_no_data_holds_state_never_resolves(self):
+        snap = {}
+        engine = SLOEngine(
+            [_spec(for_s=0.0)], snapshot_fn=lambda: dict(snap),
+        )
+        snap["serving_errors"] = {"type": "counter", "value": 2.0}
+        engine.evaluate(now=0.0)
+        assert engine.status()["alerts"][0]["state"] == "firing"
+        # the metric disappears (crashed reporter): firing must HOLD —
+        # silence is not success
+        del snap["serving_errors"]
+        assert engine.evaluate(now=10.0) == []
+        assert engine.status()["alerts"][0]["state"] == "firing"
+
+    def test_gauge_and_histogram_percentile_objectives(self):
+        m = MetricsLogger(validate=True)
+        h = m.registry.histogram("serve_latency_s")
+        for v in [0.01] * 95 + [2.0] * 5:
+            h.observe(v)
+        m.registry.gauge("serving_queue_depth").set(3.0)
+        engine = SLOEngine(
+            [
+                {"name": "p99", "metric": "serve_latency_s",
+                 "agg": "p99", "op": "<=", "threshold": 0.25},
+                {"name": "p50", "metric": "serve_latency_s",
+                 "agg": "p50", "op": "<=", "threshold": 0.25},
+                {"name": "queue", "metric": "serving_queue_depth",
+                 "agg": "value", "op": "<", "threshold": 8},
+            ],
+            snapshot_fn=m.registry.snapshot,
+        )
+        engine.evaluate(now=0.0)
+        states = {a["alert"]: a["state"]
+                  for a in engine.status()["alerts"]}
+        # the tail breaches, the median and the gauge hold
+        assert states == {"p99": "firing", "p50": "ok", "queue": "ok"}
+
+    def test_windowed_rate_fires_during_burn_and_resolves_after(self):
+        m = MetricsLogger(validate=True)
+        c = m.registry.counter("serving_requests_shed")
+        engine = SLOEngine(
+            [{"name": "shed-rate", "metric": "serving_requests_shed",
+              "agg": "rate", "op": "<=", "threshold": 0.5,
+              "window_s": 5.0}],
+            snapshot_fn=m.registry.snapshot,
+        )
+        engine.evaluate(now=0.0)  # baseline
+        c.inc(100)  # burn: 100 sheds in 2 s
+        engine.evaluate(now=2.0)
+        assert engine.status()["alerts"][0]["state"] == "firing"
+        assert engine.status()["alerts"][0]["value"] == pytest.approx(50.0)
+        # storm over: the counter is monotone, but the RATE over the
+        # trailing window decays back under threshold → resolved
+        engine.evaluate(now=8.0)
+        engine.evaluate(now=14.0)
+        assert engine.status()["alerts"][0]["state"] == "resolved"
+
+
+# ---- offline stream evaluator (the `slo` CLI engine) ------------------------
+
+class TestEvaluateStream:
+    def _records(self, node, values, t0=1000.0):
+        return [
+            {"event": "metrics_snapshot", "time": t0 + i, "node": node,
+             "metrics": {"steps": {"type": "counter",
+                                   "value": float(v)}}}
+            for i, v in enumerate(values)
+        ]
+
+    def test_violation_only_visible_in_fleet_merge(self):
+        # each node stays under the threshold alone; only the exact
+        # cross-node merge crosses it — the fleet view is load-bearing
+        specs = [{"name": "total", "metric": "steps", "agg": "value",
+                  "op": "<=", "threshold": 5.0}]
+        nodes = {
+            "client1": self._records("client1", [1, 2, 3]),
+            "client2": self._records("client2", [1, 2, 3]),
+        }
+        engine = evaluate_stream(nodes, specs)
+        assert engine.ever_fired() == ["total"]
+        clean = evaluate_stream(
+            {"client1": nodes["client1"]}, specs
+        )
+        assert clean.ever_fired() == []
+
+    def test_non_snapshot_events_and_bad_times_ignored(self):
+        records = self._records("server", [0, 0]) + [
+            {"event": "round_started", "time": 1.0},
+            {"event": "metrics_snapshot", "time": "garbage",
+             "metrics": {}},
+        ]
+        engine = evaluate_stream({"server": records},
+                                 [_spec(metric="steps", op="<=",
+                                        threshold=10.0)])
+        assert engine.ever_fired() == []
+
+
+# ---- CLI gate ----------------------------------------------------------------
+
+class TestSloCli:
+    def _write_stream(self, path, values):
+        with open(path, "w") as fh:
+            for i, v in enumerate(values):
+                fh.write(json.dumps({
+                    "event": "metrics_snapshot", "time": 1000.0 + i,
+                    "node": "server",
+                    "metrics": {"serving_errors": {"type": "counter",
+                                                   "value": float(v)}},
+                }) + "\n")
+
+    def test_exit_codes_and_json_out(self, tmp_path, capsys):
+        spec_path = tmp_path / "slo.json"
+        spec_path.write_text(json.dumps([_spec()]))
+        good = tmp_path / "good.jsonl"
+        bad = tmp_path / "bad.jsonl"
+        self._write_stream(good, [0, 0, 0])
+        self._write_stream(bad, [0, 4, 9])
+        assert cli_main(["slo", "--slo", str(spec_path),
+                         str(good)]) in (0, None)
+        assert "SLO check passed" in capsys.readouterr().out
+        out_json = tmp_path / "alerts.json"
+        rc = cli_main(["slo", "--slo", str(spec_path), "--json",
+                       str(out_json), str(bad)])
+        assert rc == 1
+        assert "FIRED" in capsys.readouterr().out
+        status = json.loads(out_json.read_text())
+        assert status["alerts"][0]["ever_fired"] is True
+
+    def test_bad_and_empty_specs_are_usage_errors(self, tmp_path):
+        stream = tmp_path / "m.jsonl"
+        self._write_stream(stream, [0])
+        with pytest.raises(SystemExit):
+            cli_main(["slo", "--slo", "[{broken", str(stream)])
+        with pytest.raises(SystemExit):
+            cli_main(["slo", "--slo", "[]", str(stream)])
+
+
+# ---- report rendering --------------------------------------------------------
+
+class TestAlertReporting:
+    def test_summarize_and_report_carry_alert_sections(self):
+        m = MetricsLogger(validate=True, node="server")
+        snap = {"serving_errors": {"type": "counter", "value": 0.0}}
+        engine = SLOEngine([_spec(for_s=0.0)],
+                           snapshot_fn=lambda: snap, metrics=m)
+        engine.evaluate(now=0.0)
+        snap["serving_errors"]["value"] = 2.0
+        engine.evaluate(now=1.0)
+        snap["serving_errors"]["value"] = 0.0
+        engine.evaluate(now=2.0)
+        s = summarize_metrics(m.records)
+        assert s["alerts"]["errs"]["firing"] == 1
+        assert s["alerts"]["errs"]["last_state"] == "resolved"
+        text = format_report(s)
+        assert "errs" in text and "resolved" in text
+
+    def test_clean_run_report_has_no_alert_noise(self):
+        m = MetricsLogger(validate=True, node="server")
+        snap = {"serving_errors": {"type": "counter", "value": 0.0}}
+        engine = SLOEngine([_spec()], snapshot_fn=lambda: snap,
+                           metrics=m)
+        engine.evaluate(now=0.0)
+        s = summarize_metrics(m.records)
+        assert s["alerts"] == {}
+
+
+# ---- serving-plane acceptance e2e -------------------------------------------
+
+class _SlowEngine:
+    """Stub inference engine with a fixed service time (the
+    test_serving.py load-shed pattern)."""
+
+    max_batch = 16
+    vocab = None
+
+    def __init__(self, service_s=0.02):
+        self.service_s = service_s
+
+    def infer(self, x):
+        import time as _time
+
+        _time.sleep(self.service_s)
+        return np.zeros((x.shape[0], 3), np.float32), 5
+
+
+class TestServingAlertLifecycleE2E:
+    def test_shed_storm_drives_alert_lifecycle_no_storm_twin_green(self):
+        from gfedntm_tpu.serving import Batcher, QueueFullError
+
+        m = MetricsLogger(validate=True, node="serve")
+        # rate objectives need a window baseline: register the counter
+        # up front so the pre-storm evaluation records shed=0 (a metric
+        # born mid-window has no baseline and stays "no data")
+        m.registry.counter("serving_requests_shed")
+        engine = SLOEngine(
+            [{"name": "shed-rate", "metric": "serving_requests_shed",
+              "agg": "rate", "op": "<=", "threshold": 0.0,
+              "window_s": 30.0, "for_s": 0.0}],
+            snapshot_fn=m.registry.snapshot, metrics=m,
+        )
+        ops = OpsServer(registry=m.registry, alerts_fn=engine.status)
+        port = ops.start()
+        b = Batcher(_SlowEngine(), linger_s=0.0, metrics=m, max_queue=4)
+        b.start()
+        try:
+            engine.evaluate()  # pre-storm baseline: green
+            assert engine.status()["firing"] == 0
+
+            sheds = 0
+            lock = threading.Lock()
+
+            def worker():
+                nonlocal sheds
+                for _ in range(10):
+                    try:
+                        fut = b.submit(np.ones((2, 10), np.float32))
+                    except QueueFullError:
+                        with lock:
+                            sheds += 1
+                        continue
+                    fut.result(timeout=30)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert sheds > 0, "overload never shed — no storm to alert on"
+
+            # induced degradation → pending → firing, live at /alerts
+            engine.evaluate()
+            url = f"http://127.0.0.1:{port}/alerts"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                live = json.loads(resp.read())
+            assert live["firing"] == 1
+            assert live["alerts"][0]["alert"] == "shed-rate"
+            assert live["alerts"][0]["state"] == "firing"
+
+            # storm over: the windowed rate decays → resolved (the
+            # window baseline must age past the storm, so evaluate with
+            # explicit post-window timestamps)
+            import time as _time
+
+            now = _time.time()
+            engine.evaluate(now=now + 31.0)
+            engine.evaluate(now=now + 62.0)
+            assert engine.status()["alerts"][0]["state"] == "resolved"
+            assert engine.ever_fired() == ["shed-rate"]
+            assert len(m.events("alert_firing")) == 1
+            assert len(m.events("alert_resolved")) == 1
+        finally:
+            b.stop()
+            ops.stop()
+
+        # the no-fault twin: same objective, no storm → never fires
+        twin_m = MetricsLogger(validate=True, node="serve")
+        twin = SLOEngine(
+            [{"name": "shed-rate", "metric": "serving_requests_shed",
+              "agg": "rate", "op": "<=", "threshold": 0.0,
+              "window_s": 30.0}],
+            snapshot_fn=twin_m.registry.snapshot, metrics=twin_m,
+        )
+        tb = Batcher(_SlowEngine(0.0), linger_s=0.0, metrics=twin_m,
+                     max_queue=64)
+        tb.start()
+        try:
+            for _ in range(5):
+                tb.submit(np.ones((1, 10), np.float32)).result(timeout=30)
+                twin.evaluate()
+        finally:
+            tb.stop()
+        assert twin.ever_fired() == []
+        assert twin_m.events("alert_pending") == []
