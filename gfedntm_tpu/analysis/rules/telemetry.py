@@ -9,11 +9,11 @@ PRs 1/5/7 caught by hand:
   passes silently in un-validated production loggers and explodes the
   first time a test constructs ``MetricsLogger(validate=True)``;
 - reverse-lint: every DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS +
-  SCALEOUT_EVENTS + SERVING_EVENTS + SCENARIO_EVENTS + FLEET_EVENTS
-  entry keeps BOTH a schema registration and at least one emission site
-  — a refactor that disconnects the admission-gate/guardian/quality/
-  scale-plane/serving/scenario/fleet-alerting telemetry must not pass
-  silently;
+  SCALEOUT_EVENTS + SERVING_EVENTS + SCENARIO_EVENTS + FLEET_EVENTS +
+  SURVIVAL_EVENTS entry keeps BOTH a schema registration and at least
+  one emission site — a refactor that disconnects the admission-gate/
+  guardian/quality/scale-plane/serving/scenario/fleet-alerting/
+  crash-recovery telemetry must not pass silently;
 - every ``observability.TRACE_PLANE_SPANS`` name keeps a ``span(...)``
   call site — the ``trace`` CLI merges and parents by these names;
 - scanner self-checks: zero ``.log(``/``span(`` sites at all means the
@@ -89,6 +89,7 @@ class TelemetryContractRule(Rule):
             SCALEOUT_EVENTS,
             SCENARIO_EVENTS,
             SERVING_EVENTS,
+            SURVIVAL_EVENTS,
             TRACE_PLANE_SPANS,
         )
 
@@ -101,6 +102,7 @@ class TelemetryContractRule(Rule):
                 "SERVING_EVENTS": tuple(SERVING_EVENTS),
                 "SCENARIO_EVENTS": tuple(SCENARIO_EVENTS),
                 "FLEET_EVENTS": tuple(FLEET_EVENTS),
+                "SURVIVAL_EVENTS": tuple(SURVIVAL_EVENTS),
             },
             "spans": tuple(TRACE_PLANE_SPANS),
             "schema_module": SCHEMA_MODULE,

@@ -94,6 +94,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", type=str, default=None,
                    help="reference-format INI (config/dft_params.cf)")
     p.add_argument("--server_address", type=str, default="localhost:50051")
+    p.add_argument("--server_addrs", type=str, default=None,
+                   help="client mode: ordered comma-list of upstream "
+                        "endpoints (first = primary); when the reconnect "
+                        "window against the current endpoint expires the "
+                        "client re-homes to the next one (a sibling relay "
+                        "or the root) presenting the same session token — "
+                        "overrides --server_address")
     p.add_argument("--listen_port", type=int, default=None,
                    help="serving port (default: 50051 for the server, "
                         "50051+id for clients — the reference scheme — "
@@ -129,6 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="server mode: minimum fraction of unfinished "
                         "clients that must answer for a round's average "
                         "to count")
+    p.add_argument("--relay_grace_rounds", type=int, default=0,
+                   help="server mode, hierarchical fleets: a shard "
+                        "(relay) that has missed this many consecutive "
+                        "rounds is excluded from the quorum denominator "
+                        "and HT population reweighting until it answers "
+                        "again — graceful degradation instead of a stall "
+                        "(0 = off, the flat-fleet semantics)")
     p.add_argument("--liveness_timeout", type=float, default=300.0,
                    help="client mode: treat the server as gone if no "
                         "activity arrives within this many seconds "
@@ -523,6 +537,7 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         staleness_alpha=getattr(args, "staleness_alpha", 0.5),
         pacing_seed=getattr(args, "pacing_seed", 0),
         journal_every=getattr(args, "journal_every", 1),
+        relay_grace_rounds=getattr(args, "relay_grace_rounds", 0),
         fault_injector=fault_injector,
         ops_port=getattr(args, "ops_port", None),
         slo_specs=_slo_specs_from_args(args),
@@ -604,10 +619,20 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         RoundProfiler(args.profile_dir, args.profile_rounds, metrics=metrics)
         if getattr(args, "profile_dir", None) else None
     )
+    # --server_addrs: ordered failover endpoints; the head is the
+    # primary, the tail is tried in order once the reconnect window
+    # against the current endpoint expires (member re-homing).
+    addrs = [
+        a.strip()
+        for a in (getattr(args, "server_addrs", None) or "").split(",")
+        if a.strip()
+    ]
+    primary = addrs[0] if addrs else args.server_address
     client = Client(
         client_id=args.id,
         corpus=corpus,
-        server_address=args.server_address,
+        server_address=primary,
+        failover_addrs=addrs[1:],
         listen_address=f"[::]:{port}",
         max_features=cfg.data.max_features,
         stop_words=cfg.data.stop_words,
@@ -659,7 +684,30 @@ def run_relay(args: argparse.Namespace, cfg: GfedConfig) -> int:
         max_update_norm=getattr(args, "max_update_norm", None),
         probation_rounds=getattr(args, "probation_rounds", 3),
         wire_codec=getattr(args, "wire_codec", None) or "auto",
+        save_dir=save_dir,
+        journal_every=getattr(args, "journal_every", 1),
+        liveness_timeout=getattr(args, "liveness_timeout", 300.0),
+        reconnect_window=getattr(args, "reconnect_window", 180.0),
     )
+    if not getattr(args, "no_autorecover", False):
+        # Zero-flag shard recovery: a respawned relay with identical
+        # argv restores its registry/round/session from the shard
+        # journal before serving, so member token-reconnects and the
+        # upstream session re-present just work.
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        try:
+            round_idx = relay.maybe_autorecover()
+        except CheckpointIntegrityError as err:
+            raise SystemExit(
+                f"relay auto-recovery found corrupt state: {err} (start "
+                "with --no_autorecover to ignore it and begin fresh)"
+            )
+        if round_idx is not None:
+            logging.info(
+                "auto-recovered relay %d shard from round %d",
+                args.id, round_idx,
+            )
     relay.start()
     logging.info("relay %d waiting for its shard + upstream", args.id)
     relay.wait_done()

@@ -381,6 +381,7 @@ class Client:
         profiler=None,
         reconnect_window: float = 180.0,
         mesh_devices: int = 0,
+        failover_addrs: "tuple[str, ...] | list[str]" = (),
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -435,6 +436,18 @@ class Client:
         # seconds — instead of self-finalizing. 0 restores the legacy
         # watchdog-finalize behaviour.
         self.reconnect_window = float(reconnect_window)
+        # Re-homing (README "Hierarchical federation"): ordered fallback
+        # endpoints (--server_addrs tail) tried IN ORDER after the
+        # reconnect window against the current endpoint expires — a member
+        # whose relay never returns fails over to a sibling relay or the
+        # root, presenting the same session token. Consumed left-to-right;
+        # empty = the historical single-endpoint behaviour.
+        self.failover_addrs: list[str] = list(failover_addrs or ())
+        # Why the last _reconnect_loop gave up ("exhausted" | "finished" |
+        # "refused" | "stopped" | "ok") — only an exhausted window against
+        # a dead endpoint justifies re-homing; a finished/refused verdict
+        # is authoritative and must not be shopped to another tier.
+        self._last_reconnect_outcome = "ok"
         self.session_token = ""
         self._advertised_address = ""
         # Retries transient failures of the client->server control RPCs
@@ -590,7 +603,7 @@ class Client:
             if idle is None:
                 continue
             if self._reconnect_available():
-                if self._reconnect_loop(idle):
+                if self._reconnect_or_rehome(idle):
                     continue  # reconnected (or stop arrived meanwhile)
             if self._watchdog_finalize():
                 break
@@ -628,7 +641,7 @@ class Client:
                 # an exhausted window self-finalizes.
                 if not (
                     self._reconnect_available()
-                    and self._reconnect_loop(0.0)
+                    and self._reconnect_or_rehome(0.0)
                 ):
                     self._on_stop()
                     return
@@ -714,6 +727,7 @@ class Client:
                     "%d attempts; self-finalizing",
                     self.client_id, self.reconnect_window, attempts,
                 )
+                self._last_reconnect_outcome = "exhausted"
                 return False
             attempts += 1
             try:
@@ -748,12 +762,14 @@ class Client:
                     "client %d: federation finished while disconnected; "
                     "finalizing", self.client_id,
                 )
+                self._last_reconnect_outcome = "finished"
                 return False
             if ack.code == 2:
                 self.logger.error(
                     "client %d: reconnect rejected (%s); finalizing",
                     self.client_id, ack.detail,
                 )
+                self._last_reconnect_outcome = "refused"
                 return False
             if ack.code == 3:
                 # A recovered server process holds none of the wire-codec
@@ -786,8 +802,69 @@ class Client:
                     "client_reconnected", client=self.client_id,
                     attempts=attempts, downtime_s=downtime,
                 )
+            self._last_reconnect_outcome = "ok"
             return True
+        self._last_reconnect_outcome = "stopped"
         return True  # stop arrived mid-reconnect: nothing left to do
+
+    def _rehome(self, address: str) -> None:
+        """Point the control stub at a new upstream endpoint and drop
+        this client's wire-codec sessions: no broadcast reference or
+        uplink view survives a tier change, so the next exchanged
+        bundles are self-contained on this end (the adoptive server's
+        Ack 3 / fresh-join handling covers its end)."""
+        old = self._fed_channel
+        channel = rpc.make_channel(address)
+        self._fed_channel = channel
+        self._federation_stub = rpc.ServiceStub(
+            channel, "gfedntm.Federation",
+            metrics=self.metrics, peer="server",
+            retry_policy=self.retry_policy,
+        )
+        self.server_address = address
+        try:
+            old.close()
+        except Exception as exc:  # noqa: BLE001 — old channel already dead
+            self.logger.info(
+                "client %d: closing the dead channel failed (%s)",
+                self.client_id, exc,
+            )
+        lock = (
+            self._servicer._lock if self._servicer is not None
+            else threading.RLock()
+        )
+        with lock:
+            if self._uplink is not None:
+                self._uplink.reset()
+            if self._downlink is not None:
+                self._downlink.reset()
+
+    def _reconnect_or_rehome(self, idle: float) -> bool:
+        """The full survivability ladder: reconnect to the current
+        endpoint; when that window exhausts against a DEAD endpoint (not
+        a finished/refusing one), fail over to the next ``--server_addrs``
+        entry — a sibling relay or the root — presenting the same session
+        token. The adoptive tier classifies the unknown-but-valid token
+        as a fresh join and announces it loudly (``member_rehomed``)."""
+        if self._reconnect_loop(idle):
+            return True
+        while (
+            self.failover_addrs
+            and self._last_reconnect_outcome == "exhausted"
+            and not self.stopped.is_set()
+        ):
+            target = self.failover_addrs.pop(0)
+            self.logger.warning(
+                "client %d: re-homing to %s (session %s…, %d fallback "
+                "endpoint(s) left)", self.client_id, target,
+                self.session_token[:8], len(self.failover_addrs),
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("client_rehomes").inc()
+            self._rehome(target)
+            if self._reconnect_loop(0.0):
+                return True
+        return False
 
     def _watchdog_finalize(self) -> bool:
         """Self-finalize under the servicer's lock, re-checking liveness
@@ -825,6 +902,7 @@ class Client:
     def join_federation(self) -> None:
         """Phases 1-2 of the client lifecycle (``client.py:378-507``)."""
         channel = rpc.make_channel(self.server_address)
+        self._fed_channel = channel
         self._federation_stub = rpc.ServiceStub(
             channel, "gfedntm.Federation",
             metrics=self.metrics, peer="server",

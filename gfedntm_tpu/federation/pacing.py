@@ -301,6 +301,10 @@ class RoundEngine:
         # population-weight estimate) — loop-thread only, but /status
         # summarizes it, so writes stay under the same lock.
         self._round_weight: dict[int, float] = {}  # guarded-by: _lock
+        # Shards already warned about as past the relay grace window
+        # (loop-thread only) — the degradation is loud once per outage,
+        # not once per round.
+        self._grace_noted: set[int] = set()
 
     # ---- sizing ------------------------------------------------------------
     def pool_workers(self, poll_workers: int) -> int:
@@ -509,6 +513,18 @@ class RoundEngine:
         s = self.server
         while not s._stopping.is_set():
             pending = s.federation.pending_suspects(iteration)
+            grace = getattr(s, "relay_grace_rounds", 0)
+            if grace > 0 and pending:
+                # Shard supervision: a relay silent past the grace
+                # window is not worth a wall-clock wait — the round
+                # loop must degrade to live shards, never hang on a
+                # dead one (it is still re-polled if its backed-off
+                # retry round arrives while others keep the run alive).
+                gone = {
+                    rec.client_id
+                    for rec in s.federation.grace_expired(iteration, grace)
+                }
+                pending = [x for x in pending if x.client_id not in gone]
             if not pending and not s._awaiting_reconnect_grace():
                 return []
             if pending:
@@ -569,15 +585,49 @@ class SyncEngine(RoundEngine):
         bit-identical."""
         return None
 
-    def quorum_denominator(self, cohort: list) -> int:
+    def quorum_denominator(self, cohort: list, iteration: int = 0) -> int:
         """Sync denominates over the round's full unfinished membership —
         INCLUDING suspects still inside their backoff window (any drop
         from this round's poll is already finished, so it no longer
         counts). Denominating over only the polled set would make the
         quorum vacuous exactly when it matters: with every peer in
         backoff, a lone straggler would be 1/1 and its solo reply would
-        become the average."""
-        return len(self.server.federation.active_clients())
+        become the average.
+
+        Shard supervision (README "Crash recovery & sessions"): when the
+        server's members are relays (``relay_grace_rounds > 0``), a
+        shard silent past the grace window leaves the denominator — the
+        root keeps aggregating over *live* shards instead of skipping
+        every round until the dead relay's probation budget runs out —
+        and its last-known weight leaves the HT population estimate so
+        cohort reweighting no longer scales toward a shard that cannot
+        answer."""
+        s = self.server
+        active = s.federation.active_clients()
+        expired = s.federation.grace_expired(
+            iteration, getattr(s, "relay_grace_rounds", 0)
+        )
+        if expired:
+            gone = {rec.client_id for rec in expired}
+            with self._lock:
+                for cid in gone:
+                    self._round_weight.pop(cid, None)
+            for cid in sorted(gone - self._grace_noted):
+                s.logger.warning(
+                    "shard %d silent past the %d-round grace window; "
+                    "quorum now denominates over live shards without it",
+                    cid, s.relay_grace_rounds,
+                )
+            # A shard that answers again (mark_recovered clears its
+            # streak) leaves this memo, so a LATER second expiry is
+            # loud again.
+            self._grace_noted = gone
+            active = [rec for rec in active if rec.client_id not in gone]
+            if s.metrics is not None:
+                s.metrics.registry.gauge("live_shards").set(len(active))
+        elif self._grace_noted:
+            self._grace_noted = set()
+        return len(active)
 
     def combine(self, snapshots, iteration: int):
         s = self.server
@@ -656,7 +706,7 @@ class SyncEngine(RoundEngine):
                         break
                     s._stopping.wait(s.round_backoff_s)
                     continue
-                membership = self.quorum_denominator(cohort)
+                membership = self.quorum_denominator(cohort, iteration)
                 quorum = max(
                     1, math.ceil(s.quorum_fraction * membership)
                 )
@@ -781,7 +831,7 @@ class CohortEngine(SyncEngine):
             )
         return cohort
 
-    def quorum_denominator(self, cohort: list) -> int:
+    def quorum_denominator(self, cohort: list, iteration: int = 0) -> int:
         """The PR 9 quorum bugfix: under cohort pacing the denominator is
         the sampled cohort — against the full membership, a K=8 sample of
         N=100 could never reach a 0.5 quorum and every round would skip."""

@@ -30,6 +30,18 @@ DROPPED = "dropped"
 MAX_RETRY_BACKOFF_ROUNDS = 8
 
 
+def looks_like_session_token(token: str) -> bool:
+    """True iff ``token`` has the shape of a minted session credential
+    (32 lowercase hex chars — ``uuid4().hex``). The adoptive tier of a
+    re-homing member uses this to tell a cross-tier failover (valid-
+    format token it never minted → loud ``member_rehomed`` admit) from a
+    garbled or hand-rolled credential (plain fresh join)."""
+    return (
+        len(token) == 32
+        and all(c in "0123456789abcdef" for c in token)
+    )
+
+
 @dataclass
 class ClientRecord:
     """Per-client federation state (reference ``FederationClient``):
@@ -75,6 +87,13 @@ class ClientRecord:
     # bounded grace window instead of declaring the run finished the
     # moment every already-reconnected member completes.
     awaiting_reconnect: bool = False
+    # Round of the FIRST failure of the current probation streak (None
+    # while healthy). Shard supervision (README "Crash recovery &
+    # sessions"): a root whose members are relays denominates quorum
+    # over *live* shards — a relay silent since more than
+    # ``relay_grace_rounds`` rounds ago leaves the denominator instead
+    # of stalling every round until its probation budget runs out.
+    suspect_since_round: "int | None" = None
 
 
 @dataclass
@@ -203,6 +222,7 @@ class Federation:
             rec.consecutive_failures = 0
             rec.next_retry_round = 0
             rec.suspect_reason = ""
+            rec.suspect_since_round = None
             self._cond.notify_all()
             return rec
 
@@ -247,6 +267,8 @@ class Federation:
                 return None
             rec.consecutive_failures += 1
             rec.suspect_reason = reason
+            if rec.suspect_since_round is None:
+                rec.suspect_since_round = round_idx
             if rec.consecutive_failures >= probation_rounds:
                 rec.status = DROPPED
                 rec.finished = True
@@ -270,6 +292,7 @@ class Federation:
             rec.consecutive_failures = 0
             rec.next_retry_round = 0
             rec.suspect_reason = ""
+            rec.suspect_since_round = None
             return True
 
     def update_progress(
@@ -329,6 +352,27 @@ class Federation:
                 c for c in self.get_clients()
                 if c.ready_for_training and not c.finished
                 and c.status == SUSPECT and c.next_retry_round > round_idx
+            ]
+
+    def grace_expired(
+        self, round_idx: int, grace_rounds: int
+    ) -> list[ClientRecord]:
+        """Suspects whose probation streak started ``grace_rounds`` or
+        more rounds ago — the shards a supervising root stops counting
+        in its quorum denominator (graceful degradation: the federation
+        keeps aggregating over live shards instead of skipping every
+        round until the dead relay's probation budget runs out).
+        ``grace_rounds <= 0`` disables the view (flat-fleet semantics
+        unchanged)."""
+        if grace_rounds <= 0:
+            return []
+        with self._lock:
+            return [
+                c for c in self.get_clients()
+                if c.ready_for_training and not c.finished
+                and c.status == SUSPECT
+                and c.suspect_since_round is not None
+                and round_idx - c.suspect_since_round >= grace_rounds
             ]
 
     def membership_snapshot(self) -> list[dict]:

@@ -38,9 +38,11 @@ both tiers independently (per-tier accounting lands in each process's own
 
 from __future__ import annotations
 
+import base64
 import itertools
 import json
 import logging
+import math
 import threading
 import time
 import uuid
@@ -60,13 +62,19 @@ from gfedntm_tpu.federation.compression import (
     encode_push_for_recipients,
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
-from gfedntm_tpu.federation.registry import DROPPED, SUSPECT, Federation
+from gfedntm_tpu.federation.registry import (
+    DROPPED,
+    SUSPECT,
+    Federation,
+    looks_like_session_token,
+)
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.utils.observability import (
     FleetRegistry,
     TelemetryShipper,
+    encode_telemetry_report,
     span,
 )
 
@@ -98,6 +106,11 @@ class RelayNode:
         retry_policy: RetryPolicy | None = None,
         fault_injector=None,
         wire_codec: str | None = "auto",
+        save_dir: str | None = None,
+        journal_every: int = 1,
+        liveness_timeout: float = 300.0,
+        watchdog_poll_s: float = 2.0,
+        reconnect_window: float = 180.0,
     ):
         assert relay_id > 0, "relay ids are upstream client ids (>= 1)"
         self.relay_id = relay_id
@@ -112,6 +125,30 @@ class RelayNode:
         self.retry_policy = retry_policy or RetryPolicy(metrics=metrics)
         self.fault_injector = fault_injector
         self.wire_codec_spec = wire_codec
+        # Shard crash-recovery plane (README "Crash recovery & sessions"):
+        # the relay journals its shard — member tokens, codec posture,
+        # last applied round, upstream session, the serialized downstream
+        # setup base — every `journal_every` applied rounds, so a
+        # SIGKILLed relay respawned with identical argv restores the
+        # whole tier zero-flag (maybe_autorecover) instead of orphaning
+        # N/relays members. 0 disables journaling and autorecovery.
+        self.save_dir = save_dir
+        self.journal_every = int(journal_every)
+        self._round_journal = None
+        self._journal_disabled = False
+        self._recovered = False
+        self._recovered_at: float | None = None
+        self._resume_ready_needed: int | None = None
+        # Upstream liveness (the client-side RECONNECTING machine, PR 10
+        # applied to the mid tier): the root drives this relay by polling
+        # it — a root gone silent past `liveness_timeout` triggers a
+        # token re-present under RetryPolicy backoff for up to
+        # `reconnect_window` seconds before the relay gives its shard up.
+        self.liveness_timeout = float(liveness_timeout)
+        self.watchdog_poll_s = float(watchdog_poll_s)
+        self.reconnect_window = float(reconnect_window)
+        self._last_upstream = time.monotonic()
+        self._watchdog: threading.Thread | None = None
 
         self.federation = Federation(min_clients=min_members)
         self.update_gate = UpdateGate(
@@ -190,6 +227,12 @@ class RelayNode:
         port = self._grpc_server.add_insecure_port(self.listen_address)
         self._grpc_server.start()
         self._advertised_address = f"{self.advertise_host}:{port}"
+        if self.liveness_timeout > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name=f"relay{self.relay_id}-watchdog", daemon=True,
+            )
+            self._watchdog.start()
         self.logger.info(
             "relay %d serving %d-member shard on %s (upstream %s)",
             self.relay_id, self.federation.min_clients,
@@ -203,6 +246,22 @@ class RelayNode:
     def shutdown(self, grace: float = 0.5) -> None:
         if self._grpc_server is not None:
             self._grpc_server.stop(grace)
+        self._pool.shutdown(wait=False)
+        for _addr, channel, _stub in self._member_stubs.values():
+            channel.close()
+
+    def abort(self) -> None:
+        """Hard-crash simulation (the scenario/chaos SIGKILL-equivalent):
+        tear both protocol faces down NOW — no stop broadcast to the
+        shard, no finalize, no journal finished-stamp — so a respawned
+        relay with identical argv exercises :meth:`maybe_autorecover`
+        exactly as after a real kill."""
+        if self._grpc_server is not None:
+            # Stop serving BEFORE flagging stopped: a member RPC racing
+            # the abort must fail like a dead process, not be answered
+            # "federation already finished".
+            self._grpc_server.stop(0)
+        self.stopped.set()  # parks the watchdog; _finalize was NOT run
         self._pool.shutdown(wait=False)
         for _addr, channel, _stub in self._member_stubs.values():
             channel.close()
@@ -223,8 +282,12 @@ class RelayNode:
         """Block for the shard's vocabulary quorum, run the upstream join
         exactly once (union vocabulary + summed weight offered as this
         relay's own vocab), then mirror the root's consensus downstream
-        with a relay-minted member session token."""
-        self.federation.wait_vocab_quorum()
+        with a relay-minted member session token. A recovered relay
+        already holds the setup base from its journal — a late/fresh
+        joiner must not block on a vocabulary quorum the restored shard
+        will never re-offer."""
+        if not self._setup_ready.is_set():
+            self.federation.wait_vocab_quorum()
         with self._setup_lock:
             if self._setup_base is None:
                 self._setup_base = self._upstream_setup()
@@ -262,6 +325,7 @@ class RelayNode:
                 timeout=self.setup_timeout,
             )
         self.session_token = setup.session_token or ""
+        self._last_upstream = time.monotonic()
         if (setup.pacing_id or "").startswith("push"):
             # The relay is polled by the root (TrainStep fan-out); it
             # does not originate PushUpdate rounds. A push-paced root
@@ -327,6 +391,14 @@ class RelayNode:
             )
 
     def ReadyForTraining(self, request: pb.JoinRequest, context) -> pb.Ack:
+        """Member readiness — the same durable-session classification the
+        root runs (README "Crash recovery & sessions"): a token reconnect
+        against a recovered relay restores the member's shard state and
+        orders an Ack 3 codec reset; an unknown-but-valid-format token is
+        a member of a DEAD tier re-homing here, admitted fresh but loud.
+        The upstream ready is (re-)sent once the shard reaches its bar —
+        ``min_members``, or after recovery the restored-membership
+        quorum, whichever is lower."""
         if self.stopped.is_set():
             return pb.Ack(code=1, detail="federation already finished")
         client_codec = request.codec_id or "none"
@@ -341,27 +413,97 @@ class RelayNode:
                     f"member offered {client_codec!r}"
                 ),
             )
+        kind = self.federation.classify_join(
+            request.client_id, request.session_token
+        )
         self.federation.connect_ready(request.client_id, request.address)
+        if request.telemetry:
+            self.fleet.ingest_bytes(request.telemetry)
+        ack_code, ack_detail = 0, "ready recorded by relay"
+        if kind == "restore":
+            self.logger.info(
+                "relay %d: member %d reconnected with its session token",
+                self.relay_id, request.client_id,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("session_restores").inc()
+                self.metrics.log(
+                    "session_restored", client=request.client_id,
+                )
+            if (
+                self.federation.consume_codec_reset(request.client_id)
+                and self._codec is not None
+                and not self._codec.identity
+            ):
+                ack_code = 3
+                ack_detail = (
+                    "session restored by a recovered relay; reset "
+                    "wire-codec sessions"
+                )
+        elif kind == "new":
+            # A fresh process holds no broadcast reference: the next
+            # downstream push to it must be self-contained.
+            with self._lock:
+                self._member_acked.pop(request.client_id, None)
+            if looks_like_session_token(request.session_token):
+                # A valid-format token this relay never minted — a
+                # member of a dead sibling tier re-homing here.
+                self.logger.warning(
+                    "relay %d: member %d presented an unknown session "
+                    "token — re-homed member of a dead tier; admitting "
+                    "as a fresh join", self.relay_id, request.client_id,
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter("members_rehomed").inc()
+                    self.metrics.log(
+                        "member_rehomed", client=request.client_id,
+                    )
         ready = sum(
             c.ready_for_training for c in self.federation.get_clients()
         )
+        needed = self.federation.min_clients
+        if self._resume_ready_needed is not None:
+            needed = min(needed, self._resume_ready_needed)
         with self._setup_lock:
-            if ready >= self.federation.min_clients and not self._ready_sent:
+            if ready >= needed and not self._ready_sent:
                 self._ready_sent = True
                 ack = self._fed_stub.ReadyForTraining(pb.JoinRequest(
                     client_id=self.relay_id,
                     address=self._advertised_address,
                     codec_id=negotiated,
                     session_token=self.session_token,
+                    recovered=self._recovered,
                 ))
                 self.logger.info(
                     "relay %d: shard complete (%d members) — upstream "
                     "ready ack %d", self.relay_id, ready, ack.code,
                 )
+                self._last_upstream = time.monotonic()
+                if self._recovered_at is not None:
+                    # Time-to-quorum after the relay crash — the metric
+                    # the `recovery_time` SLO example bounds.
+                    elapsed = time.monotonic() - self._recovered_at
+                    self._recovered_at = None
+                    if self.metrics is not None:
+                        self.metrics.registry.gauge(
+                            "recovery_time_s"
+                        ).set(elapsed)
                 if ack.code == 1:
                     self._finalize()
                     return pb.Ack(code=1, detail="federation finished")
-        return pb.Ack(code=0, detail="ready recorded by relay")
+                if ack.code == 3:
+                    # A recovered root restored our session: start the
+                    # upstream hop's codec sessions self-contained.
+                    with self._lock:
+                        if self._uplink_up is not None:
+                            self._uplink_up.reset()
+                        if self._downlink_up is not None:
+                            self._downlink_up.reset()
+                # The shard roster (tokens included) is now worth
+                # surviving: a crash before the first applied round must
+                # still restore the membership.
+                self._journal_shard()
+        return pb.Ack(code=ack_code, detail=ack_detail)
 
     def PushUpdate(self, request: pb.StepReply, context) -> pb.Aggregate:
         """Members of a relay shard are relay-paced (polled), never
@@ -380,6 +522,7 @@ class RelayNode:
         with no admissible member update raises — the root's probation
         machinery treats the relay like any failed client."""
         with self._lock:
+            self._last_upstream = time.monotonic()
             seq = int(request.seq)
             if (
                 seq and self._last_reply is not None
@@ -573,6 +716,7 @@ class RelayNode:
         the relay's own per-recipient downlink encoding, and account
         member progress. Stop broadcasts and session resets fan out."""
         with self._lock:
+            self._last_upstream = time.monotonic()
             if request.stop:
                 self._fanout_stop()
                 self._finalize()
@@ -626,6 +770,8 @@ class RelayNode:
             finished = self._fanout_aggregate(
                 average, round_idx, bool(request.reset_session)
             )
+            if self.journal_every > 0 and round_idx % self.journal_every == 0:
+                self._journal_shard()
             return pb.AggregateReply(
                 client_id=self.relay_id, finished=finished,
             )
@@ -691,6 +837,7 @@ class RelayNode:
         if self._finalized:
             return
         self._finalized = True
+        self._mark_journal_finished()
         self.logger.info(
             "relay %d: federation finished after round %d",
             self.relay_id, self._applied_round,
@@ -698,6 +845,334 @@ class RelayNode:
         if self.metrics is not None:
             self.metrics.snapshot_registry(relay=self.relay_id)
         self.stopped.set()
+
+    # ---- shard crash-recovery journal (README "Crash recovery") ------------
+    def _journal(self):
+        if self._round_journal is None:
+            if self.save_dir is None:
+                raise ValueError("the shard journal requires save_dir")
+            import os
+
+            from gfedntm_tpu.train.checkpoint import RoundJournal
+
+            self._round_journal = RoundJournal(
+                os.path.join(self.save_dir, "checkpoints")
+            )
+        return self._round_journal
+
+    def _membership_state(self) -> "list[dict]":
+        """JSON-able shard membership (member session tokens included) —
+        the same snapshot shape the root journals, so a respawned relay
+        re-admits member token-reconnects."""
+        return [
+            {
+                "client_id": c.client_id,
+                "nr_samples": c.nr_samples,
+                "current_mb": c.current_mb,
+                "current_epoch": c.current_epoch,
+                "finished": bool(c.finished),
+                "status": c.status,
+                "session_token": c.session_token,
+            }
+            for c in self.federation.get_clients()
+        ]
+
+    def _note_journal_write_failure(self, round_idx: int,
+                                    err: Exception) -> None:
+        """A shard-journal write hit the filesystem's failure surface
+        (ENOSPC, EIO): degrade LOUDLY — ``journal_write_failed`` event +
+        counter — and disable journaling for the rest of the run. The
+        shard keeps training; only autorecovery is forfeited."""
+        self._journal_disabled = True
+        self.logger.error(
+            "relay %d: shard journal write at round %d failed (%s); "
+            "journaling disabled for this run — a crash now loses the "
+            "shard", self.relay_id, round_idx, err,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("journal_write_failures").inc()
+            self.metrics.log(
+                "journal_write_failed", round=round_idx, error=str(err),
+            )
+
+    def _journal_shard(self) -> None:
+        """Journal the shard: member roster (tokens included), upstream
+        session, codec id, last applied round + broadcast average, and
+        the serialized downstream setup base — everything
+        ``maybe_autorecover`` needs to restore the tier zero-flag.
+        ``round == -1`` is the valid pre-first-round roster journal."""
+        if (
+            self.journal_every <= 0 or self.save_dir is None
+            or self._journal_disabled or self._setup_base is None
+        ):
+            return
+        try:
+            self._journal().record(
+                self._applied_round,
+                self._current_global(),
+                self._membership_state(),
+                vocab=list(self.global_vocab.tokens),
+                extra={
+                    "relay": self.relay_id,
+                    "upstream_session": self.session_token,
+                    "codec_id": (
+                        self._codec.codec_id if self._codec is not None
+                        else "none"
+                    ),
+                    "setup_base_b64": base64.b64encode(
+                        self._setup_base.SerializeToString()
+                    ).decode("ascii"),
+                },
+            )
+        except OSError as err:
+            self._note_journal_write_failure(self._applied_round, err)
+        except Exception:
+            self.logger.exception(
+                "relay %d: shard journal write at round %d failed",
+                self.relay_id, self._applied_round,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("journal_errors").inc()
+
+    def _mark_journal_finished(self) -> None:
+        """Stamp the journal after a normal stop so the next relay start
+        under this save_dir begins fresh. Still attempted when journaling
+        was disabled by a write failure: only the stamp stops the NEXT
+        start from resurrecting the stale journal, and the disk may have
+        recovered since."""
+        if self.journal_every <= 0 or self.save_dir is None:
+            return
+        try:
+            self._journal().mark_finished()
+        except Exception:
+            self.logger.exception(
+                "relay %d: marking the shard journal finished failed",
+                self.relay_id,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("journal_errors").inc()
+
+    def maybe_autorecover(self) -> "int | None":
+        """Zero-flag relay crash recovery (call before :meth:`start`):
+        when ``save_dir`` holds a shard journal from an interrupted run,
+        restore the whole tier — consensus vocab, codec sessions (fresh),
+        downstream setup base, upstream session token, last applied
+        round/average, member roster with tokens — and return the resume
+        round; ``None`` means fresh start (no journal, or the previous
+        run finished cleanly). The restored members are NOT ready: each
+        must token-reconnect (getting Ack 3 codec resets), and the
+        upstream ready is re-sent with ``recovered=True`` once the
+        restored-membership quorum re-forms. Corrupt state raises —
+        silently discarding a shard an operator counts on is worse than
+        stopping."""
+        from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
+
+        if self.save_dir is None or self.journal_every <= 0:
+            return None
+        try:
+            finished = bool(
+                (self._journal().load_meta() or {}).get("finished")
+            )
+        except CheckpointIntegrityError:
+            finished = False
+        if finished:
+            self.logger.info(
+                "relay %d: previous shard under %s finished cleanly; "
+                "starting fresh", self.relay_id, self.save_dir,
+            )
+            return None
+        jstate = self._journal().load()
+        if jstate is None:
+            return None
+        if int(jstate.get("relay", self.relay_id)) != self.relay_id:
+            raise ValueError(
+                f"shard journal under {self.save_dir} belongs to relay "
+                f"{jstate.get('relay')}, not relay {self.relay_id} — "
+                "refusing to adopt another tier's shard"
+            )
+        self.global_vocab = Vocabulary(tuple(jstate["vocab"]))
+        self._negotiate_codec(jstate.get("codec_id") or "none")
+        base = pb.GlobalSetup.FromString(
+            base64.b64decode(jstate["setup_base_b64"])
+        )
+        hyper = json.loads(base.hyperparams_json)
+        template = build_template_model(
+            hyper["family"], len(self.global_vocab), hyper["kwargs"]
+        )
+        self._template_flat = _shared_flat(
+            template, tuple(hyper["grads_to_share"])
+        )
+        self.update_gate.set_template(self._template_flat)
+        with self._setup_lock:
+            self._setup_base = base
+            self._setup_ready.set()
+        self.session_token = jstate.get("upstream_session") or ""
+        round_idx = int(jstate["round"])
+        self._applied_round = round_idx
+        if round_idx >= 0:
+            # Journaled average comes back float64 from npz round-trip of
+            # the broadcast; re-present the template dtypes downstream.
+            self._current = {
+                k: np.asarray(v).astype(self._template_flat[k].dtype)
+                if k in self._template_flat else np.asarray(v)
+                for k, v in jstate["average"].items()
+            }
+        unfinished = 0
+        codec_live = self._codec is not None and not self._codec.identity
+        for m in jstate.get("membership", []):
+            self.federation.restore_member(
+                int(m["client_id"]),
+                nr_samples=float(m.get("nr_samples", 0.0)),
+                session_token=m.get("session_token", ""),
+                finished=bool(m.get("finished")),
+                current_mb=int(m.get("current_mb", 0)),
+                current_epoch=int(m.get("current_epoch", 0)),
+                needs_codec_reset=codec_live,
+            )
+            if not m.get("finished"):
+                unfinished += 1
+        if unfinished:
+            # Resume quorum: half the restored unfinished members — a
+            # member that died with the relay must not hold the shard
+            # hostage forever (the root's probation covers the gap).
+            self._resume_ready_needed = max(1, math.ceil(0.5 * unfinished))
+        self._recovered = True
+        self._recovered_at = time.monotonic()
+        self.logger.warning(
+            "relay %d: auto-recovered an interrupted shard — resuming at "
+            "round %d with %d restored members (%d unfinished); awaiting "
+            "member token-reconnects", self.relay_id, round_idx,
+            len(jstate.get("membership", [])), unfinished,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("relay_recoveries").inc()
+            self.metrics.log(
+                "relay_recovered", relay=self.relay_id, round=round_idx,
+                members=len(jstate.get("membership", [])),
+            )
+        return round_idx
+
+    # ---- upstream liveness (RECONNECTING, mid-tier) ------------------------
+    def _watchdog_loop(self) -> None:
+        """The root drives this relay by polling it; a root gone silent
+        past ``liveness_timeout`` triggers the upstream reconnect loop.
+        Pre-ready silence is expected (the shard is still forming)."""
+        while not self.stopped.is_set():
+            if self.stopped.wait(self.watchdog_poll_s):
+                return
+            if not self._ready_sent:
+                continue
+            idle = time.monotonic() - self._last_upstream
+            if idle < self.liveness_timeout:
+                continue
+            if self._upstream_reconnect(idle):
+                continue
+            # The upstream is gone for good (window exhausted, finished,
+            # or refused): release the shard so members can re-home.
+            with self._lock:
+                if self.stopped.is_set():
+                    return
+                self.logger.error(
+                    "relay %d: upstream unreachable — stopping the shard "
+                    "so members can fail over", self.relay_id,
+                )
+                self._fanout_stop()
+                self._finalize()
+            return
+
+    def _upstream_reconnect(self, idle: float) -> bool:
+        """RECONNECTING against the root: re-present the relay's session
+        token (a fresh upstream ReadyForTraining carrying a FULL shard
+        telemetry report) under capped decorrelated backoff until the
+        root answers, the window is exhausted, or a stop arrives. Returns
+        True to resume the watchdog wait, False to give the shard up."""
+        start = time.monotonic()
+        self.logger.warning(
+            "relay %d: no upstream activity for %.0f s — RECONNECTING "
+            "(session %s…, up to %.0f s)",
+            self.relay_id, idle, self.session_token[:8],
+            self.reconnect_window,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("reconnects_entered").inc()
+        attempts = 0
+        delays = self.retry_policy.delays()
+        while not self.stopped.is_set():
+            if time.monotonic() - start > self.reconnect_window:
+                self.logger.error(
+                    "relay %d: reconnect window (%.0f s) exhausted after "
+                    "%d attempts", self.relay_id, self.reconnect_window,
+                    attempts,
+                )
+                return False
+            attempts += 1
+            try:
+                ack = self._fed_stub.ReadyForTraining(
+                    pb.JoinRequest(
+                        client_id=self.relay_id,
+                        address=self._advertised_address,
+                        codec_id=(
+                            self._codec.codec_id if self._codec is not None
+                            else "none"
+                        ),
+                        session_token=self.session_token,
+                        recovered=self._recovered,
+                        # FULL report: deltas shipped into the dead
+                        # connection are lost; one RPC resynchronizes the
+                        # root's merged shard view.
+                        telemetry=encode_telemetry_report(
+                            self._telemetry_nodes(), full=True,
+                        ),
+                    ),
+                    timeout=10.0,
+                )
+            except Exception as exc:
+                self.logger.info(
+                    "relay %d: upstream reconnect attempt %d failed (%s)",
+                    self.relay_id, attempts, exc,
+                )
+                self.stopped.wait(min(next(delays), 5.0))
+                continue
+            if ack.code == 1:
+                self.logger.warning(
+                    "relay %d: federation finished while disconnected",
+                    self.relay_id,
+                )
+                return False
+            if ack.code == 2:
+                self.logger.error(
+                    "relay %d: upstream reconnect rejected (%s)",
+                    self.relay_id, ack.detail,
+                )
+                return False
+            if ack.code == 3:
+                # A recovered root holds none of the upstream wire-codec
+                # session state; drop both directions of the relay↔root
+                # hop (the member-hop sessions are untouched — they chain
+                # off this relay, which never lost them).
+                self.logger.warning(
+                    "relay %d: recovered root ordered an upstream "
+                    "wire-codec session reset", self.relay_id,
+                )
+                with self._lock:
+                    if self._uplink_up is not None:
+                        self._uplink_up.reset()
+                    if self._downlink_up is not None:
+                        self._downlink_up.reset()
+            self._last_upstream = time.monotonic()
+            downtime = time.monotonic() - start
+            self.logger.warning(
+                "relay %d: upstream reconnected after %d attempt(s) "
+                "(%.1f s offline)", self.relay_id, attempts, downtime,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter("client_reconnections").inc()
+                self.metrics.log(
+                    "client_reconnected", client=self.relay_id,
+                    attempts=attempts, downtime_s=downtime,
+                )
+            return True
+        return True  # stop arrived mid-reconnect: nothing left to do
 
 
 def _shared_flat(

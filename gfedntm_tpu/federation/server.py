@@ -72,7 +72,11 @@ from gfedntm_tpu.federation.compression import (
 )
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.eval.monitor import COHERENCE_COLLAPSE, ContributionTracker
-from gfedntm_tpu.federation.registry import DROPPED, Federation
+from gfedntm_tpu.federation.registry import (
+    DROPPED,
+    Federation,
+    looks_like_session_token as _looks_like_session_token,
+)
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.models.avitm import AVITM
@@ -165,6 +169,7 @@ class FederatedServer:
         pacing_seed: int = 0,
         journal_every: int = 1,
         reconnect_grace_s: float = 120.0,
+        relay_grace_rounds: int = 0,
         slo_specs=None,
         fleet_max_nodes: int = 512,
         fleet_max_series: int = 512,
@@ -349,8 +354,24 @@ class FederatedServer:
         # disables journaling and auto-recovery).
         self.journal_every = int(journal_every)
         self._round_journal = None
+        # Set by the first journal write that hits the filesystem's
+        # failure surface (ENOSPC/EIO): training continues, journaling
+        # (and therefore crash autorecovery) is off for the rest of the
+        # run — see _note_journal_write_failure.
+        self._journal_disabled = False
         self._recovered_from: int | None = None
         self._recovered_source: str | None = None
+        # Wall-clock timestamp of the autorecovery restore, consumed by
+        # the recovery_time_s gauge the moment the post-recovery quorum
+        # re-forms (the metric the `recovery_time` SLO example bounds).
+        self._recovered_at: float | None = None
+        # Shard supervision (README "Crash recovery & sessions"): when
+        # this server's members are RELAYS (a hierarchy root), a relay
+        # silent for this many rounds leaves the quorum denominator —
+        # quorum is denominated over live shards instead of stalling
+        # until the dead relay's probation budget runs out. 0 keeps the
+        # flat-fleet semantics bitwise.
+        self.relay_grace_rounds = int(relay_grace_rounds)
         # After recovery the original min_clients bar may be unreachable
         # (some members died for good): training restarts once
         # quorum_fraction of the restored unfinished membership is back.
@@ -873,12 +894,34 @@ class FederatedServer:
             )
         return self._round_journal
 
+    def _note_journal_write_failure(self, iteration: int,
+                                    err: Exception) -> None:
+        """A journal write hit the filesystem's failure surface (ENOSPC,
+        EIO, a yanked volume): degrade LOUDLY — ``journal_write_failed``
+        event + counter — and disable journaling for the rest of the run.
+        Training continues; only crash autorecovery is lost, and a stale
+        half-written journal must never masquerade as current state."""
+        self._journal_disabled = True
+        self.logger.error(
+            "round journal write at %d failed (%s); journaling disabled "
+            "for the rest of this run — training continues WITHOUT crash "
+            "autorecovery", iteration, err,
+        )
+        if self.metrics is not None:
+            self.metrics.registry.counter("journal_write_failures").inc()
+            self.metrics.log(
+                "journal_write_failed", round=iteration, error=str(err),
+            )
+
     def _journal_round(self, iteration: int) -> None:
         """Journal one fully-pushed round (called by the engines after the
         push completes). Like checkpointing, a journal failure is loud but
-        never kills training — it only widens the recovery replay."""
+        never kills training — an I/O failure (ENOSPC/EIO) additionally
+        disables journaling for the run, other failures only widen the
+        recovery replay."""
         if (
             self.journal_every <= 0 or self.save_dir is None
+            or self._journal_disabled
             or self.last_average is None
             or iteration % self.journal_every != 0
         ):
@@ -890,6 +933,8 @@ class FederatedServer:
                 extra=self._state_extra(),
                 aggregator_state=self.aggregator.state_dict(),
             )
+        except OSError as err:
+            self._note_journal_write_failure(iteration, err)
         except Exception:
             self.logger.exception(
                 "round journal write at %d failed", iteration
@@ -899,7 +944,10 @@ class FederatedServer:
 
     def _mark_journal_finished(self) -> None:
         """Stamp the journal after a normal shutdown so the next server
-        start's auto-recovery probe does not resurrect a finished run."""
+        start's auto-recovery probe does not resurrect a finished run.
+        Still attempted when journaling was disabled by a write failure:
+        the stamp is what stops the NEXT start from resurrecting the
+        stale journal, and the disk may have recovered since."""
         if self.journal_every <= 0 or self.save_dir is None:
             return
         try:
@@ -1139,6 +1187,9 @@ class FederatedServer:
             "auto-recovered an interrupted federation: resuming from "
             "round %d (re-admitting session-token reconnects)", round_idx,
         )
+        # Recovery clock for the recovery_time_s gauge: stops the moment
+        # the post-recovery quorum re-forms and training restarts.
+        self._recovered_at = time.monotonic()
         if self.metrics is not None:
             self.metrics.registry.counter("server_recoveries").inc()
             self.metrics.log(
@@ -1254,7 +1305,42 @@ class FederatedServer:
                     "session restored by a recovered server; reset "
                     "wire-codec sessions"
                 )
+            if request.recovered:
+                # The PRESENTER crashed and restored itself from its own
+                # journal (a respawned relay): same session, same weight
+                # — but its wire-codec state died with the old process,
+                # so this side's per-recipient push posture must not
+                # delta-encode against references it no longer holds.
+                # Its first poll re-jits too.
+                with self._push_lock:
+                    self._push_acked.pop(request.client_id, None)
+                    self._push_sent.pop(request.client_id, None)
+                    self._reset_owed.pop(request.client_id, None)
+                self._reply_seen.pop(request.client_id, None)
+                self._push_seen.pop(request.client_id, None)
+                self._poll_warmed.discard(request.client_id)
+                self.logger.info(
+                    "client %d reconnected as a journal-recovered "
+                    "process; its wire posture starts self-contained",
+                    request.client_id,
+                )
         elif kind == "new":
+            if _looks_like_session_token(request.session_token):
+                # A valid-format token this federation never minted: a
+                # member of a dead tier re-homing here (README "Crash
+                # recovery & sessions" — cross-tier failover presents
+                # the ORIGINAL tier's token). Admit it as a fresh join,
+                # but LOUDLY: an operator seeing this has lost a relay.
+                self.logger.warning(
+                    "client %d presented an unknown session token — "
+                    "re-homed member of a dead tier; admitting as a "
+                    "fresh join", request.client_id,
+                )
+                if self.metrics is not None:
+                    self.metrics.registry.counter("members_rehomed").inc()
+                    self.metrics.log(
+                        "member_rehomed", client=request.client_id,
+                    )
             # A (re)joining client is a fresh process with no broadcast
             # reference — it must not count as having acked the last
             # push, or the next push could be delta-encoded against
@@ -1293,6 +1379,16 @@ class FederatedServer:
                 )
                 >= needed
             ):
+                if self._recovered_at is not None:
+                    # Time-to-quorum after a crash: the metric the
+                    # shipped `recovery_time` SLO example bounds (README
+                    # "Fleet telemetry & SLOs").
+                    elapsed = time.monotonic() - self._recovered_at
+                    self._recovered_at = None
+                    if self.metrics is not None:
+                        self.metrics.registry.gauge(
+                            "recovery_time_s"
+                        ).set(elapsed)
                 self._train_thread = threading.Thread(
                     target=self._run_training, name="federated-training",
                     daemon=True,

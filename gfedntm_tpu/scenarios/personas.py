@@ -10,11 +10,18 @@ strings so a cell is one line and the CLI/README table stays readable:
   ``vocabskew:<frac>`` — composable, e.g.
   ``dirichlet:0.1+imbalance:20``.
 - fault: ``none`` | ``slow:<delay_s>`` | ``partition:<window_s>`` |
-  ``flap:<times>`` | ``crash:<round>``.
+  ``flap:<times>`` | ``crash:<round>`` | ``relaycrash:<round>`` |
+  ``relayloss:<round>``.
 
-Fault personas (except ``crash``, which the runner drives as a
-process-lifecycle event) lower into the SAME validated fault-spec
-dicts the ``--chaos`` CLI flag takes
+The two ``relay*`` personas imply a HIERARCHICAL topology (root + two
+relays splitting the cell's members): ``relaycrash`` kills one relay
+after the given round and respawns it with identical argv (shard
+journal autorecovery), ``relayloss`` kills it for good (members
+re-home to the root via their ``--server_addrs`` fallback list).
+
+Fault personas (except the process-lifecycle kinds ``crash`` /
+``relaycrash`` / ``relayloss``, which the runner drives) lower into
+the SAME validated fault-spec dicts the ``--chaos`` CLI flag takes
 (:func:`gfedntm_tpu.federation.resilience.validate_fault_spec`), so a
 typo'd persona fails at parse time, never as an inert injector.
 """
@@ -36,6 +43,8 @@ from gfedntm_tpu.data.synthetic import (
 __all__ = [
     "DataPersona",
     "FaultPersona",
+    "LIFECYCLE_KINDS",
+    "RELAY_KINDS",
     "ScenarioCell",
     "build_corpora",
     "fault_specs_for",
@@ -104,10 +113,22 @@ def parse_data_persona(spec: str) -> DataPersona:
 
 # ---- fault personas ---------------------------------------------------------
 
-#: Fault-persona kinds the engine understands. ``crash`` is driven by
-#: the runner (server abort + zero-flag autorecovery, the PR 10
-#: SIGKILL-equivalent); everything else lowers to FaultInjector specs.
-FAULT_KINDS = ("none", "slow", "partition", "flap", "crash")
+#: Fault-persona kinds the engine understands. ``crash`` (root kill +
+#: zero-flag autorecovery, the PR 10 SIGKILL-equivalent),
+#: ``relaycrash`` (relay kill + identical-argv respawn) and
+#: ``relayloss`` (relay kill, never returns — members re-home) are
+#: driven by the runner as process-lifecycle events; everything else
+#: lowers to FaultInjector specs.
+FAULT_KINDS = (
+    "none", "slow", "partition", "flap", "crash", "relaycrash",
+    "relayloss",
+)
+
+#: The runner-driven process-lifecycle kinds (no FaultInjector specs).
+LIFECYCLE_KINDS = ("crash", "relaycrash", "relayloss")
+
+#: The kinds that imply a hierarchical (root + relays) topology.
+RELAY_KINDS = ("relaycrash", "relayloss")
 
 
 @dataclass(frozen=True)
@@ -120,7 +141,8 @@ class FaultPersona:
 
     @property
     def crash_round(self) -> int:
-        """The round the crash persona kills the server after."""
+        """The round the crash/relaycrash/relayloss persona kills its
+        target process after."""
         return int(self.value)
 
 
@@ -148,7 +170,7 @@ def parse_fault_persona(spec: str) -> FaultPersona:
         raise ValueError(
             f"fault persona {spec!r} needs a positive argument"
         )
-    if name in ("flap", "crash") and value != int(value):
+    if name in ("flap",) + LIFECYCLE_KINDS and value != int(value):
         raise ValueError(f"fault persona {spec!r} needs an integer count")
     return FaultPersona(spec=spec, kind=name, value=value)
 
@@ -170,7 +192,7 @@ def fault_specs_for(
       ``TrainStep``, two clean calls apart — the flapping-link persona
       (stresses the retry policy and probation recovery).
     """
-    if persona.kind in ("none", "crash"):
+    if persona.kind == "none" or persona.kind in LIFECYCLE_KINDS:
         return []
     if persona.kind == "slow":
         return [{
@@ -257,8 +279,8 @@ class ScenarioCell:
         is pulled in so the shorter run still dies mid-flight."""
         fault = self.fault
         persona = parse_fault_persona(fault)
-        if persona.kind == "crash":
-            fault = f"crash:{min(persona.crash_round, 2)}"
+        if persona.kind in LIFECYCLE_KINDS:
+            fault = f"{persona.kind}:{min(persona.crash_round, 2)}"
         return replace(
             self,
             fault=fault,

@@ -32,10 +32,19 @@ import numpy as np
 
 from gfedntm_tpu.scenarios.contracts import CLEAN_COUNTERS, evaluate_contracts
 from gfedntm_tpu.scenarios.personas import (
+    RELAY_KINDS,
     ScenarioCell,
     build_corpora,
     fault_specs_for,
 )
+
+#: Hierarchical cells (relaycrash/relayloss personas): how many relays
+#: the root terminates, and the relay-id base — DISJOINT from member ids
+#: (members keep 1..N upstream ids; a re-homed member presenting id 1 to
+#: a root that knows relay 1 would otherwise corrupt the relay's
+#: registration — the README trust note).
+N_RELAYS = 2
+RELAY_ID_BASE = 100
 
 __all__ = [
     "CellResult",
@@ -94,6 +103,32 @@ def default_matrix() -> list[ScenarioCell]:
         # pacing x delta codec — the composition ROADMAP item 4 names.
         ScenarioCell("dir01-crash-cohort", data=D, pacing="cohort:2",
                      wire_codec="delta", fault="crash:3"),
+        # -- hierarchical survivability (root + 2 relays; the runner
+        # splits the members between the shards). relaycrash: one relay
+        # SIGKILLed mid-run and respawned with identical argv (shard
+        # journal autorecovery, Ack-3 member reconnects); relayloss: the
+        # relay never returns and its members re-home to the root. Both
+        # compose non-IID data with the delta codec; the NPMI baseline
+        # twin is the same policy run FLAT (two-tier FedAvg reproduces
+        # the flat trajectory). The relaycrash cell also bounds
+        # time-to-quorum after the kill via the recovery_time SLO,
+        # replayed through the offline `slo` engine.
+        ScenarioCell("dir01-relaycrash-sync", data=D, wire_codec="delta",
+                     fault="relaycrash:3", n_clients=4, total_docs=160,
+                     slo=(
+                         {"name": "recovery_time",
+                          "metric": "recovery_time_s", "agg": "value",
+                          "op": "<=", "threshold": 120.0},
+                     )),
+        # The relayloss cell kills early and stretches the surviving
+        # shard's runway (long epochs, one minibatch per poll) so the
+        # root is still mid-run when the orphaned members' failover
+        # lands — re-homing must RACE completion to be observable at
+        # all.
+        ScenarioCell("dir01-relayloss-sync", data=D, wire_codec="delta",
+                     fault="relayloss:2", n_clients=4, total_docs=160,
+                     num_epochs=24, local_steps=1, max_iters=400,
+                     extra_server_kwargs={"round_backoff_s": 1.0}),
     ]
 
 
@@ -230,6 +265,13 @@ def run_cell(
     port = _free_port()
     server_dir = os.path.join(workdir, "server")
     server_kwargs = _server_kwargs(cell, server_dir, ref_path)
+    hier = persona.kind in RELAY_KINDS
+    if hier:
+        # Hierarchical topology: the root terminates RELAYS, not
+        # members, and a lost shard must degrade the quorum after a
+        # short grace, never stall the round loop.
+        server_kwargs["min_clients"] = N_RELAYS
+        server_kwargs.setdefault("relay_grace_rounds", 2)
     stream_paths = [os.path.join(server_dir, "metrics.jsonl")]
     m_server = MetricsLogger(stream_paths[0], node="server", validate=True)
     injector_specs = fault_specs_for(persona, cell.n_clients)
@@ -243,6 +285,57 @@ def run_cell(
     )
     server.start(f"[::]:{port}")
 
+    relays: list = []
+    relay_metrics: list = []
+    relay_ports: list[int] = []
+    relay_kwargs: list[dict[str, Any]] = []
+    shard_of = [
+        c * N_RELAYS // max(1, len(corpora)) for c in range(len(corpora))
+    ]
+    # For relayloss the victim is the LIGHTEST-loaded shard: its
+    # orphaned members must re-home while the survivors still hold
+    # enough work to keep the root's round loop alive (non-IID splits
+    # can make the shards very uneven).
+    shard_load = [
+        sum(len(corpora[c]) for c in range(len(corpora))
+            if shard_of[c] == r)
+        for r in range(N_RELAYS)
+    ]
+    victim_shard = (
+        min(range(N_RELAYS), key=lambda r: shard_load[r])
+        if persona.kind == "relayloss" else 0
+    )
+    if hier:
+        from gfedntm_tpu.federation.relay import RelayNode
+
+        for r in range(N_RELAYS):
+            relay_id = RELAY_ID_BASE + 1 + r
+            rport = _free_port()
+            rdir = os.path.join(workdir, f"relay{relay_id}")
+            rpath = os.path.join(rdir, "metrics.jsonl")
+            stream_paths.append(rpath)
+            rm = MetricsLogger(
+                rpath, node=f"relay{relay_id}", validate=True
+            )
+            kwargs = dict(
+                relay_id=relay_id,
+                upstream_address=f"localhost:{port}",
+                min_members=shard_of.count(r),
+                listen_address=f"[::]:{rport}",
+                save_dir=rdir,
+                journal_every=1,
+                wire_codec="auto",
+                liveness_timeout=60.0,
+                watchdog_poll_s=0.2,
+                reconnect_window=30.0,
+            )
+            relay = RelayNode(metrics=rm, **kwargs)
+            relay.start()
+            relays.append(relay)
+            relay_metrics.append(rm)
+            relay_ports.append(rport)
+            relay_kwargs.append(kwargs)
+
     client_metrics = []
     clients = []
     for c, corpus in enumerate(corpora):
@@ -251,15 +344,36 @@ def run_cell(
         stream_paths.append(path)
         cm = MetricsLogger(path, node=f"client{c + 1}", validate=True)
         client_metrics.append(cm)
+        if hier:
+            upstream = f"localhost:{relay_ports[shard_of[c]]}"
+            # relayloss: the doomed shard's members carry the root as a
+            # failover endpoint plus a TIGHT liveness window and
+            # reconnect window, so they detect the dead relay and
+            # re-home while the surviving shard is still training (the
+            # race the rehoming contract asserts — detection is
+            # idle-based, the tier polls its members). relaycrash
+            # members ride the ordinary window so the respawned relay
+            # (same port) re-admits them instead.
+            doomed = (
+                persona.kind == "relayloss"
+                and shard_of[c] == victim_shard
+            )
+            failover = [f"localhost:{port}"] if doomed else []
+            window = 1.0 if doomed else 180.0
+            live = 1.2 if doomed else 60.0
+        else:
+            upstream, failover, window = f"localhost:{port}", [], 180.0
+            live = 60.0
         clients.append(Client(
             client_id=c + 1,
             corpus=corpus,
-            server_address=f"localhost:{port}",
+            server_address=upstream,
+            failover_addrs=failover,
             save_dir=cdir,
             metrics=cm,
-            liveness_timeout=60.0,
+            liveness_timeout=live,
             watchdog_poll_s=0.2,
-            reconnect_window=180.0,
+            reconnect_window=window,
             wire_codec="auto",
         ))
     threads = [
@@ -274,7 +388,56 @@ def run_cell(
     error: str | None = None
     final_server = server
     try:
-        if persona.kind == "crash":
+        if hier:
+            _await_round(server, persona.crash_round,
+                         timeout=cell.timeout_s / 2)
+            # Kill the victim shard's relay with no stop fan-out — the
+            # relay-tier SIGKILL-equivalent.
+            victim = relays[victim_shard]
+            victim.abort()
+            killed_at = server.global_iterations
+            relay_metrics[victim_shard].snapshot_registry()
+            relay_metrics[victim_shard].close()
+            if persona.kind == "relaycrash":
+                # Identical-argv respawn: same id, same port, same
+                # save_dir, ZERO recovery flags — maybe_autorecover
+                # restores the shard from its journal on its own.
+                rpath2 = os.path.join(
+                    workdir, "relay_recovered", "metrics.jsonl"
+                )
+                stream_paths.append(rpath2)
+                rm2 = MetricsLogger(
+                    rpath2,
+                    node=f"relay{RELAY_ID_BASE + 1 + victim_shard}",
+                    validate=True,
+                )
+                from gfedntm_tpu.federation.relay import RelayNode
+
+                relay2 = RelayNode(
+                    metrics=rm2, **relay_kwargs[victim_shard]
+                )
+                resumed = relay2.maybe_autorecover()
+                relay2.start()
+                relays[victim_shard] = relay2
+                relay_metrics[victim_shard] = rm2
+                recovery = {
+                    "recovered": resumed is not None,
+                    "resumed_round": resumed,
+                    "killed_round": killed_at,
+                    "source": "journal",
+                }
+            else:
+                # relayloss: the relay never returns; its members must
+                # re-home to the root via their failover endpoint.
+                relays[victim_shard] = None
+                relay_metrics[victim_shard] = None
+                recovery = {
+                    "recovered": False,
+                    "resumed_round": None,
+                    "killed_round": killed_at,
+                    "source": None,
+                }
+        elif persona.kind == "crash":
             _await_round(server, persona.crash_round,
                          timeout=cell.timeout_s / 2)
             # SIGKILL-equivalent (the PR 10 recipe): abort without any
@@ -317,6 +480,17 @@ def run_cell(
             final_server.stop()
         except Exception:
             _LOG.exception("cell %s: server stop failed", cell.name)
+        for relay in relays:
+            if relay is None:
+                continue
+            try:
+                relay.shutdown()
+            except Exception:
+                _LOG.exception("cell %s: relay shutdown failed", cell.name)
+        for rm in relay_metrics:
+            if rm is not None:
+                rm.snapshot_registry()
+                rm.close()
         for c in clients:
             try:
                 c.shutdown()
@@ -457,6 +631,12 @@ def collect_cell_evidence(
         "slo": slo,
         "server_recovered_events": sum(
             1 for r in all_records if r.get("event") == "server_recovered"
+        ),
+        "relay_recovered_events": sum(
+            1 for r in all_records if r.get("event") == "relay_recovered"
+        ),
+        "member_rehomed_events": sum(
+            1 for r in all_records if r.get("event") == "member_rehomed"
         ),
     }
 

@@ -99,6 +99,17 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "rpc_deduplicated": frozenset({"client", "method"}),
     "server_recovered": frozenset({"round", "source"}),
     "partition_injected": frozenset({"peer", "window_s"}),
+    # survivable hierarchy (relay crash recovery / member re-homing /
+    # journal degradation; README "Crash recovery & sessions"): a
+    # respawned relay that restored its shard from its own journal, a
+    # member adopted by a new tier after its relay never came back (the
+    # adoptive tier logs this LOUDLY — an unknown-but-valid-format token
+    # is evidence of a cross-tier failover, not a fresh fleet member),
+    # and a journal write that failed (ENOSPC/EIO) — training continues
+    # but autorecovery is disabled for the rest of the run.
+    "relay_recovered": frozenset({"relay", "round", "members"}),
+    "member_rehomed": frozenset({"client"}),
+    "journal_write_failed": frozenset({"round", "error"}),
     # data-plane defense (update admission gate / divergence guardian;
     # see README "Robust aggregation & divergence recovery")
     "update_rejected": frozenset({"client", "round", "reason"}),
@@ -692,6 +703,19 @@ FLEET_EVENTS: tuple[str, ...] = (
     "alert_firing",
     "alert_resolved",
     "fleet_overflow",
+)
+
+#: Survivable-hierarchy events (relay crash autorecovery, cross-tier
+#: member re-homing, journal-write degradation — README "Crash recovery
+#: & sessions"). Same reverse-lint contract: graftlint verifies each
+#: keeps an emission call site, so the hierarchy's crash-recovery audit
+#: trail (which the chaos suite and the relay-crash scenario cells
+#: assert against) can never be silently disconnected.
+SURVIVAL_EVENTS: tuple[str, ...] = (
+    "server_recovered",
+    "relay_recovered",
+    "member_rehomed",
+    "journal_write_failed",
 )
 
 

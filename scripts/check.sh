@@ -128,9 +128,10 @@ print('dryrun_multichip(8) OK')
 fi
 
 if [ "${CHAOS:-0}" = "1" ]; then
-    # Process-level chaos suite (README "Crash recovery & sessions"):
-    # spawns the real CLI as subprocesses and SIGKILLs the server
-    # mid-round / clients mid-step. Slow-marked, excluded from tier-1;
+    # Process-level chaos suite (README "Crash recovery & sessions",
+    # "Survivable hierarchy"): spawns the real CLI as subprocesses and
+    # SIGKILLs the server mid-round / clients mid-step / one relay of a
+    # two-tier hierarchy mid-round. Slow-marked, excluded from tier-1;
     # opt in with CHAOS=1.
     echo "== process-level chaos suite (CHAOS=1) =="
     env JAX_PLATFORMS=cpu python -m pytest tests/chaos -q -m slow \

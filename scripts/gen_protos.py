@@ -55,10 +55,17 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # server's FleetRegistry resynchronizes the node's series in the same
     # RPC that restores its session — no extra round-trips, best-effort
     # (an empty field costs nothing on the wire).
+    # `recovered` rides a token reconnect from a process that crashed and
+    # restored itself from its own journal (a respawned relay): the
+    # session is the SAME — weight, straggler EWMA, registry identity
+    # survive — but the presenter's wire-codec state died with the old
+    # process, so the receiver must drop its per-recipient push-ack /
+    # delta-reference posture and send the next broadcast self-contained.
     "JoinRequest": [
         ("codec_id", 3, F.TYPE_STRING),
         ("session_token", 4, F.TYPE_STRING),
         ("telemetry", 5, F.TYPE_BYTES),
+        ("recovered", 6, F.TYPE_BOOL),
     ],
     # Pacing negotiation (README "Hierarchical federation & wire
     # efficiency"): the server advertises its round pacing policy

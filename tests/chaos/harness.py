@@ -2,8 +2,9 @@
 
 Everything in `tests/test_survival.py` kills servers in-process (fast,
 deterministic, tier-1); this harness is the last mile of honesty — the
-server and every client are separate `python -m gfedntm_tpu.cli`
-processes, and the kills are actual `SIGKILL`s, so recovery is proven
+server, every relay, and every client are separate
+`python -m gfedntm_tpu.cli` processes, and the kills are actual
+`SIGKILL`s, so recovery is proven
 against real process death: no shared interpreter, no shared jax
 runtime, no in-memory state accidentally surviving the "crash".
 
@@ -73,6 +74,31 @@ def spawn_server(save_dir: str, port: int, archive: str,
         *extra,
     ]
     return _spawn(argv, os.path.join(save_dir, "server_stdout.log"))
+
+
+def spawn_relay(relay_id: int, save_dir: str, port: int,
+                upstream_port: int, archive: str, n_members: int = 2,
+                extra: list[str] = ()) -> subprocess.Popen:
+    """The mid-tier aggregator role (``--role relay``): terminates
+    ``n_members`` members on ``port`` and joins the root at
+    ``upstream_port`` as client ``relay_id``. Zero recovery flags — a
+    respawn with the SAME argv must auto-recover the shard on its own
+    (the CLI calls ``maybe_autorecover()`` before serving)."""
+    argv = [
+        "--role", "relay", "--id", str(relay_id), "--source", archive,
+        "--server_address", f"localhost:{upstream_port}",
+        "--min_clients_federation", str(n_members),
+        "--listen_port", str(port), "--save_dir", save_dir,
+        # Fast dead-root detection + a patient upstream reconnect
+        # window, mirroring the member posture below.
+        "--liveness_timeout", "30", "--reconnect_window", "300",
+        "--verbose",
+        *extra,
+    ]
+    os.makedirs(save_dir, exist_ok=True)
+    return _spawn(
+        argv, os.path.join(save_dir, f"relay{relay_id}_stdout.log")
+    )
 
 
 def spawn_client(client_id: int, save_dir: str, port: int, archive: str,

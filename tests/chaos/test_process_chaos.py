@@ -9,6 +9,12 @@ and REAL SIGKILLs (slow-marked; `CHAOS=1 scripts/check.sh`).
    replacement process rejoins cleanly (fresh session, push-ack/codec
    state deduplicated server-side), and `codec_ref_miss == 0` under the
    delta wire codec.
+3. SIGKILL one relay of a two-tier hierarchy mid-round → a respawn with
+   the IDENTICAL argv auto-recovers the shard from its journal
+   (`relay_recovered`), the orphaned members token-reconnect, every
+   member finishes, the root's `codec_ref_miss`/`rpcs_deduplicated`
+   stay 0, and the final betas match a no-crash hierarchical baseline
+   within tolerance.
 """
 
 import os
@@ -114,6 +120,121 @@ def test_server_sigkill_zero_flag_autorecovery(tmp_path):
     assert tss >= 0.75 * baseline.shape[0], (
         f"recovered betas diverged from baseline (tss={tss:.2f} of "
         f"{baseline.shape[0]})"
+    )
+
+
+def _spawn_hierarchy(tmp_path, archive, tag, epochs):
+    """Root + two relays (two members each) as real processes. Shard
+    layout: members 1,2 → relay 1; members 3,4 → relay 2. Returns the
+    processes plus the ports/dirs a respawn-with-identical-argv needs."""
+    root_port = _free_port()
+    root_dir = str(tmp_path / f"{tag}_root")
+    os.makedirs(root_dir, exist_ok=True)
+    # The root terminates the two relays, not the four members. A dead
+    # relay's polls fail FAST (connection refused, not a deadline), so
+    # the default 3-round probation would permanently drop the shard
+    # seconds into a ~30 s respawn (a fresh interpreter start-up) — a
+    # 2-shard operator configures patience, the respawn stays zero-flag.
+    root = harness.spawn_server(root_dir, root_port, archive,
+                                n_clients=2, num_epochs=epochs,
+                                extra=["--probation_rounds", "60"])
+    harness.wait_for_port(root_port)
+    relay_ports = [_free_port(), _free_port()]
+    relay_dirs = [str(tmp_path / f"{tag}_r{r + 1}") for r in range(2)]
+    relays = [
+        harness.spawn_relay(r + 1, relay_dirs[r], relay_ports[r],
+                            root_port, archive, n_members=2)
+        for r in range(2)
+    ]
+    for port in relay_ports:
+        harness.wait_for_port(port)
+    clients = [
+        harness.spawn_client(
+            cid, str(tmp_path / f"{tag}_c{cid}"),
+            relay_ports[(cid - 1) // 2], archive, num_epochs=epochs,
+        )
+        for cid in (1, 2, 3, 4)
+    ]
+    topo = {
+        "root_dir": root_dir, "root_port": root_port,
+        "relay_dirs": relay_dirs, "relay_ports": relay_ports,
+    }
+    return root, relays, clients, topo
+
+
+def test_relay_sigkill_shard_autorecovery(tmp_path):
+    archive = str(tmp_path / "corpus.npz")
+    harness.make_archive(archive, n_nodes=4)
+    # A long-epoch run, like the client-kill scenario: the respawned
+    # relay pays a fresh ~30 s interpreter start-up and the federation
+    # must still be mid-run when the recovered shard re-forms.
+    epochs = 24
+
+    # no-crash hierarchical baseline over the same archive/seeds
+    root, relays, clients, topo = _spawn_hierarchy(
+        tmp_path, archive, "base", epochs
+    )
+    codes = harness.drain([root, *relays, *clients], timeout=600)
+    assert codes == [0] * 7, f"baseline exit codes {codes}"
+    baseline = harness.load_server_betas(topo["root_dir"])
+
+    root, relays, clients, topo = _spawn_hierarchy(
+        tmp_path, archive, "crash", epochs
+    )
+    victim_dir = topo["relay_dirs"][0]
+    # the shard journal lives under the relay's per-node subdirectory
+    victim_node_dir = os.path.join(victim_dir, "relay1")
+    try:
+        harness.wait_for(
+            lambda: (harness.journal_round(victim_node_dir) or -1) >= 2,
+            timeout=420, what="round 2 in relay 1's shard journal",
+        )
+        harness.sigkill(relays[0])
+        time.sleep(2.0)
+        # the replacement: IDENTICAL argv — shard recovery is zero-flag
+        relay1b = harness.spawn_relay(
+            1, victim_dir, topo["relay_ports"][0], topo["root_port"],
+            archive, n_members=2,
+        )
+        codes = harness.drain(
+            [root, relays[1], relay1b, *clients], timeout=600
+        )
+    finally:
+        harness.drain([root, *relays, *clients], timeout=10)
+    assert codes[0] == 0, "root did not exit cleanly"
+    assert codes[1] == 0, "surviving relay did not exit cleanly"
+    assert codes[2] == 0, "recovered relay did not exit cleanly"
+    assert codes[3:].count(0) == 4, f"member exit codes {codes[3:]}"
+
+    # the respawned relay announced its recovery and resumed at (or just
+    # behind) the kill point; its orphaned members token-reconnected
+    relay_metrics = os.path.join(victim_node_dir, "metrics.jsonl")
+    recovered = harness.read_events(relay_metrics, "relay_recovered")
+    assert recovered and recovered[-1]["round"] >= 1
+    assert recovered[-1]["members"] >= 2
+    restores = {
+        e["client"]
+        for e in harness.read_events(relay_metrics, "session_restored")
+    }
+    assert restores, "no member token-reconnected to the recovered relay"
+
+    # acceptance invariants at the root: the shard bounce cost time,
+    # never reference-chain integrity or double counting — and nobody
+    # was re-homed (the shard came BACK; failover never engaged)
+    root_metrics = os.path.join(topo["root_dir"], "metrics.jsonl")
+    assert harness.final_counter(root_metrics, "codec_ref_miss") == 0
+    assert harness.final_counter(root_metrics, "rpcs_deduplicated") == 0
+    assert harness.read_events(root_metrics, "member_rehomed") == []
+
+    betas = harness.load_server_betas(topo["root_dir"])
+    assert np.isfinite(betas).all()
+    assert betas.shape == baseline.shape
+    from gfedntm_tpu.eval.metrics import topic_similarity_score
+
+    tss = topic_similarity_score(betas, baseline)
+    assert tss >= 0.75 * baseline.shape[0], (
+        f"recovered-shard betas diverged from baseline (tss={tss:.2f} "
+        f"of {baseline.shape[0]})"
     )
 
 

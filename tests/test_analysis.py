@@ -233,6 +233,67 @@ class TestTelemetryContract:
         assert "'alert_firing'" in found[0].message
         assert "no .log() emission site" in found[0].message
 
+    def test_survival_events_reverse_lint_catches_disconnect(
+            self, tmp_path):
+        """ISSUE 17: the SURVIVAL_EVENTS group is reverse-linted the
+        same way — the crash-recovery story is only as good as its
+        observability, so a refactor that silently drops the
+        `relay_recovered` / `member_rehomed` / `journal_write_failed`
+        emission (or its schema) must fail the lint."""
+        survival = {
+            "relay_recovered": frozenset({"relay", "round"}),
+            "member_rehomed": frozenset({"client"}),
+            "journal_write_failed": frozenset({"round", "error"}),
+        }
+        src = (
+            'metrics.log("relay_recovered", relay=1, round=3)\n'
+            'metrics.log("member_rehomed", client=2)\n'
+        )  # journal_write_failed emission seeded out
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE), src,
+            options=telemetry_contract(
+                events=survival,
+                required={"SURVIVAL_EVENTS": tuple(survival)},
+            ),
+        )
+        assert len(found) == 1
+        assert "SURVIVAL_EVENTS" in found[0].message
+        assert "'journal_write_failed'" in found[0].message
+        assert "no .log() emission site" in found[0].message
+        # schema seeded out too: the event still emits but is no longer
+        # registered — both halves of the disconnect must flag
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            src + 'metrics.log("journal_write_failed", round=3, '
+                  'error="e")\n',
+            options=telemetry_contract(
+                events={k: v for k, v in survival.items()
+                        if k != "journal_write_failed"},
+                required={"SURVIVAL_EVENTS": tuple(survival)},
+            ),
+        )
+        msgs = " | ".join(f.message for f in found)
+        assert "missing from EVENT_SCHEMAS" in msgs
+
+    def test_survival_events_group_wired_to_real_registry(self):
+        """The production lint options really do carry the
+        SURVIVAL_EVENTS group, each member schema-registered — so the
+        seeded regressions above model the real contract."""
+        from gfedntm_tpu.analysis.core import LintContext
+        from gfedntm_tpu.utils.observability import (
+            EVENT_SCHEMAS,
+            SURVIVAL_EVENTS,
+        )
+
+        contract = TelemetryContractRule()._contract(
+            LintContext(root=".")
+        )
+        assert tuple(contract["required"]["SURVIVAL_EVENTS"]) == tuple(
+            SURVIVAL_EVENTS
+        )
+        for name in SURVIVAL_EVENTS:
+            assert name in EVENT_SCHEMAS
+
     def test_scanner_selfcheck_fires_on_zero_sites(self, tmp_path):
         found = lint_src(
             tmp_path, TelemetryContractRule(paths=EVERYWHERE),

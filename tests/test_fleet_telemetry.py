@@ -351,6 +351,61 @@ class TestRelayShardReport:
         assert merged["local_step_s"] == flat["local_step_s"]
 
 
+class TestRelayBounceFullReship:
+    def test_respawned_relay_first_build_heals_root_view(self):
+        # A relay crash loses the shipper's delta baseline AND whatever
+        # shard deltas were in flight. The respawn contract: a fresh
+        # shipper's FIRST build is FULL, and each member's
+        # token-reconnect re-ships its FULL report into the new shard
+        # registry — so after one post-bounce ship the root's merged
+        # view equals the offline flat merge bucket-for-bucket, with
+        # nothing double-counted and nothing missing.
+        root = FleetRegistry()
+        members = {}
+        for cid in (1, 2):
+            m = MetricsLogger(node=f"client{cid}")
+            _observe_series(m.registry, [0.001 * (cid + k)
+                                         for k in range(3)])
+            m.registry.counter("steps").inc(3)
+            members[cid] = m
+
+        relay = RelayNode(relay_id=7, upstream_address="unused:0",
+                          min_members=2)
+        for cid, m in members.items():
+            relay.fleet.ingest_bytes(TelemetryShipper(
+                registry=m.registry, node=f"client{cid}").build())
+        root.ingest_bytes(relay._shipper.build())  # FULL
+        # Members progress; the pre-crash relay ships a delta the crash
+        # will orphan on the root (its baseline dies with the process).
+        for cid, m in members.items():
+            _observe_series(m.registry, [0.01 * cid])
+            m.registry.counter("steps").inc(1)
+            relay.fleet.ingest_bytes(TelemetryShipper(
+                registry=m.registry, node=f"client{cid}").build())
+        root.ingest_bytes(relay._shipper.build())
+
+        # SIGKILL-equivalent: the relay object is discarded. The respawn
+        # holds a FRESH shipper; members re-ship FULL reports on their
+        # token-reconnects (more progress happened while it was down).
+        relay2 = RelayNode(relay_id=7, upstream_address="unused:0",
+                           min_members=2)
+        for cid, m in members.items():
+            _observe_series(m.registry, [0.02 * cid, 0.03])
+            m.registry.counter("steps").inc(2)
+            relay2.fleet.ingest_bytes(TelemetryShipper(
+                registry=m.registry, node=f"client{cid}").build())
+        root.ingest_bytes(relay2._shipper.build())  # fresh shipper: FULL
+
+        assert set(root.node_snapshots()) == {"relay7:shard"}
+        flat = merge_node_snapshots({
+            f"client{cid}": m.registry.snapshot()
+            for cid, m in members.items()
+        })
+        merged = root.merged()
+        assert merged["steps"]["value"] == flat["steps"]["value"] == 12.0
+        assert merged["local_step_s"] == flat["local_step_s"]
+
+
 # ---- live-fleet acceptance e2e ----------------------------------------------
 
 def _run_fleet_and_compare(tmp_path, pacing, n_clients=3, steps=4,

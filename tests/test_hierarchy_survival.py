@@ -1,0 +1,319 @@
+"""Unit coverage for the survivable-hierarchy machinery (ISSUE 17):
+relay shard-journal / zero-flag autorecovery edges, the client-side
+reconnect→re-home failover ladder, the root's shard-grace quorum view,
+and the relaycrash/relayloss scenario personas + contracts. These are
+the fast in-process complements of the real-SIGKILL e2e in
+``tests/chaos/test_process_chaos.py`` and the scenario smoke cells.
+"""
+
+import os
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.data.vocab import Vocabulary
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.protos import federated_pb2 as pb
+from gfedntm_tpu.federation.registry import Federation
+from gfedntm_tpu.federation.relay import RelayNode
+from gfedntm_tpu.scenarios.contracts import evaluate_contracts, quorum_floor
+from gfedntm_tpu.scenarios.personas import (
+    RELAY_KINDS,
+    fault_specs_for,
+    parse_fault_persona,
+)
+from gfedntm_tpu.scenarios.runner import default_matrix
+from gfedntm_tpu.train.checkpoint import RoundJournal
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+
+# ---------------------------------------------------------------------------
+# relay shard journal + maybe_autorecover edges
+# ---------------------------------------------------------------------------
+
+def _relay(tmp_path=None, **kw):
+    kw.setdefault("relay_id", 1)
+    kw.setdefault("upstream_address", "unused:0")
+    kw.setdefault("min_members", 1)
+    if tmp_path is not None:
+        kw.setdefault("save_dir", str(tmp_path))
+    return RelayNode(**kw)
+
+
+def _write_journal(save_dir: str, relay: int = 1) -> RoundJournal:
+    journal = RoundJournal(os.path.join(save_dir, "checkpoints"))
+    journal.record(
+        0, {"w": np.zeros(2, np.float32)}, [],
+        vocab=["a", "b"],
+        extra={
+            "relay": relay, "upstream_session": "tok", "codec_id": "none",
+            "setup_base_b64": "",
+        },
+    )
+    return journal
+
+
+class TestRelayJournalEdges:
+    def test_fresh_start_without_journal(self, tmp_path):
+        assert _relay(tmp_path).maybe_autorecover() is None
+
+    def test_disabled_without_save_dir_or_journaling(self, tmp_path):
+        assert _relay().maybe_autorecover() is None
+        assert _relay(tmp_path, journal_every=0).maybe_autorecover() is None
+
+    def test_finished_journal_starts_fresh(self, tmp_path):
+        _write_journal(str(tmp_path)).mark_finished()
+        assert _relay(tmp_path).maybe_autorecover() is None
+
+    def test_foreign_shard_refused(self, tmp_path):
+        """A journal written by a DIFFERENT relay id under this save_dir
+        is operator error — adopting another tier's shard silently would
+        double-represent its members upstream."""
+        _write_journal(str(tmp_path), relay=2)
+        with pytest.raises(ValueError, match="refusing to adopt"):
+            _relay(tmp_path).maybe_autorecover()
+
+    def test_journal_write_failure_degrades_loudly(self, tmp_path):
+        """Satellite: ENOSPC/EIO on a shard-journal write must not kill
+        training — the relay keeps serving, but it says LOUDLY (event +
+        counter) that autorecovery is forfeited, and stops retrying."""
+        metrics = MetricsLogger(validate=True)
+        relay = _relay(tmp_path, metrics=metrics)
+        relay.global_vocab = Vocabulary(("a", "b"))
+        with relay._setup_lock:
+            relay._setup_base = pb.GlobalSetup()
+
+        class _BrokenJournal:
+            calls = 0
+
+            def record(self, *a, **kw):
+                self.calls += 1
+                raise OSError(28, "No space left on device")
+
+        broken = _BrokenJournal()
+        relay._round_journal = broken
+        relay._journal_shard()
+        assert relay._journal_disabled
+        events = metrics.events("journal_write_failed")
+        assert len(events) == 1 and "No space left" in events[0]["error"]
+        assert metrics.registry.snapshot()[
+            "journal_write_failures"]["value"] == 1.0
+        # degraded, not flapping: further rounds skip the dead journal
+        relay._journal_shard()
+        assert broken.calls == 1
+        assert len(metrics.events("journal_write_failed")) == 1
+
+
+# ---------------------------------------------------------------------------
+# client failover ladder
+# ---------------------------------------------------------------------------
+
+def _client(**kw):
+    kw.setdefault("client_id", 1)
+    kw.setdefault("corpus", RawCorpus(documents=["alpha beta gamma"] * 3))
+    kw.setdefault("server_address", "localhost:1")
+    return Client(**kw)
+
+
+class _DeadChannel:
+    closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestClientRehoming:
+    def test_rehome_swaps_endpoint_and_resets_codec_sessions(self):
+        client = _client(failover_addrs=["localhost:2", "localhost:3"])
+        assert list(client.failover_addrs) == ["localhost:2", "localhost:3"]
+        old = _DeadChannel()
+        client._fed_channel = old
+        client._federation_stub = object()
+
+        class _Session:
+            resets = 0
+
+            def reset(self):
+                self.resets += 1
+
+        client._uplink = up = _Session()
+        client._downlink = down = _Session()
+        client._rehome("localhost:2")
+        assert client.server_address == "localhost:2"
+        assert old.closed, "the dead channel was not released"
+        assert up.resets == 1 and down.resets == 1, (
+            "wire-codec sessions must not survive a tier change"
+        )
+
+    def test_failover_ladder_walks_endpoints_on_exhaustion(self):
+        """exhausted → pop the next endpoint and retry; any other
+        outcome (finished/refused) ends the ladder — a federation that
+        ANSWERED is authoritative, only a dead endpoint justifies
+        re-homing."""
+        client = _client(failover_addrs=["localhost:2", "localhost:3"])
+        client._fed_channel = _DeadChannel()
+        outcomes = iter(["exhausted", "exhausted", "ok"])
+        attempts = []
+
+        def fake_loop(idle):
+            client._last_reconnect_outcome = next(outcomes)
+            attempts.append(client.server_address)
+            return client._last_reconnect_outcome == "ok"
+
+        client._reconnect_loop = fake_loop
+        assert client._reconnect_or_rehome(0.0)
+        assert attempts == ["localhost:1", "localhost:2", "localhost:3"]
+        assert client.failover_addrs == []
+
+    def test_failover_ladder_stops_on_authoritative_answer(self):
+        client = _client(failover_addrs=["localhost:2"])
+        client._fed_channel = _DeadChannel()
+
+        def fake_loop(idle):
+            client._last_reconnect_outcome = "finished"
+            return False
+
+        client._reconnect_loop = fake_loop
+        assert not client._reconnect_or_rehome(0.0)
+        assert client.failover_addrs == ["localhost:2"], (
+            "a 'finished' answer must not trigger re-homing"
+        )
+
+    def test_watchdog_window_shrinks_only_when_reconnect_available(self):
+        client = _client(liveness_timeout=60.0, reconnect_window=30.0)
+        client.session_token = "tok"
+        client._gap_ewma = 0.1  # fast observed cadence
+        # reconnect available: fast dead-server detection may shrink the
+        # window below the fixed formula, floored at WATCHDOG_FLOOR_S
+        assert client._watchdog_window() == pytest.approx(10.0)
+        # detection would self-finalize (destructive): the observed
+        # cadence may only ever WIDEN the operator's window
+        client.reconnect_window = 0.0
+        assert client._watchdog_window() == pytest.approx(60.0)
+
+
+# ---------------------------------------------------------------------------
+# root-side shard supervision: the grace view
+# ---------------------------------------------------------------------------
+
+class TestShardGrace:
+    def test_grace_expired_views_long_suspects_only(self):
+        fed = Federation(min_clients=2)
+        fed.connect_ready(1, "a")
+        fed.connect_ready(2, "b")
+        fed.mark_suspect(1, "a", round_idx=5, probation_rounds=99)
+        assert fed.grace_expired(6, grace_rounds=2) == []
+        expired = fed.grace_expired(7, grace_rounds=2)
+        assert [c.client_id for c in expired] == [1]
+        # flat-fleet semantics unchanged: grace disabled → empty view
+        assert fed.grace_expired(99, grace_rounds=0) == []
+
+    def test_recovered_suspect_leaves_the_view(self):
+        fed = Federation(min_clients=1)
+        fed.connect_ready(1, "a")
+        fed.mark_suspect(1, "a", round_idx=1, probation_rounds=99)
+        assert fed.grace_expired(3, grace_rounds=2)
+        assert fed.mark_recovered(1)
+        assert fed.grace_expired(3, grace_rounds=2) == []
+
+
+# ---------------------------------------------------------------------------
+# scenario personas + contracts for the relay cells
+# ---------------------------------------------------------------------------
+
+def _matrix_cells():
+    return {c.name: c for c in default_matrix()}
+
+
+class TestRelayPersonas:
+    def test_parse_relay_kinds(self):
+        for spec, kind in (("relaycrash:3", "relaycrash"),
+                           ("relayloss:2", "relayloss")):
+            persona = parse_fault_persona(spec)
+            assert persona.kind == kind and persona.kind in RELAY_KINDS
+            assert persona.crash_round == int(spec.split(":")[1])
+            # lifecycle personas are runner-driven, never injector specs
+            assert fault_specs_for(persona, 4) == []
+
+    def test_relay_kill_round_must_be_integer(self):
+        with pytest.raises(ValueError, match="integer"):
+            parse_fault_persona("relaycrash:1.5")
+
+    def test_matrix_carries_the_hierarchy_cells(self):
+        cells = _matrix_cells()
+        crash = cells["dir01-relaycrash-sync"]
+        loss = cells["dir01-relayloss-sync"]
+        assert crash.fault_persona.kind == "relaycrash"
+        assert loss.fault_persona.kind == "relayloss"
+        assert any(s["name"] == "recovery_time" for s in crash.slo)
+        # the fault axis is excluded from the baseline-twin key …
+        assert replace(crash, fault="none").policy_key() == \
+            crash.policy_key()
+        # … and the two cells pace differently (the relayloss cell
+        # stretches its runway), so each gets its own baseline twin
+        assert crash.policy_key() != loss.policy_key()
+
+    def test_shrink_pulls_the_kill_round_in(self):
+        for name in ("dir01-relaycrash-sync", "dir01-relayloss-sync"):
+            shrunk = _matrix_cells()[name].shrink()
+            assert parse_fault_persona(shrunk.fault).crash_round <= 2
+
+    def test_quorum_floor_is_one_for_relay_cells(self):
+        cells = _matrix_cells()
+        assert quorum_floor(cells["dir01-relaycrash-sync"]) == 1
+        assert quorum_floor(cells["dir01-relayloss-sync"]) == 1
+
+
+def _evidence(**over):
+    ev = {
+        "finished": True, "betas_finite": True, "rounds": 8,
+        "averaged_push_clients": [2, 2, 1],
+        "counters": {"codec_ref_miss": 0.0, "rpcs_deduplicated": 0.0},
+        "npmi_final": 0.41,
+        "slo": {
+            "alerts": [{"alert": "recovery_time",
+                        "objective": "recovery_time_s <= 120",
+                        "state": "ok"}],
+            "fired": [],
+        },
+    }
+    ev.update(over)
+    return ev
+
+
+class TestRelayContracts:
+    def test_relaycrash_recovery_contract(self):
+        cell = _matrix_cells()["dir01-relaycrash-sync"]
+        good = _evidence(
+            recovery={"recovered": True, "resumed_round": 2,
+                      "killed_round": 3},
+            relay_recovered_events=1,
+        )
+        out = evaluate_contracts(cell, good)
+        assert out["recovery"]["ok"], out["recovery"]["detail"]
+        assert out["slo"]["ok"], out["slo"]["detail"]
+        # the journal may trail by the in-flight round on each side of
+        # the pre-reduction (slack 2) — but no further
+        behind = _evidence(
+            recovery={"recovered": True, "resumed_round": 0,
+                      "killed_round": 3},
+            relay_recovered_events=1,
+        )
+        assert not evaluate_contracts(cell, behind)["recovery"]["ok"]
+        # recovery without the loud announcement is not recovery
+        silent = _evidence(
+            recovery={"recovered": True, "resumed_round": 3,
+                      "killed_round": 3},
+            relay_recovered_events=0,
+        )
+        assert not evaluate_contracts(cell, silent)["recovery"]["ok"]
+
+    def test_relayloss_rehoming_contract(self):
+        cell = _matrix_cells()["dir01-relayloss-sync"]
+        out = evaluate_contracts(cell, _evidence(member_rehomed_events=2))
+        assert out["rehoming"]["ok"], out["rehoming"]["detail"]
+        assert not evaluate_contracts(
+            cell, _evidence(member_rehomed_events=0)
+        )["rehoming"]["ok"]

@@ -515,14 +515,22 @@ class TestMatrixAndSchema:
         )
         # every fault persona kind appears
         kinds = {c.fault_persona.kind for c in cells}
-        assert {"none", "slow", "partition", "flap", "crash"} <= kinds
+        assert {"none", "slow", "partition", "flap", "crash",
+                "relaycrash", "relayloss"} <= kinds
         # both workloads appear
         assert {c.workload for c in cells} == {"avitm", "ctm"}
-        # every faulted cell has its no-fault baseline twin in-matrix
+        # every faulted cell has its no-fault baseline twin in-matrix —
+        # except the hierarchical cells, whose pacing axes are tuned to
+        # the relay-kill races (stretched runway) and so share no policy
+        # key with any flat cell: run_matrix synthesizes their flat
+        # twins into the batch (covered by test_run_matrix paths).
+        from gfedntm_tpu.scenarios.personas import RELAY_KINDS
+
         keys = {c.policy_key() for c in cells
                 if c.fault_persona.kind == "none"}
         for c in cells:
-            if c.fault_persona.kind != "none":
+            kind = c.fault_persona.kind
+            if kind != "none" and kind not in RELAY_KINDS:
                 assert c.policy_key() in keys, c.name
 
     def test_baseline_of(self):
