@@ -16,6 +16,7 @@ from gfedntm_tpu.analysis.rules.donation import DonationSafetyRule
 from gfedntm_tpu.analysis.rules.exceptions import ExceptionHygieneRule
 from gfedntm_tpu.analysis.rules.locks import LockDisciplineRule
 from gfedntm_tpu.analysis.rules.precision import PrecisionPinRule
+from gfedntm_tpu.analysis.rules.rng import RngDisciplineRule
 from gfedntm_tpu.analysis.rules.telemetry import TelemetryContractRule
 
 __all__ = [
@@ -24,6 +25,7 @@ __all__ = [
     "ExceptionHygieneRule",
     "LockDisciplineRule",
     "PrecisionPinRule",
+    "RngDisciplineRule",
     "TelemetryContractRule",
 ]
 
@@ -37,4 +39,5 @@ def make_default_rules() -> list:
         DonationSafetyRule(),
         LockDisciplineRule(),
         ExceptionHygieneRule(),
+        RngDisciplineRule(),
     ]

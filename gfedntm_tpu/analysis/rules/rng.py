@@ -1,0 +1,125 @@
+"""GL006 rng-discipline: noise paths must never draw ambient randomness.
+
+Ground truth (PR 18, the privacy plane): every DP noise draw must be a
+pure function of an explicit ``(seed, application index)`` — the
+accountant's ledger, the crash-autorecovery contract (a restored server
+resumes the exact noise stream), and the host-oracle/device parity
+tests all depend on it. Two failure shapes sneak past review:
+
+- ``np.random.<fn>(...)`` **module-level** draws (``np.random.normal``,
+  ``np.random.rand``, even ``np.random.seed`` — mutating the ambient
+  global stream is as bad as reading it): any other library touching
+  the global ``RandomState`` silently reorders the draws. The seeded
+  factories (``np.random.default_rng``, ``Generator``, ``PCG64``, ...)
+  are the sanctioned spelling and stay quiet.
+- ``jax.random.PRNGKey(<literal>)`` with a hard-coded constant key
+  outside tests: every process folds the SAME stream, so per-client /
+  per-round noise is perfectly correlated — exactly the independence
+  assumption the RDP composition theorem needs. Keys must derive from
+  a seed that was passed in (``PRNGKey(int(seed))``, ``fold_in``).
+
+Scope: the privacy package plus the two aggregation modules whose
+noise/estimator paths the mechanisms ride through. Test files configure
+the rule onto fixtures; the live-repo self-run must stay clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gfedntm_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+    attr_root,
+)
+
+NP_ROOTS = frozenset({"np", "numpy"})
+
+#: ``np.random.<name>`` attributes that are seeded constructors / types,
+#: not draws from (or mutations of) the ambient global stream.
+SEEDED_FACTORIES = frozenset({
+    "default_rng",
+    "Generator",
+    "SeedSequence",
+    "BitGenerator",
+    "Philox",
+    "PCG64",
+    "PCG64DXSM",
+    "MT19937",
+    "SFC64",
+})
+
+
+def _np_random_fn(func: ast.AST) -> str | None:
+    """``np.random.<fn>`` / ``numpy.random.<fn>`` -> ``fn`` (else None)."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    mid = func.value
+    if not (isinstance(mid, ast.Attribute) and mid.attr == "random"):
+        return None
+    if not (isinstance(mid.value, ast.Name) and mid.value.id in NP_ROOTS):
+        return None
+    return func.attr
+
+
+def _is_prngkey(func: ast.AST) -> bool:
+    """``jax.random.PRNGKey`` / ``jrandom.PRNGKey`` / ``random.PRNGKey``
+    (any chain ending in the attribute, rooted at a plausible jax
+    handle)."""
+    if not (isinstance(func, ast.Attribute) and func.attr == "PRNGKey"):
+        return False
+    root = attr_root(func)
+    return root in {"jax", "jrandom", "jr", "random"}
+
+
+class RngDisciplineRule(Rule):
+    id = "GL006"
+    name = "rng-discipline"
+    description = (
+        "noise paths must not draw from np.random's ambient global "
+        "stream or hard-code jax PRNGKey literals — DP noise is a pure "
+        "function of (seed, index)"
+    )
+    default_paths = (
+        "gfedntm_tpu/privacy/",
+        "gfedntm_tpu/federation/device_agg.py",
+        "gfedntm_tpu/federation/aggregation.py",
+    )
+
+    NP_HINT = (
+        "draw from an explicitly-seeded generator — "
+        "np.random.default_rng((seed, index)) — so the stream is a pure "
+        "function of the mechanism seed, not ambient process state"
+    )
+    KEY_HINT = (
+        "derive the key from a seed that was passed in "
+        "(jax.random.PRNGKey(int(seed)) + fold_in), never a hard-coded "
+        "literal — a constant key correlates every process's noise"
+    )
+
+    def check_file(self, src: SourceFile, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _np_random_fn(node.func)
+            if fn is not None and fn not in SEEDED_FACTORIES:
+                out.append(self.finding(
+                    src, node.lineno,
+                    f"np.random.{fn}() draws from (or mutates) the "
+                    "ambient global stream in a noise path",
+                    hint=self.NP_HINT,
+                ))
+                continue
+            if _is_prngkey(node.func) and node.args and isinstance(
+                node.args[0], ast.Constant
+            ):
+                out.append(self.finding(
+                    src, node.lineno,
+                    f"{ast.unparse(node.func)}({node.args[0].value!r}) "
+                    "hard-codes the PRNG key in a noise path",
+                    hint=self.KEY_HINT,
+                ))
+        return out

@@ -10,10 +10,11 @@ PRs 1/5/7 caught by hand:
   first time a test constructs ``MetricsLogger(validate=True)``;
 - reverse-lint: every DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS +
   SCALEOUT_EVENTS + SERVING_EVENTS + SCENARIO_EVENTS + FLEET_EVENTS +
-  SURVIVAL_EVENTS entry keeps BOTH a schema registration and at least
+  SURVIVAL_EVENTS + PRIVACY_EVENTS entry keeps BOTH a schema
+  registration and at least
   one emission site — a refactor that disconnects the admission-gate/
   guardian/quality/scale-plane/serving/scenario/fleet-alerting/
-  crash-recovery telemetry must not pass silently;
+  crash-recovery/privacy telemetry must not pass silently;
 - every ``observability.TRACE_PLANE_SPANS`` name keeps a ``span(...)``
   call site — the ``trace`` CLI merges and parents by these names;
 - scanner self-checks: zero ``.log(``/``span(`` sites at all means the
@@ -86,6 +87,7 @@ class TelemetryContractRule(Rule):
             EVENT_SCHEMAS,
             FLEET_EVENTS,
             MODEL_QUALITY_EVENTS,
+            PRIVACY_EVENTS,
             SCALEOUT_EVENTS,
             SCENARIO_EVENTS,
             SERVING_EVENTS,
@@ -103,6 +105,7 @@ class TelemetryContractRule(Rule):
                 "SCENARIO_EVENTS": tuple(SCENARIO_EVENTS),
                 "FLEET_EVENTS": tuple(FLEET_EVENTS),
                 "SURVIVAL_EVENTS": tuple(SURVIVAL_EVENTS),
+                "PRIVACY_EVENTS": tuple(PRIVACY_EVENTS),
             },
             "spans": tuple(TRACE_PLANE_SPANS),
             "schema_module": SCHEMA_MODULE,

@@ -312,6 +312,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the divergence-rollback path, reason "
                         "'coherence_collapse' (needs --quality_every > 0 "
                         "and --quality_ref)")
+    # Privacy plane (README "Differential privacy & posterior sampling"):
+    # DP-SGD / FedLD noise mechanisms + the (eps, delta) accountant.
+    p.add_argument("--dp", type=str, default="off",
+                   choices=["off", "server", "client"],
+                   help="differential-privacy mode: 'server' adds "
+                        "FedLD-style calibrated Gaussian noise to each "
+                        "aggregate (and tightens --max_update_norm to "
+                        "--dp_clip so the clip ball is enforced at "
+                        "admission); 'client' clips + noises each "
+                        "client's outgoing update locally (local DP). "
+                        "'off' (default) constructs no mechanism objects "
+                        "— every existing trajectory is bitwise unchanged")
+    p.add_argument("--dp_clip", type=float, default=1.0,
+                   help="L2 sensitivity bound (the DP clip; default 1.0)")
+    p.add_argument("--dp_sigma", type=float, default=0.0,
+                   help="noise multiplier (noise std = sigma x "
+                        "sensitivity; required > 0 when --dp is not off)")
+    p.add_argument("--dp_delta", type=float, default=1e-5,
+                   help="delta the (eps, delta) accountant reports at "
+                        "(default 1e-5)")
+    p.add_argument("--dp_budget", type=float, default=0.0,
+                   help="declared epsilon budget: exceeding it logs "
+                        "privacy_budget_exceeded (loud, training "
+                        "continues); the offline `privacy` gate turns it "
+                        "into rc=1 (default 0 = track only)")
+    p.add_argument("--dp_seed", type=int, default=0,
+                   help="mechanism seed — every noise draw is a pure "
+                        "function of (seed, application index)")
     # Serving plane (README "Serving"): the `serve` role's knobs. The
     # model identity (family/kwargs/vocab) normally comes from the
     # journal itself (self-describing since the serving PR); --model_type
@@ -548,6 +576,12 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         quality_ref=getattr(args, "quality_ref", None),
         quality_topn=getattr(args, "quality_topn", 10),
         quality_guard=getattr(args, "quality_guard", False),
+        dp=getattr(args, "dp", "off"),
+        dp_clip=getattr(args, "dp_clip", 1.0),
+        dp_sigma=getattr(args, "dp_sigma", 0.0),
+        dp_delta=getattr(args, "dp_delta", 1e-5),
+        dp_budget=getattr(args, "dp_budget", 0.0),
+        dp_seed=getattr(args, "dp_seed", 0),
     )
     if getattr(args, "resume", False):
         from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
@@ -643,6 +677,12 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         wire_codec=getattr(args, "wire_codec", None) or "auto",
         profiler=profiler,
         mesh_devices=getattr(args, "mesh_devices", 0) or 0,
+        dp=getattr(args, "dp", "off"),
+        dp_clip=getattr(args, "dp_clip", 1.0),
+        dp_sigma=getattr(args, "dp_sigma", 0.0),
+        dp_delta=getattr(args, "dp_delta", 1e-5),
+        dp_budget=getattr(args, "dp_budget", 0.0),
+        dp_seed=getattr(args, "dp_seed", 0),
     )
     client.run()
     client.shutdown()
@@ -939,9 +979,11 @@ def run_summarize(argv: list[str]) -> int:
 
     from gfedntm_tpu.utils.observability import (
         collect_wire_tiers,
+        format_privacy_line,
         format_report,
         format_wire_tiers,
         summarize_metrics,
+        summarize_privacy,
     )
 
     # One read per stream: the primary report comes from the FIRST
@@ -952,6 +994,9 @@ def run_summarize(argv: list[str]) -> int:
     summary = summarize_metrics(node_records.get(first_node, []))
     tiers = collect_wire_tiers(node_records)
     summary["wire_tiers"] = tiers
+    summary["privacy"] = summarize_privacy(
+        node_records.get(first_node, [])
+    )
     if args.json_out:
         os.makedirs(
             os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
@@ -959,6 +1004,9 @@ def run_summarize(argv: list[str]) -> int:
         with open(args.json_out, "w") as fh:
             json.dump(summary, fh, indent=1, default=float)
     print(format_report(summary))
+    if summary["privacy"]:
+        print()
+        print(format_privacy_line(summary["privacy"]))
     print()
     print(format_wire_tiers(tiers))
     return 0
@@ -998,11 +1046,13 @@ def run_report(argv: list[str]) -> int:
         format_quality_report,
         format_wire_tiers,
         summarize_model_quality,
+        summarize_privacy,
     )
 
     node_records, _first = _read_node_records(args.paths)
     records = [r for recs in node_records.values() for r in recs]
     summary = summarize_model_quality(records)
+    summary["privacy"] = summarize_privacy(records)
     tiers = collect_wire_tiers(node_records)
     summary["wire_tiers"] = tiers
     if args.json_out:
@@ -1256,6 +1306,110 @@ def run_slo(argv: list[str]) -> int:
     return 0
 
 
+def run_privacy(argv: list[str]) -> int:
+    """``privacy <metrics.jsonl>...``: replay a run's privacy ledger
+    offline — the per-round ``privacy_budget`` events the server's
+    accountant logged — and gate on it (the ``slo`` offline CI-gate
+    pattern). Exits 1 when the declared (or ``--budget``-overridden)
+    epsilon budget was exceeded, or when the ledger is non-monotone
+    (an epsilon that ever DECREASES means the accountant state was
+    reset mid-run — e.g. a recovery path that dropped the ledger —
+    which silently under-reports the true privacy cost)."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu privacy",
+        description="Replay the (eps, delta) privacy ledger from "
+                    "recorded metrics.jsonl streams (exit 1 if the "
+                    "budget was exceeded or the ledger is non-monotone).",
+    )
+    p.add_argument("paths", nargs="+",
+                   help="per-node metrics.jsonl files (the server's "
+                        "stream carries the privacy_budget ledger)")
+    p.add_argument("--budget", type=float, default=None,
+                   help="epsilon budget to enforce (default: each "
+                        "event's own declared budget field)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the final ledger state as JSON")
+    args = p.parse_args(argv)
+
+    node_records, _first = _read_node_records(args.paths)
+    ledger = sorted(
+        (r for recs in node_records.values() for r in recs
+         if r.get("event") == "privacy_budget"),
+        key=lambda r: (float(r.get("time", 0.0)), int(r.get("round", 0))),
+    )
+    exceeded_events = [
+        r for recs in node_records.values() for r in recs
+        if r.get("event") == "privacy_budget_exceeded"
+    ]
+    if not ledger:
+        if args.budget is not None:
+            print(
+                "privacy check FAILED: --budget declared but the stream "
+                "has no privacy_budget events (was the run --dp off?)",
+                file=sys.stderr,
+            )
+            return 1
+        print("no privacy_budget events — nothing to check")
+        return 0
+
+    failures: list[str] = []
+    prev_eps = 0.0
+    for r in ledger:
+        eps = float(r.get("eps", 0.0))
+        if eps + 1e-12 < prev_eps:
+            failures.append(
+                f"ledger not monotone: eps fell {prev_eps:.6g} -> "
+                f"{eps:.6g} at round {r.get('round')} (accountant state "
+                "was reset mid-run)"
+            )
+            break
+        prev_eps = eps
+    last = ledger[-1]
+    final_eps = float(last.get("eps", 0.0))
+    budget = (
+        args.budget if args.budget is not None
+        else float(last.get("budget", 0.0))
+    )
+    if budget > 0.0 and final_eps > budget:
+        failures.append(
+            f"budget exceeded: final eps {final_eps:.6g} > budget "
+            f"{budget:.6g} (delta {last.get('delta')})"
+        )
+    elif args.budget is None and exceeded_events:
+        failures.append(
+            f"run logged {len(exceeded_events)} privacy_budget_exceeded "
+            "event(s)"
+        )
+    state = {
+        "rounds": len(ledger),
+        "eps": final_eps,
+        "delta": float(last.get("delta", 0.0)),
+        "steps": int(last.get("steps", len(ledger))),
+        "mode": last.get("mode"),
+        "sigma": float(last.get("sigma", 0.0)),
+        "budget": budget,
+        "failures": failures,
+    }
+    if args.json_out:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
+        )
+        with open(args.json_out, "w") as fh:
+            json.dump(state, fh, indent=1, default=float)
+    print(
+        f"privacy ledger: {len(ledger)} round(s), mode "
+        f"{state['mode']}, final eps {final_eps:.6g} at delta "
+        f"{state['delta']:g} (sigma {state['sigma']:g}, budget "
+        + (f"{budget:g})" if budget > 0 else "untracked)")
+    )
+    if failures:
+        for f in failures:
+            print(f"privacy check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("privacy check passed")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1269,6 +1423,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_scenarios(argv[1:])
     if argv and argv[0] == "slo":
         return run_slo(argv[1:])
+    if argv and argv[0] == "privacy":
+        return run_privacy(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

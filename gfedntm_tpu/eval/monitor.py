@@ -239,6 +239,7 @@ class TopicQualityMonitor:
         guard_patience: int = 2,
         guard_drop: float = 0.5,
         guard_floor: float = 0.1,
+        noise_floor: float = 0.0,
         metrics: Any = None,
         logger: logging.Logger | None = None,
     ):
@@ -257,6 +258,10 @@ class TopicQualityMonitor:
                 "guard_drop/guard_floor must be > 0 (a zero threshold "
                 "flags every fluctuation as a collapse)"
             )
+        if noise_floor < 0:
+            raise ValueError(
+                f"noise_floor must be >= 0, got {noise_floor}"
+            )
         self.every = int(every)
         self.id2token = dict(id2token)
         self.ref_tokens = (
@@ -268,6 +273,15 @@ class TopicQualityMonitor:
         self.guard_patience = int(guard_patience)
         self.guard_drop = float(guard_drop)
         self.guard_floor = float(guard_floor)
+        # DP-noise awareness (README "Differential privacy & posterior
+        # sampling"): an additive NPMI slack on the collapse threshold.
+        # With --dp on, every quality round's coherence jitters by the
+        # injected noise; without the slack the guard reads that jitter
+        # as decay and false-triggers rollbacks — but the slack is
+        # ADDITIVE, not multiplicative, so a genuine collapse (a drop
+        # far past the noise floor) still fires (regression-tested in
+        # both directions).
+        self.noise_floor = float(noise_floor)
         self.metrics = metrics
         self.logger = logger or logging.getLogger("TopicQualityMonitor")
         self._beta_key: str | None = None
@@ -400,6 +414,7 @@ class TopicQualityMonitor:
             threshold = (
                 None if ewma is None
                 else self.guard_drop * max(abs(ewma), self.guard_floor)
+                + self.noise_floor
             )
             if threshold is not None and (ewma - npmi) > threshold:
                 self._streak += 1
@@ -437,6 +452,7 @@ class TopicQualityMonitor:
                 "every": self.every,
                 "topn": self.topn,
                 "has_reference": self.ref_tokens is not None,
+                "noise_floor": self.noise_floor,
                 "coherence_ewma": self._coherence_ewma,
                 "unhealthy_streak": self._streak,
                 "last": last,

@@ -358,19 +358,38 @@ class ServerAggregator:
     Stateless aggregators return ``None`` from :meth:`state_dict`; stateful
     ones return a flat npz-able array dict and accept it back via
     :meth:`load_state_dict` on ``--resume``.
+
+    ``noiser`` (default None — bitwise no-op) is the server-side FedLD
+    DP mechanism (:class:`gfedntm_tpu.privacy.mechanisms.ServerNoiser`),
+    applied to the mean stage's output *after* the robust estimate: the
+    estimator first discards the byzantine tail, then calibrated
+    Gaussian noise lands on the clean estimate — composing robustness
+    and privacy without either masking the other. The hook sits in
+    :meth:`_mean` so every aggregator (plain assignment and the slotted
+    server optimizers alike) injects noise into the same place the
+    sensitivity analysis bounds: the admitted cohort's location
+    estimate. The noiser deliberately does NOT join :attr:`name` — the
+    estimator composition is checkpoint identity, the noise mechanism
+    is run configuration carried by the privacy ledger.
     """
 
     name = "base"
 
     def __init__(self, estimator: "str | RobustEstimator | None" = None):
         self.estimator = make_estimator(estimator)
+        #: Optional server-side DP noise mechanism (set by the server
+        #: when ``--dp server``; None leaves every path bitwise intact).
+        self.noiser = None
         if self.estimator.name != "mean":
             # Instance attribute shadows the class name: the composition is
             # part of the aggregator's identity (checkpoints, /status).
             self.name = f"{type(self).name}+{self.estimator.name}"
 
     def _mean(self, snapshots) -> dict[str, np.ndarray]:
-        return self.estimator(snapshots)
+        est = self.estimator(snapshots)
+        if self.noiser is not None:
+            est = self.noiser.apply(est, len(snapshots))
+        return est
 
     def aggregate(
         self,

@@ -66,12 +66,21 @@ class FederatedClientServicer:
                  on_activity=None, on_done=None, on_local_steps=None,
                  uplink: UplinkEncoder | None = None,
                  downlink: DownlinkDecoder | None = None,
-                 profiler=None):
+                 profiler=None, sanitizer=None):
         self.client_id = client_id
         self.stepper = stepper
         self.on_stop = on_stop
         self.logger = logger
         self.metrics = metrics
+        # Optional privacy.ClientSanitizer (--dp client): every outgoing
+        # snapshot is clipped + noised against the round-start reference
+        # BEFORE encoding, so neither the server, any relay tier, nor a
+        # wire observer ever sees the raw local update (local DP). The
+        # reference is the last applied aggregate — or, before any
+        # broadcast, the replicated init template captured lazily at the
+        # first exchanged step.
+        self.sanitizer = sanitizer
+        self._dp_reference: dict[str, np.ndarray] | None = None
         # Optional RoundProfiler: the client learns the round index from
         # each StepRequest, so the jax.profiler window opens/closes here —
         # the local steps are where this process's device time actually is.
@@ -162,6 +171,14 @@ class FederatedClientServicer:
                 self.profiler.observe(int(request.global_iter))
             requested = max(1, int(request.local_steps or 1))
             self.on_local_steps(requested)
+            if self.sanitizer is not None and self._dp_reference is None:
+                # First exchanged round before any broadcast: the DP
+                # clip/noise reference is the replicated init template —
+                # captured here, BEFORE any local step mutates it.
+                self._dp_reference = {
+                    k: np.array(v, copy=True)
+                    for k, v in self.stepper.get_gradients().items()
+                }
             # Truncate the round to the remaining epoch budget so the
             # exchanged step is always the FINAL scheduled one — the SPMD
             # trainer's forced-final-exchange semantics; never train past
@@ -184,6 +201,13 @@ class FederatedClientServicer:
             nr_samples += self.stepper._last_batch_size
             if self.metrics is not None:
                 self.metrics.registry.counter("client_polls").inc()
+            if self.sanitizer is not None:
+                # DP-SGD at the source: clip + noise the round delta
+                # before it is encoded — downstream of here (uplink codec,
+                # relays, server) only the sanitized update exists.
+                snapshot = self.sanitizer.apply(
+                    snapshot, self._dp_reference, self._applied_round + 1,
+                )
             if self.uplink is not None:
                 shared = self.uplink.encode(snapshot)
             else:
@@ -298,6 +322,15 @@ class FederatedClientServicer:
                     request.shared, metrics=self.metrics
                 )
             self._applied_round = int(request.round)
+            if self.sanitizer is not None:
+                # The applied aggregate is the next round's clip/noise
+                # reference (merged: a partial push must not orphan keys
+                # already covered by the previous reference).
+                ref = dict(self._dp_reference or {})
+                ref.update(
+                    (k, np.array(v, copy=True)) for k, v in average.items()
+                )
+                self._dp_reference = ref
             status = self.stepper.delta_update_fit(average)
             if status.epoch_ended:
                 self.logger.info(
@@ -382,9 +415,34 @@ class Client:
         reconnect_window: float = 180.0,
         mesh_devices: int = 0,
         failover_addrs: "tuple[str, ...] | list[str]" = (),
+        dp: str = "off",
+        dp_clip: float = 1.0,
+        dp_sigma: float = 0.0,
+        dp_delta: float = 1e-5,
+        dp_budget: float = 0.0,
+        dp_seed: int = 0,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
+        # Local differential privacy (--dp client): outgoing snapshots are
+        # clipped + noised by a ClientSanitizer before they leave this
+        # process. "server" mode is a server-side mechanism — a client
+        # constructed with dp="server" does nothing locally (the spec is
+        # parsed for validation only). "off" constructs no mechanism
+        # objects at all (the bitwise default-off contract).
+        from gfedntm_tpu.privacy.mechanisms import parse_dp
+
+        self.dp = parse_dp(
+            dp, clip=dp_clip, sigma=dp_sigma, delta=dp_delta,
+            budget=dp_budget, seed=dp_seed,
+        )
+        self._dp_sanitizer = None
+        if self.dp.mode == "client":
+            from gfedntm_tpu.privacy.mechanisms import ClientSanitizer
+
+            self._dp_sanitizer = ClientSanitizer(
+                self.dp, client_id=client_id, metrics=metrics,
+            )
         # Multi-chip local training (--mesh_devices): 0/1 = the historical
         # single-device stepper, bit-for-bit; N>1 = the local corpus
         # doc-shards over a 1-D data mesh of the first N devices and every
@@ -1064,7 +1122,7 @@ class Client:
             metrics=self.metrics, on_activity=self._rpc_begin,
             on_done=self._rpc_end, on_local_steps=self._note_local_steps,
             uplink=self._uplink, downlink=self._downlink,
-            profiler=self.profiler,
+            profiler=self.profiler, sanitizer=self._dp_sanitizer,
         )
         self._servicer = servicer
         self._grpc_server = rpc.make_server(max_workers=4)

@@ -275,6 +275,23 @@ class DeviceAggEngine:
             out_specs=P(ax, None, None),
         )
 
+        # ---- DP noise: sharded per-device Gaussian draws ---------------
+        # Each shard folds its axis index into the round key and draws
+        # its own coordinate block, so noise generation is data-parallel
+        # over the plane like every other program here — no host-side
+        # O(D) draw, no gather. The stream is a pure function of
+        # (seed, application index, shard index): deterministic across
+        # runs and resumes, and deliberately NOT bitwise-equal to the
+        # numpy host oracle (threefry vs PCG64 — see
+        # privacy.mechanisms' documented parity contract).
+        def dp_noise(key, vec):
+            k = jax.random.fold_in(key, jax.lax.axis_index(ax))
+            return jax.random.normal(k, vec.shape, jnp.float32)
+
+        self._dp_noise = _sm(
+            dp_noise, in_specs=(P(), P(ax)), out_specs=P(ax)
+        )
+
         # trimmed mean needs a static trim count: one jitted program per t.
         self._trimmed: dict[int, Any] = {}
         self._sm_builder = _sm
@@ -372,6 +389,30 @@ class DeviceAggEngine:
         sq = np.diagonal(dots).copy()
         d2 = sq[:, None] + sq[None, :] - 2.0 * dots
         return d2.astype(np.float32, copy=False)
+
+    # ---- DP noise ------------------------------------------------------
+    def noise_vector(
+        self, plane: FlatPlane, *, std: float, seed: int, index: int,
+    ) -> np.ndarray:
+        """Device-generated DP noise over the plane: ``[plane.dim]``
+        float32 Gaussian draws at ``std``, generated shard-parallel from
+        the key ``fold_in(PRNGKey(seed), index)`` with each shard's axis
+        index folded in (see the ``dp_noise`` program). Per-(seed,
+        index) deterministic; bitwise-off from the numpy host oracle by
+        construction (different PRNG), matching it in distribution."""
+        import jax
+
+        from gfedntm_tpu.parallel.sharded import shard_param_plane
+
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(int(seed)), int(index)
+        )
+        shaped = shard_param_plane(
+            np.zeros(self._pad_dim(plane), np.float32),
+            self.mesh, self.axis,
+        )
+        draws = np.asarray(self._dp_noise(key, shaped))
+        return draws[:plane.dim] * np.float32(std)
 
     def contribution_stats(
         self, stacked: StackedRound, avg: Mapping[str, Any]

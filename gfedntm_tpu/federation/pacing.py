@@ -361,6 +361,16 @@ class RoundEngine:
             out[rec.client_id] = max(0, min(claimed, observed))
         return out
 
+    # ---- privacy amplification (PR 18) -------------------------------------
+    def inclusion_q(self) -> float:
+        """Per-round client inclusion probability, the q the privacy
+        accountant credits for subsampling amplification. Only cohort
+        pacing actually *samples* (uniform K-of-eligible, overridden
+        below); sync polls everyone and async/push participation is
+        availability-driven, not a sampling distribution — all three
+        return the conservative 1.0 (no amplification claimed)."""
+        return 1.0
+
     # ---- status ------------------------------------------------------------
     def status(self) -> "dict[str, Any]":
         with self._lock:
@@ -826,10 +836,18 @@ class CohortEngine(SyncEngine):
             s.metrics.registry.gauge("cohort_eligible").set(len(active))
             s.metrics.log(
                 "cohort_sampled", round=iteration, k=len(cohort),
-                eligible=len(active),
+                eligible=len(active), q=self._inclusion_p,
                 cohort=[rec.client_id for rec in cohort],
             )
         return cohort
+
+    def inclusion_q(self) -> float:
+        """The live K/eligible of the most recent sample — first-class,
+        so the privacy accountant never re-derives K/N from config (a
+        probation-shrunk eligible pool makes the true q *larger* than
+        the configured K/N; reading the sampler's own value keeps the
+        amplification credit honest)."""
+        return float(self._inclusion_p)
 
     def quorum_denominator(self, cohort: list, iteration: int = 0) -> int:
         """The PR 9 quorum bugfix: under cohort pacing the denominator is

@@ -91,6 +91,15 @@ from gfedntm_tpu.utils.observability import (
 )
 
 
+#: Default additive NPMI slack the coherence-collapse guard gets under
+#: any DP mode (README "Differential privacy & posterior sampling"):
+#: wide enough that per-round noise jitter at the published scales never
+#: false-triggers a rollback, narrow enough that a genuine collapse
+#: (NPMI cliffs are several tenths) still fires. Operators override via
+#: quality_monitor_kwargs={"noise_floor": ...}.
+DP_GUARD_NOISE_FLOOR = 0.05
+
+
 def build_template_model(
     family: str, vocab_size: int, model_kwargs: dict[str, Any]
 ) -> AVITM:
@@ -173,6 +182,12 @@ class FederatedServer:
         slo_specs=None,
         fleet_max_nodes: int = 512,
         fleet_max_series: int = 512,
+        dp: str = "off",
+        dp_clip: float = 1.0,
+        dp_sigma: float = 0.0,
+        dp_delta: float = 1e-5,
+        dp_budget: float = 0.0,
+        dp_seed: int = 0,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -268,6 +283,47 @@ class FederatedServer:
             )
             if divergence_patience > 0 else None
         )
+        # Privacy plane (README "Differential privacy & posterior
+        # sampling"): ``--dp off`` (the default) constructs NOTHING —
+        # no noiser, no accountant — so every existing trajectory is
+        # bitwise unchanged. ``--dp server`` injects FedLD noise into
+        # the aggregate after the (possibly robust) mean stage and
+        # tightens the admission gate's clip to the DP clip (that clip
+        # IS the sensitivity bound the noise is calibrated to);
+        # ``--dp client`` expects clients to sanitize locally and only
+        # runs the server-side ledger, charged conservatively at q = 1
+        # with the declared mechanism parameters.
+        from gfedntm_tpu.privacy.mechanisms import parse_dp
+
+        self.dp = parse_dp(
+            dp, clip=dp_clip, sigma=dp_sigma, delta=dp_delta,
+            budget=dp_budget, seed=dp_seed,
+        )
+        self.privacy_accountant = None
+        self._dp_noiser = None
+        if self.dp.enabled:
+            from gfedntm_tpu.privacy import PrivacyAccountant, ServerNoiser
+
+            self.privacy_accountant = PrivacyAccountant(
+                sigma=self.dp.sigma, delta=self.dp.delta,
+                budget=self.dp.budget, mode=self.dp.mode,
+            )
+            if self.dp.mode == "server":
+                self._dp_noiser = ServerNoiser(self.dp, metrics=metrics)
+                self.aggregator.noiser = self._dp_noiser
+                if sanitize:
+                    gate = self.update_gate
+                    gate.max_update_norm = (
+                        self.dp.clip if gate.max_update_norm is None
+                        else min(gate.max_update_norm, self.dp.clip)
+                    )
+                else:
+                    self.logger.warning(
+                        "--dp server with sanitize off: the admission "
+                        "gate is not enforcing the DP clip, so the "
+                        "declared sensitivity bound rests on clients "
+                        "clipping honestly",
+                    )
         # Wire codec, negotiated with every client at join time: the
         # GlobalSetup advertises this id, ReadyForTraining verifies the
         # client runs the same one (mismatch = Ack code 2, loud on both
@@ -648,6 +704,13 @@ class FederatedServer:
             # coherence/diversity/drift ring buffer + per-client
             # contribution EWMAs; None when the plane is off.
             "model_quality": self._model_quality_status(full=full),
+            # Privacy plane (README "Differential privacy & posterior
+            # sampling"): the live (eps, delta) ledger; None when
+            # --dp off (the plane constructs nothing).
+            "privacy": (
+                self.privacy_accountant.status()
+                if self.privacy_accountant is not None else None
+            ),
             # Fleet telemetry plane (README "Fleet telemetry & SLOs"):
             # headline counts only — the bounded deep view is
             # /status.fleet, live alert detail is /alerts.
@@ -858,6 +921,12 @@ class FederatedServer:
                 "npmi": last.get("npmi"),
                 "round": last.get("round"),
             }
+        # Privacy ledger (README "Differential privacy & posterior
+        # sampling"): the spent-budget RDP curve rides every journal
+        # write and checkpoint, so crash-autorecovery RESUMES epsilon —
+        # a restart must never hand the adversary a fresh budget.
+        if self.privacy_accountant is not None:
+            extra["privacy"] = self.privacy_accountant.state_dict()
         return extra
 
     def _save_round_checkpoint(self) -> None:
@@ -1066,6 +1135,7 @@ class FederatedServer:
             self._restore_aggregator_state(ckpt, meta, round_idx)
         self.last_average = average
         self.global_iterations = int(round_idx)
+        self._restore_privacy(source.get("privacy"))
         self._restore_membership(source.get("membership") or ())
         # Recovered-server wire posture: this process holds no codec
         # session state and no push acks — the next push is
@@ -1106,6 +1176,47 @@ class FederatedServer:
         if self.metrics is not None:
             self.metrics.log("resume", step=round_idx)
         return round_idx
+
+    def _restore_privacy(self, state) -> None:
+        """Resume the (ε, δ) ledger from recovery state: ε continues,
+        never resets. The server-noise stream continues too — the noiser
+        counter is restored to the ledger's (post-catch-up) step count,
+        so recovery never reuses a draw the dead process may already
+        have spent. A run
+        recovered WITHOUT ``--dp`` while the journal carries a ledger is
+        loud: the operator silently dropping the mechanism mid-run is a
+        privacy-accounting hole, not a configuration preference."""
+        if state is None:
+            return
+        if self.privacy_accountant is None:
+            self.logger.warning(
+                "recovery state carries a privacy ledger (%s steps, "
+                "mode=%s) but this server runs --dp off; the ledger is "
+                "NOT carried forward — rounds from here on are "
+                "unaccounted", state.get("steps"), state.get("mode"),
+            )
+            return
+        self.privacy_accountant.load_state_dict(dict(state))
+        # The round journal is written BEFORE the round's accountant tick
+        # (the journal marks "fully pushed", the tick runs at round end),
+        # so the journaled ledger can lag the RELEASED noise by exactly
+        # one round. Recovery charges one conservative catch-up step:
+        # the ledger never under-counts noise that already left the
+        # server (at worst one round is double-charged), and the noise
+        # stream index advances past any draw the dead process may have
+        # spent.
+        self.privacy_accountant.step(
+            q=self.privacy_accountant.last_q or 1.0
+        )
+        if self._dp_noiser is not None:
+            self._dp_noiser.applications = self.privacy_accountant.steps
+        self.logger.info(
+            "resumed privacy ledger: eps=%.4f at delta=%g after %d "
+            "noised rounds (incl. one conservative catch-up step for "
+            "the possibly-uncharged in-flight round)",
+            self.privacy_accountant.epsilon(),
+            self.privacy_accountant.delta, self.privacy_accountant.steps,
+        )
 
     def _restore_journal_aggregator(self, jstate: dict) -> None:
         """Reload journaled server-optimizer slots (same name-mismatch
@@ -1738,6 +1849,51 @@ class FederatedServer:
             )
         if self.slo is not None:
             self.slo.evaluate()
+        self._privacy_tick(iteration)
+
+    def _privacy_tick(self, iteration: int) -> None:
+        """Charge the (ε, δ) ledger for one aggregated round. Called from
+        :meth:`_fleet_tick`, which every pacing engine runs exactly once
+        per round that actually aggregated — skipped (below-quorum)
+        rounds apply no mechanism and are charged nothing, keeping the
+        ledger's step count in lock-step with the noiser's application
+        counter. q comes from the live engine
+        (:meth:`pacing.RoundEngine.inclusion_q`): the cohort sampler's
+        actual K/eligible, the conservative 1.0 everywhere else. Budget
+        exhaustion is LOUD (event + counter + warning) but never stops
+        training — the offline ``privacy`` CLI gate enforces."""
+        acct = self.privacy_accountant
+        if acct is None:
+            return
+        q = (
+            self._engine.inclusion_q() if self._engine is not None
+            else 1.0
+        )
+        was_exceeded = acct.exceeded
+        eps = acct.step(q=q)
+        if self.metrics is not None:
+            self.metrics.registry.gauge("privacy_eps").set(eps)
+            self.metrics.log(
+                "privacy_budget", round=iteration, eps=float(eps),
+                delta=acct.delta, steps=acct.steps, q=float(q),
+                sigma=acct.sigma, mode=acct.mode, budget=acct.budget,
+            )
+        if acct.exceeded and not was_exceeded:
+            self.logger.warning(
+                "privacy budget EXCEEDED at round %d: eps=%.4f > "
+                "declared budget %.4f (delta=%g); training continues — "
+                "the offline `privacy` CLI gate is the enforcement "
+                "point", iteration, eps, acct.budget, acct.delta,
+            )
+            if self.metrics is not None:
+                self.metrics.registry.counter(
+                    "privacy_budget_exceeded"
+                ).inc()
+                self.metrics.log(
+                    "privacy_budget_exceeded", round=iteration,
+                    eps=float(eps), budget=acct.budget,
+                    delta=acct.delta,
+                )
 
     def _awaiting_reconnect_grace(self) -> bool:
         """True while the post-recovery grace window is open AND some
@@ -1807,6 +1963,11 @@ class FederatedServer:
 
                 engine = DeviceAggEngine()
                 self.update_gate.set_engine(engine)
+                if self._dp_noiser is not None:
+                    # Noise generation joins the device data plane:
+                    # sharded per-device draws on the same mesh the
+                    # stacked round lives on (host oracle otherwise).
+                    self._dp_noiser.device_engine = engine
                 self.logger.info(
                     "aggregation backend: device (%d-way '%s' mesh)",
                     engine.n_shards, engine.axis,
@@ -2140,6 +2301,14 @@ class FederatedServer:
                     "coherence (and the coherence guard) are disabled; "
                     "diversity and drift still run"
                 )
+            kwargs = dict(self.quality_monitor_kwargs)
+            if self.dp.enabled and "noise_floor" not in kwargs:
+                # DP noise jitters every quality round's coherence; give
+                # the collapse guard an additive NPMI slack so the noise
+                # floor cannot read as decay (operators override via
+                # quality_monitor_kwargs; a genuine collapse still fires
+                # — the slack is additive, not a disable).
+                kwargs["noise_floor"] = DP_GUARD_NOISE_FLOOR
             self._quality_mon = TopicQualityMonitor(
                 every=self.quality_every,
                 id2token=self.global_vocab.id2token,
@@ -2148,7 +2317,7 @@ class FederatedServer:
                 history=self.quality_history,
                 metrics=self.metrics,
                 logger=self.logger,
-                **self.quality_monitor_kwargs,
+                **kwargs,
             )
         return self._quality_mon
 

@@ -1,0 +1,57 @@
+"""Privacy plane: DP mechanisms + the (ε, δ) accountant (ROADMAP item 3).
+
+The federation's premise is private client corpora, yet the shared
+parameter stream is exactly what membership-inference attacks read.
+This package bounds that leakage with two composable mechanisms and one
+ledger:
+
+- **Server-side FedLD noise** (:class:`~.mechanisms.ServerNoiser`):
+  calibrated Gaussian noise injected into the aggregate *after* the
+  (possibly robust) mean stage — the Federated Averaging Langevin
+  Dynamics construction (arXiv:2112.05120, arXiv:2211.00100), which
+  turns the round loop into posterior sampling and yields central DP
+  against recipients of the broadcast stream.
+- **Client-side DP-SGD** (:class:`~.mechanisms.ClientSanitizer`): each
+  client clips its outgoing update to an L2 ball (the PR 5
+  ``--max_update_norm`` gate-clip semantics reused as the DP clip) and
+  adds seeded Gaussian noise *before* the update leaves the client —
+  local DP against the server itself (and every relay tier).
+- **The accountant** (:class:`~.accountant.PrivacyAccountant`): an
+  RDP/moments ledger composed per aggregation round with the *actual*
+  mechanism used, crediting cohort-subsampling amplification with the
+  live q = K/N from :meth:`pacing.CohortEngine.inclusion_q` and staying
+  conservative (q = 1) for sync/async/push pacing. The ledger rides the
+  PR 10 journal/checkpoint state so crash-autorecovery resumes the
+  budget instead of resetting it.
+
+Everything is default-off: ``--dp off`` constructs none of these
+objects and every existing trajectory is bitwise unchanged.
+"""
+
+from gfedntm_tpu.privacy.accountant import (
+    ALPHAS,
+    PrivacyAccountant,
+    eps_from_rdp,
+    gaussian_rdp,
+    subsampled_gaussian_rdp,
+)
+from gfedntm_tpu.privacy.mechanisms import (
+    ClientSanitizer,
+    DPSpec,
+    ServerNoiser,
+    host_noise_vector,
+    parse_dp,
+)
+
+__all__ = [
+    "ALPHAS",
+    "PrivacyAccountant",
+    "eps_from_rdp",
+    "gaussian_rdp",
+    "subsampled_gaussian_rdp",
+    "DPSpec",
+    "parse_dp",
+    "ServerNoiser",
+    "ClientSanitizer",
+    "host_noise_vector",
+]

@@ -196,4 +196,29 @@ def evaluate_contracts(
             f"npmi={npmi:.4f} baseline={base_npmi:.4f} "
             f"delta={delta:.4f} tol={cell.npmi_tol:g}",
         )
+
+    # 7. DP cells: the (ε, δ) ledger exists, only ever grows, and ends
+    # positive — in stream order across a crash cell's recovery seam
+    # (the replacement server resumes the journaled ledger; an ε that
+    # ever FALLS means the accountant was reset mid-run and the true
+    # privacy cost is under-reported).
+    if cell.dp != "off":
+        eps = [float(e) for e in (evidence.get("privacy_eps") or ())]
+        drops = [
+            (i, eps[i - 1], eps[i]) for i in range(1, len(eps))
+            if eps[i] + 1e-12 < eps[i - 1]
+        ]
+        out["budget_monotone"] = _contract(
+            bool(eps) and not drops and eps[-1] > 0.0,
+            (
+                f"{len(eps)} ledger rounds, final eps="
+                f"{eps[-1]:.4f}" if eps and not drops
+                else (
+                    f"eps fell {drops[0][1]:.4f} -> {drops[0][2]:.4f} "
+                    f"at ledger row {drops[0][0]}" if drops
+                    else "no privacy_budget events at all (dp plane "
+                         "silently off?)"
+                )
+            ),
+        )
     return out

@@ -250,6 +250,17 @@ class ScenarioCell:
     # engine the live planes run; any spec that ever fires is a red
     # "slo" contract.
     slo: tuple = ()
+    # DP axis (README "Differential privacy & posterior sampling"):
+    # "server" = FedLD noise on each aggregate, "client" = local DP-SGD
+    # on each outgoing update. Like the fault axis, dp is EXCLUDED from
+    # policy_key(): a dp cell's baseline twin is the same policy run
+    # noiseless (fault none AND dp off), and the npmi_tolerance contract
+    # bounds the coherence the noise may cost. dp != "off" also adds the
+    # budget_monotone contract over the server's privacy_budget ledger.
+    dp: str = "off"
+    dp_clip: float = 1.0
+    dp_sigma: float = 0.0
+    dp_budget: float = 0.0
 
     def __post_init__(self):
         if self.workload not in ("avitm", "ctm"):
@@ -257,6 +268,10 @@ class ScenarioCell:
         # Parse eagerly: a typo'd persona fails at matrix build time.
         parse_data_persona(self.data)
         parse_fault_persona(self.fault)
+        from gfedntm_tpu.privacy.mechanisms import parse_dp
+
+        parse_dp(self.dp, clip=self.dp_clip, sigma=self.dp_sigma,
+                 budget=self.dp_budget)
         if self.slo:
             from gfedntm_tpu.utils.slo import SLOSpec
 

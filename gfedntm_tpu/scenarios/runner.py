@@ -129,15 +129,40 @@ def default_matrix() -> list[ScenarioCell]:
                      fault="relayloss:2", n_clients=4, total_docs=160,
                      num_epochs=24, local_steps=1, max_iters=400,
                      extra_server_kwargs={"round_backoff_s": 1.0}),
+        # -- privacy cells (README "Differential privacy & posterior
+        # sampling"): each dp cell's baseline twin is the same policy
+        # run noiseless — the npmi_tolerance contract bounds what the
+        # noise costs, budget_monotone asserts the (eps, delta) ledger
+        # never resets. Sigma/clip are sized for these tiny synthetic
+        # federations: server noise std = sigma*clip/n_contributors.
+        ScenarioCell("dp-server-sync-dir01", data=D,
+                     dp="server", dp_clip=0.5, dp_sigma=0.6),
+        ScenarioCell("dp-server-cohort-dir01", data=D, pacing="cohort:2",
+                     dp="server", dp_clip=0.5, dp_sigma=0.6),
+        ScenarioCell("dp-client-sync-dir01", data=D,
+                     dp="client", dp_clip=0.3, dp_sigma=0.3),
+        ScenarioCell("dp-client-cohort-dir01", data=D, pacing="cohort:2",
+                     dp="client", dp_clip=0.3, dp_sigma=0.3),
+        # DP x crash: the ledger must survive the kill — the replacement
+        # server resumes epsilon from the journal (plus one conservative
+        # catch-up step), so the merged privacy_budget stream stays
+        # monotone through the recovery seam.
+        ScenarioCell("dp-server-crash-cohort", data=D, pacing="cohort:2",
+                     wire_codec="delta", fault="crash:3",
+                     dp="server", dp_clip=0.5, dp_sigma=0.6),
     ]
 
 
 def baseline_of(cell: ScenarioCell) -> "ScenarioCell | None":
-    """The no-fault twin a faulted cell's comparative contracts need
-    (None when the cell is its own baseline)."""
-    if cell.fault_persona.kind == "none":
+    """The clean twin a faulted/dp cell's comparative contracts need —
+    same policy axes, no fault AND no noise (None when the cell is its
+    own baseline)."""
+    if cell.fault_persona.kind == "none" and cell.dp == "off":
         return None
-    return replace(cell, name=f"{cell.name}-baseline", fault="none")
+    return replace(
+        cell, name=f"{cell.name}-baseline", fault="none", dp="off",
+        dp_sigma=0.0,
+    )
 
 
 # ---- one cell ---------------------------------------------------------------
@@ -203,6 +228,14 @@ def _server_kwargs(cell: ScenarioCell, save_dir: str,
         # The live engine runs the same specs the offline contract
         # replays — alert_* events land in the cell's server stream.
         kwargs["slo_specs"] = list(cell.slo)
+    if cell.dp != "off":
+        # Both dp modes hand the spec to the server: "server" constructs
+        # the FedLD noiser, "client" only the (conservative) accountant
+        # — the mechanism itself runs in the clients.
+        kwargs.update(
+            dp=cell.dp, dp_clip=cell.dp_clip, dp_sigma=cell.dp_sigma,
+            dp_budget=cell.dp_budget, dp_seed=cell.seed,
+        )
     kwargs.update(cell.extra_server_kwargs)
     return kwargs
 
@@ -364,6 +397,11 @@ def run_cell(
         else:
             upstream, failover, window = f"localhost:{port}", [], 180.0
             live = 60.0
+        dp_kwargs = (
+            dict(dp="client", dp_clip=cell.dp_clip,
+                 dp_sigma=cell.dp_sigma, dp_seed=cell.seed)
+            if cell.dp == "client" else {}
+        )
         clients.append(Client(
             client_id=c + 1,
             corpus=corpus,
@@ -375,6 +413,7 @@ def run_cell(
             watchdog_poll_s=0.2,
             reconnect_window=window,
             wire_codec="auto",
+            **dp_kwargs,
         ))
     threads = [
         threading.Thread(target=c.run, daemon=True, name=f"cell-client{i}")
@@ -618,6 +657,14 @@ def collect_cell_evidence(
             "fired": engine.ever_fired(),
             "alerts": engine.status()["alerts"],
         }
+    # Privacy ledger evidence (README "Differential privacy & posterior
+    # sampling"): the server stream's per-round eps trajectory, in
+    # stream order (a crash cell's recovered-server stream extends the
+    # killed one's — the budget_monotone contract asserts the seam).
+    privacy_eps = [
+        float(r.get("eps", 0.0)) for r in server_records
+        if r.get("event") == "privacy_budget"
+    ]
     return {
         "finished": bool(finished),
         "betas_finite": bool(betas_finite),
@@ -629,6 +676,11 @@ def collect_cell_evidence(
         "quality_rounds": len(quality.get("quality", ())),
         "recovery": recovery,
         "slo": slo,
+        "privacy_eps": privacy_eps,
+        "privacy_exceeded_events": sum(
+            1 for r in all_records
+            if r.get("event") == "privacy_budget_exceeded"
+        ),
         "server_recovered_events": sum(
             1 for r in all_records if r.get("event") == "server_recovered"
         ),
@@ -660,8 +712,11 @@ def run_matrix(
     if len(set(names)) != len(names):
         raise ValueError(f"duplicate cell names in matrix: {names}")
 
-    baselines = [c for c in cells if c.fault_persona.kind == "none"]
-    faulted = [c for c in cells if c.fault_persona.kind != "none"]
+    def _clean(c: ScenarioCell) -> bool:
+        return c.fault_persona.kind == "none" and c.dp == "off"
+
+    baselines = [c for c in cells if _clean(c)]
+    faulted = [c for c in cells if not _clean(c)]
     by_key: dict[tuple, ScenarioCell] = {}
     for c in baselines:
         by_key.setdefault(c.policy_key(), c)
@@ -676,7 +731,7 @@ def run_matrix(
     evidence_by_key: dict[tuple, CellResult] = {}
     for cell in baselines + faulted:
         base_res = evidence_by_key.get(cell.policy_key())
-        is_baseline = cell.fault_persona.kind == "none"
+        is_baseline = _clean(cell)
         res = run_cell(
             cell,
             os.path.join(workdir, cell.name),
@@ -737,6 +792,11 @@ def cell_bench_row(result: CellResult) -> dict[str, Any]:
         + (f"+{cell.robust}" if cell.robust else ""),
         "wire_codec": cell.wire_codec,
         "n_clients": cell.n_clients,
+        "dp": cell.dp,
+        "dp_sigma": cell.dp_sigma,
+        "privacy_final_eps": (
+            (result.evidence.get("privacy_eps") or [None])[-1]
+        ),
         "rounds": result.evidence.get("rounds"),
         "npmi": result.evidence.get("npmi_final"),
         "baseline_npmi": result.evidence.get("baseline_npmi"),

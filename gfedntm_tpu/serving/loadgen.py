@@ -57,15 +57,31 @@ class ClosedLoopLoadGen:
         duration_s: float = 10.0,
         metrics=None,
         window_s: float = 1.0,
+        min_rounds: int | None = None,
+        max_duration_s: float | None = None,
     ):
+        """``min_rounds`` makes the run condition-driven: after the
+        ``duration_s`` floor, the load stays up until it has observed
+        that many DISTINCT model rounds in responses (or
+        ``max_duration_s`` elapses, default ``6 * duration_s``). Use it
+        for hot-swap acceptance — a fixed wall-clock window races the
+        trainer's round rate and the plane's swap cost, both of which
+        scale with machine load."""
         if concurrency < 1:
             raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+        if min_rounds is not None and min_rounds < 1:
+            raise ValueError(f"min_rounds must be >= 1, got {min_rounds}")
         self.infer_fn = infer_fn
         self.make_batch = make_batch
         self.concurrency = int(concurrency)
         self.duration_s = float(duration_s)
         self.metrics = metrics
         self.window_s = float(window_s)
+        self.min_rounds = None if min_rounds is None else int(min_rounds)
+        self.max_duration_s = float(
+            max_duration_s if max_duration_s is not None
+            else 6.0 * self.duration_s
+        )
         self._lock = threading.Lock()
         self._latencies: list[float] = []
         self._failures: list[str] = []
@@ -142,6 +158,13 @@ class ClosedLoopLoadGen:
         for w in workers:
             w.start()
         time.sleep(self.duration_s)
+        if self.min_rounds is not None:
+            hard = t_start + self.max_duration_s
+            while time.perf_counter() < hard:
+                with self._lock:
+                    if len(self._rounds_seen) >= self.min_rounds:
+                        break
+                time.sleep(min(0.25, self.window_s))
         stop.set()
         for w in workers:
             w.join(timeout=60.0)

@@ -136,7 +136,7 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     # straggler analytics
     # federation pacing (cohort sampling / buffered async; README
     # "Federation pacing")
-    "cohort_sampled": frozenset({"round", "k", "eligible"}),
+    "cohort_sampled": frozenset({"round", "k", "eligible", "q"}),
     "async_aggregated": frozenset({"round", "buffered", "admitted"}),
     "update_stale_discounted": frozenset(
         {"client", "round", "staleness", "factor"}
@@ -201,6 +201,18 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "alert_firing": frozenset({"alert", "metric", "threshold"}),
     "alert_resolved": frozenset({"alert"}),
     "fleet_overflow": frozenset({"node", "reason"}),
+    # privacy plane (README "Differential privacy & posterior sampling"):
+    # one dp_noise_applied per mechanism application (server FedLD /
+    # client DP-SGD), one privacy_budget ledger row per aggregated round
+    # (the accountant's running (eps, delta) — what the `privacy` CLI
+    # gate replays), and a once-per-transition budget-exceeded marker.
+    "dp_noise_applied": frozenset({"mode", "index", "std", "n", "dim"}),
+    "privacy_budget": frozenset(
+        {"round", "eps", "delta", "steps", "q", "sigma", "mode", "budget"}
+    ),
+    "privacy_budget_exceeded": frozenset(
+        {"round", "eps", "budget", "delta"}
+    ),
 }
 
 
@@ -716,6 +728,18 @@ SURVIVAL_EVENTS: tuple[str, ...] = (
     "relay_recovered",
     "member_rehomed",
     "journal_write_failed",
+)
+
+#: Privacy-plane events (DP mechanism applications + the accountant's
+#: per-round (eps, delta) ledger — README "Differential privacy &
+#: posterior sampling"). Same reverse-lint contract: graftlint verifies
+#: each keeps an emission call site, so the privacy ledger (which the
+#: `privacy` CI gate replays and the budget_monotone scenario contract
+#: asserts against) can never be silently disconnected.
+PRIVACY_EVENTS: tuple[str, ...] = (
+    "dp_noise_applied",
+    "privacy_budget",
+    "privacy_budget_exceeded",
 )
 
 
@@ -2049,7 +2073,58 @@ def format_quality_report(s: dict[str, Any]) -> str:
         for i, words in enumerate(s["topics"]):
             lines.append(f"  topic {i}: {' '.join(words[:10])}")
 
+    privacy = s.get("privacy")
+    if privacy:
+        lines.append("")
+        lines.append(format_privacy_line(privacy))
+
     return "\n".join(lines)
+
+
+def summarize_privacy(
+    records: "list[dict[str, Any]]",
+) -> "dict[str, Any] | None":
+    """Fold a stream's ``privacy_budget`` ledger into its final state
+    (the accountant's running (eps, delta) — README "Differential
+    privacy & posterior sampling"); ``None`` when the run carried no
+    ledger (``--dp off``)."""
+    last: dict[str, Any] | None = None
+    rounds = 0
+    exceeded = 0
+    for r in records:
+        event = r.get("event")
+        if event == "privacy_budget":
+            rounds += 1
+            last = r
+        elif event == "privacy_budget_exceeded":
+            exceeded += 1
+    if last is None:
+        return None
+    return {
+        "mode": last.get("mode"),
+        "eps": float(last.get("eps", 0.0)),
+        "delta": float(last.get("delta", 0.0)),
+        "sigma": float(last.get("sigma", 0.0)),
+        "steps": int(last.get("steps", rounds)),
+        "budget": float(last.get("budget", 0.0)),
+        "rounds": rounds,
+        "exceeded_events": exceeded,
+    }
+
+
+def format_privacy_line(p: "dict[str, Any]") -> str:
+    """One-line rendering of a :func:`summarize_privacy` dict."""
+    budget = (
+        f"budget {p['budget']:g}"
+        + (f", EXCEEDED x{p['exceeded_events']}"
+           if p.get("exceeded_events") else "")
+        if p.get("budget") else "budget untracked"
+    )
+    return (
+        f"privacy: dp={p['mode']} eps {p['eps']:.4g} at delta "
+        f"{p['delta']:g} after {p['steps']} noised round(s) "
+        f"(sigma {p['sigma']:g}, {budget})"
+    )
 
 
 # ---- Prometheus exposition + live ops endpoint ------------------------------

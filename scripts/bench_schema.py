@@ -61,6 +61,14 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
     # acceptance flags (>= 12 cells, all contracts green, the
     # dirichlet x crash x cohort headline cell present and green).
     "scenario_bench": ("bench", "rev", "cells", "acceptance"),
+    # scripts/dp_bench.py's BENCH_DP artifact object (README
+    # "Differential privacy & posterior sampling"): per-round wall-clock
+    # overhead of the server noise path (noise-on vs noise-off twins of
+    # the same aggregation) and device-vs-host noise-generation timing.
+    "dp_bench": (
+        "bench", "rev", "backend", "rounds", "noiseless_round_ms",
+        "noised_round_ms", "overhead_pct", "noise_gen", "acceptance",
+    ),
 }
 
 #: Fields a bench summary must ALSO carry when the named condition key is

@@ -54,7 +54,7 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_analysis.py tests/test_pacing.py \
     tests/test_survival.py tests/test_scaleout.py \
     tests/test_multichip.py tests/test_serving.py \
-    tests/test_scenarios.py \
+    tests/test_scenarios.py tests/test_privacy.py \
     tests/test_fleet_telemetry.py tests/test_slo.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
@@ -92,6 +92,36 @@ if env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli slo \
     exit 1
 fi
 rm -rf "$SLO_TMP"
+
+# Privacy CLI gate (README "Differential privacy & posterior sampling"):
+# the offline `privacy` subcommand must pass a budget-respecting ledger
+# (exit 0) and fail a budget-exceeding one (exit 1) — same inline-
+# fixture pattern as the slo gate above.
+echo "== privacy CLI gate =="
+DP_TMP=$(mktemp -d)
+env JAX_PLATFORMS=cpu python - "$DP_TMP" <<'PY' || exit 1
+import json, sys
+tmp = sys.argv[1]
+def ledger(path, eps_series, budget):
+    with open(path, "w") as fh:
+        for r, eps in enumerate(eps_series):
+            fh.write(json.dumps({
+                "event": "privacy_budget", "time": 1000.0 + r,
+                "node": "server", "round": r, "eps": eps,
+                "delta": 1e-5, "steps": r + 1, "q": 1.0,
+                "sigma": 2.0, "mode": "server", "budget": budget,
+            }) + "\n")
+ledger(f"{tmp}/good.jsonl", [0.4, 0.8, 1.1], budget=3.0)
+ledger(f"{tmp}/bad.jsonl", [1.4, 2.6, 3.9], budget=3.0)
+PY
+env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli privacy \
+    "$DP_TMP/good.jsonl" || exit 1
+if env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli privacy \
+    "$DP_TMP/bad.jsonl" >/dev/null 2>&1; then
+    echo "privacy CLI failed to flag a seeded budget violation" >&2
+    exit 1
+fi
+rm -rf "$DP_TMP"
 
 if [ "${SCENARIO:-0}" = "1" ]; then
     # Scenario-matrix smoke (README "Scenario matrix"): two fast cells

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""DP overhead bench: the BENCH_DP artifact (ISSUE 18).
+
+Measures what the server-side FedLD noise path costs where it actually
+runs — :meth:`ServerAggregator._mean` — by timing identical aggregation
+rounds with the noiser detached (the ``--dp off`` bitwise-no-op path)
+and attached, over a realistic update plane (8 clients, ~200k float32
+params). A second measurement times noise *generation* alone: the numpy
+host oracle vs the device path (jax threefry, per-shard ``fold_in``),
+plus the determinism check both paths must pass (draw ``i`` is a pure
+function of ``(seed, i)``).
+
+Acceptance bars (recorded in the artifact, asserted by the emitter):
+- the noise path costs <= 10 ms absolute per round at the bench plane
+  size (~200k params) — the bare weighted mean is sub-millisecond, so a
+  relative bar would only measure the mean's smallness; the bound that
+  matters is noise cost vs the >= 100 ms local-training floor of any
+  real round, where <= 10 ms is noise (pun intended);
+- both noise backends replay their streams exactly;
+- both backends land within 5% of the calibrated std.
+
+Usage:
+    python scripts/dp_bench.py            # -> BENCH_DP_r01.json
+    python scripts/dp_bench.py --quick    # fewer rounds, no artifact
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT_PATH = os.path.join(REPO, "BENCH_DP_r01.json")
+
+N_CLIENTS = 8
+SHAPES = {  # ~200k params across a few tensors, AVITM-shaped
+    "params/beta": (50, 2_000),
+    "params/inf_w1": (2_000, 40),
+    "params/inf_b1": (40,),
+    "params/mu_w": (40, 50),
+    "params/sigma_w": (40, 50),
+    "num_batches": (),  # int passthrough
+}
+
+
+def _snapshots():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    snaps = []
+    for i in range(N_CLIENTS):
+        params = {
+            k: rng.standard_normal(shape).astype(np.float32)
+            if k != "num_batches" else np.array(3 + i, np.int32)
+            for k, shape in SHAPES.items()
+        }
+        snaps.append((float(1 + i % 3), params))
+    return snaps
+
+
+def time_rounds(agg, snaps, rounds: int) -> float:
+    """Median per-round wall ms of ``agg._mean`` over the snapshots."""
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        agg._mean(snaps)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench_noise_gen(dim: int, std: float, reps: int) -> dict:
+    """Host-oracle vs device noise generation: wall ms + the parity
+    contract (exact per-path replay, std within 5% of calibration)."""
+    import numpy as np
+
+    from gfedntm_tpu.federation.device_agg import DeviceAggEngine, FlatPlane
+    from gfedntm_tpu.privacy import host_noise_vector
+
+    out: dict = {"dim": dim, "std": std}
+
+    t0 = time.perf_counter()
+    for i in range(reps):
+        host = host_noise_vector(dim, std, seed=11, index=i)
+    out["host_ms"] = round((time.perf_counter() - t0) * 1e3 / reps, 3)
+
+    engine = DeviceAggEngine()
+    plane = FlatPlane({"plane": np.zeros((dim,), np.float32)})
+    engine.noise_vector(plane, std=std, seed=11, index=0)  # compile
+    t0 = time.perf_counter()
+    for i in range(reps):
+        dev = engine.noise_vector(plane, std=std, seed=11, index=i)
+    out["device_ms"] = round((time.perf_counter() - t0) * 1e3 / reps, 3)
+
+    replay_host = host_noise_vector(dim, std, seed=11, index=reps - 1)
+    replay_dev = engine.noise_vector(
+        plane, std=std, seed=11, index=reps - 1
+    )
+    out["deterministic"] = bool(
+        np.array_equal(host, replay_host)
+        and np.array_equal(dev, replay_dev)
+    )
+    out["host_std_rel_err"] = round(
+        abs(float(host.std()) - std) / std, 4
+    )
+    out["device_std_rel_err"] = round(
+        abs(float(dev.std()) - std) / std, 4
+    )
+    return out
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv[1:]
+    rounds = 8 if quick else 40
+
+    from gfedntm_tpu.federation.aggregation import make_aggregator
+    from gfedntm_tpu.privacy import ServerNoiser, parse_dp
+    from scripts import bench_schema
+
+    snaps = _snapshots()
+    spec = parse_dp("server", clip=0.5, sigma=0.6, seed=17)
+
+    agg = make_aggregator("fedavg")
+    time_rounds(agg, snaps, 3)  # warm caches before either timing
+    noiseless_ms = time_rounds(agg, snaps, rounds)
+    agg.noiser = ServerNoiser(spec)
+    noised_ms = time_rounds(agg, snaps, rounds)
+    agg.noiser = None
+    noise_cost_ms = round(noised_ms - noiseless_ms, 3)
+    overhead_pct = round(
+        100.0 * (noised_ms - noiseless_ms) / max(noiseless_ms, 1e-9), 1
+    )
+
+    dim = sum(
+        int(math.prod(s)) for k, s in SHAPES.items() if k != "num_batches"
+    )
+    noise_gen = bench_noise_gen(dim, std=spec.sigma * spec.clip,
+                                reps=4 if quick else 20)
+
+    acceptance = {
+        "noise_cost_under_10ms": bool(noise_cost_ms <= 10.0),
+        "noise_streams_deterministic": bool(noise_gen["deterministic"]),
+        "std_calibrated_5pct": bool(
+            noise_gen["host_std_rel_err"] <= 0.05
+            and noise_gen["device_std_rel_err"] <= 0.05
+        ),
+    }
+    result = bench_schema.require({
+        "bench": "dp_overhead",
+        "rev": "r01",
+        "backend": "cpu",
+        "n_clients": N_CLIENTS,
+        "plane_elems": dim,
+        "sigma": spec.sigma,
+        "clip": spec.clip,
+        "rounds": rounds,
+        "noiseless_round_ms": round(noiseless_ms, 3),
+        "noised_round_ms": round(noised_ms, 3),
+        "noise_cost_ms": noise_cost_ms,
+        "overhead_pct": overhead_pct,
+        "noise_gen": noise_gen,
+        "acceptance": acceptance,
+    }, "dp_bench")
+
+    print(json.dumps(result, indent=1))
+    if quick:
+        return 0
+    with open(OUT_PATH, "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    print(f"wrote {OUT_PATH}", file=sys.stderr)
+    return 0 if all(acceptance.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,6 +30,7 @@ from gfedntm_tpu.analysis.rules.donation import DonationSafetyRule
 from gfedntm_tpu.analysis.rules.exceptions import ExceptionHygieneRule
 from gfedntm_tpu.analysis.rules.locks import LockDisciplineRule
 from gfedntm_tpu.analysis.rules.precision import PrecisionPinRule
+from gfedntm_tpu.analysis.rules.rng import RngDisciplineRule
 from gfedntm_tpu.analysis.rules.telemetry import TelemetryContractRule
 
 EVERYWHERE = ("",)  # path-prefix scope matching every fixture file
@@ -706,6 +707,90 @@ class TestExceptionHygiene:
         assert rule.applies_to("gfedntm_tpu/federation/server.py")
         assert rule.applies_to("gfedntm_tpu/utils/observability.py")
         assert not rule.applies_to("gfedntm_tpu/data/vocab.py")
+
+
+# ---------------------------------------------------------------------------
+# GL006 rng-discipline (PR 18, the privacy plane's noise paths)
+# ---------------------------------------------------------------------------
+
+class TestRngDiscipline:
+    def _lint(self, tmp_path, source: str):
+        return lint_src(
+            tmp_path, RngDisciplineRule(paths=EVERYWHERE), source,
+        )
+
+    def test_ambient_np_random_draw_flagged(self, tmp_path):
+        found = self._lint(tmp_path, """
+        import numpy as np
+
+        def noise(dim):
+            return np.random.normal(size=dim)
+        """)
+        assert len(found) == 1
+        assert "ambient global stream" in found[0].message
+        assert "default_rng((seed, index))" in found[0].hint
+
+    def test_ambient_seed_mutation_flagged(self, tmp_path):
+        """np.random.seed() MUTATES the global stream — as bad as
+        reading it (another library's draws get reordered)."""
+        found = self._lint(tmp_path, """
+        import numpy as np
+
+        def setup(seed):
+            np.random.seed(seed)
+        """)
+        assert len(found) == 1
+
+    def test_hardcoded_prngkey_literal_flagged(self, tmp_path):
+        found = self._lint(tmp_path, """
+        import jax
+
+        def noise():
+            return jax.random.normal(jax.random.PRNGKey(0), (4,))
+        """)
+        assert len(found) == 1
+        assert "hard-codes the PRNG key" in found[0].message
+
+    def test_seeded_generator_and_derived_key_clean(self, tmp_path):
+        assert self._lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def host_noise(dim, seed, index):
+            rng = np.random.default_rng((seed, index))
+            return rng.standard_normal(dim)
+
+        def device_key(seed, shard):
+            return jax.random.fold_in(
+                jax.random.PRNGKey(int(seed)), shard
+            )
+
+        def entropy(seed):
+            return np.random.SeedSequence(seed)
+        """) == []
+
+    def test_generator_method_draws_clean(self, tmp_path):
+        """rng.normal() on an explicit Generator is the sanctioned
+        spelling — only the MODULE-level np.random.* draws are ambient."""
+        assert self._lint(tmp_path, """
+        import numpy as np
+
+        def noise(rng: np.random.Generator, dim):
+            return rng.normal(size=dim)
+        """) == []
+
+    def test_scope_covers_noise_paths_only(self):
+        rule = RngDisciplineRule()
+        assert rule.applies_to("gfedntm_tpu/privacy/mechanisms.py")
+        assert rule.applies_to("gfedntm_tpu/federation/device_agg.py")
+        assert rule.applies_to("gfedntm_tpu/federation/aggregation.py")
+        assert not rule.applies_to("gfedntm_tpu/data/synthetic.py")
+        assert not rule.applies_to("tests/test_privacy.py")
+
+    def test_registered_in_default_rules(self):
+        assert any(
+            r.name == "rng-discipline" for r in make_default_rules()
+        )
 
 
 # ---------------------------------------------------------------------------

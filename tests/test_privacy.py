@@ -1,0 +1,712 @@
+"""Privacy plane (tier-1, ISSUE 18).
+
+Covers the RDP/moments accountant (closed-form single-round pins, RDP-
+vs-naive composition, subsampling monotonicity, cohort amplification,
+state round-trips), the noise mechanisms (host oracle determinism,
+ServerNoiser / ClientSanitizer semantics, the device/host parity
+contract: per-path determinism + distributional match), the server
+integration (status / ledger events / budget-exceeded transition /
+gate tightening / recovery catch-up step / noise-aware collapse guard),
+the ``--dp off`` bitwise no-op, and the offline ``privacy`` CLI gate.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import build_parser, main as cli_main
+from gfedntm_tpu.eval.monitor import TopicQualityMonitor
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation.aggregation import (
+    make_aggregator,
+    weighted_mean,
+)
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.device_agg import DeviceAggEngine, FlatPlane
+from gfedntm_tpu.federation.server import (
+    DP_GUARD_NOISE_FLOOR,
+    FederatedServer,
+)
+from gfedntm_tpu.privacy import (
+    ALPHAS,
+    ClientSanitizer,
+    DPSpec,
+    PrivacyAccountant,
+    ServerNoiser,
+    eps_from_rdp,
+    gaussian_rdp,
+    host_noise_vector,
+    parse_dp,
+    subsampled_gaussian_rdp,
+)
+from gfedntm_tpu.utils.observability import (
+    MetricsLogger,
+    summarize_privacy,
+)
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _server(tmp_path, **kw):
+    kw.setdefault("min_clients", 2)
+    kw.setdefault("family", "avitm")
+    kw.setdefault("model_kwargs", MODEL_KWARGS)
+    kw.setdefault("max_iters", 5)
+    kw.setdefault("save_dir", str(tmp_path))
+    return FederatedServer(**kw)
+
+
+# ---------------------------------------------------------------------------
+# accountant math
+# ---------------------------------------------------------------------------
+
+class TestAccountantMath:
+    def test_gaussian_rdp_closed_form(self):
+        assert gaussian_rdp(2, 1.0) == pytest.approx(1.0)
+        assert gaussian_rdp(8, 2.0) == pytest.approx(1.0)
+        assert gaussian_rdp(3, 0.0) == math.inf
+
+    def test_single_round_eps_pins_continuous_bound(self):
+        """One full-batch Gaussian round at sigma=4, delta=1e-5: the
+        integer-alpha grid must land at (or a hair above, grid
+        quantization) the continuous-alpha optimum
+        ``1/(2 sigma^2) + sqrt(2 log(1/delta)) / sigma``."""
+        sigma, delta = 4.0, 1e-5
+        acct = PrivacyAccountant(sigma=sigma, delta=delta)
+        eps = acct.step(q=1.0)
+        star = 1.0 / (2 * sigma * sigma) + math.sqrt(
+            2 * math.log(1 / delta)
+        ) / sigma
+        assert star <= eps <= star * 1.01
+
+    def test_subsampled_reduces_to_full_at_q1_and_zero_at_q0(self):
+        for alpha in (2, 7, 33):
+            assert subsampled_gaussian_rdp(alpha, 1.0, 2.0) == (
+                pytest.approx(gaussian_rdp(alpha, 2.0))
+            )
+            assert subsampled_gaussian_rdp(alpha, 0.0, 2.0) == 0.0
+
+    def test_subsampled_monotone_in_q(self):
+        """More inclusion can never cost less privacy: the bound is
+        nondecreasing in q at every tracked order."""
+        qs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0]
+        for alpha in (2, 5, 16, 64):
+            costs = [
+                subsampled_gaussian_rdp(alpha, q, 1.5) for q in qs
+            ]
+            assert costs == sorted(costs)
+            assert all(c >= 0.0 for c in costs)
+
+    def test_rdp_composition_beats_naive_eps_summing(self):
+        """T rounds composed in RDP spend less than T times the
+        single-round eps — the whole point of the moments accountant."""
+        one = PrivacyAccountant(sigma=2.0).step()
+        acct = PrivacyAccountant(sigma=2.0)
+        for _ in range(20):
+            eps = acct.step()
+        assert eps < 20 * one
+
+    def test_cohort_amplification(self):
+        """Equal rounds, equal noise: the cohort-sampled run (q=0.5)
+        spends strictly less than the sync run (q=1) — privacy
+        amplification by subsampling, the pacing-engine payoff."""
+        cohort = PrivacyAccountant(sigma=4.0)
+        sync = PrivacyAccountant(sigma=4.0)
+        for _ in range(10):
+            eps_cohort = cohort.step(q=0.5)
+            eps_sync = sync.step(q=1.0)
+        assert eps_cohort < eps_sync
+        # pin the verified values so the math cannot silently drift
+        assert eps_cohort == pytest.approx(2.1757, abs=5e-3)
+        assert eps_sync == pytest.approx(4.1063, abs=5e-3)
+
+    def test_zero_steps_zero_eps(self):
+        acct = PrivacyAccountant(sigma=1.0)
+        assert acct.epsilon() == 0.0
+        assert not acct.exceeded
+
+    def test_exceeded_flips_only_past_budget(self):
+        acct = PrivacyAccountant(sigma=4.0, budget=2.0)
+        acct.step()
+        assert not acct.exceeded
+        for _ in range(10):
+            acct.step()
+        assert acct.exceeded
+
+    def test_state_roundtrip_is_exact_and_continues(self):
+        acct = PrivacyAccountant(sigma=3.0, delta=1e-6, budget=5.0)
+        for q in (1.0, 0.4, 0.7):
+            acct.step(q=q)
+        state = json.loads(json.dumps(acct.state_dict()))
+        fresh = PrivacyAccountant(
+            sigma=3.0, delta=1e-6, budget=5.0
+        )
+        fresh.load_state_dict(state)
+        assert fresh.epsilon() == pytest.approx(acct.epsilon(), rel=0,
+                                                abs=0)
+        assert fresh.steps == acct.steps
+        assert fresh.last_q == acct.last_q
+        # the restored ledger composes FORWARD from the spent budget
+        before = fresh.epsilon()
+        assert fresh.step() > before
+
+    def test_restore_missing_orders_falls_back_conservatively(self):
+        acct = PrivacyAccountant(sigma=2.0)
+        acct.step()
+        state = acct.state_dict()
+        worst = max(state["rdp"].values())
+        state["rdp"] = {"2": state["rdp"]["2"], "64": state["rdp"]["64"]}
+        fresh = PrivacyAccountant(sigma=2.0)
+        fresh.load_state_dict(state)
+        # absent orders restart at the maximum already spent, never 0
+        assert all(
+            fresh._rdp[a] >= min(worst, gaussian_rdp(a, 2.0)) or
+            fresh._rdp[a] == worst
+            for a in ALPHAS
+        )
+        assert fresh._rdp[33] == worst
+
+    def test_unknown_ledger_version_rejected(self):
+        acct = PrivacyAccountant(sigma=1.0)
+        with pytest.raises(ValueError):
+            acct.load_state_dict({"version": 9, "steps": 1, "rdp": {}})
+
+    def test_eps_from_rdp_validates_delta(self):
+        with pytest.raises(ValueError):
+            eps_from_rdp({2: 1.0}, 0.0)
+        with pytest.raises(ValueError):
+            eps_from_rdp({2: 1.0}, 1.0)
+
+    def test_parse_dp_validation(self):
+        assert parse_dp(None).mode == "off"
+        assert parse_dp("off", sigma=-3.0).mode == "off"  # off ignores
+        spec = parse_dp("server", clip=0.5, sigma=2.0, budget=3.0)
+        assert spec == DPSpec("server", clip=0.5, sigma=2.0, budget=3.0)
+        assert parse_dp(spec) is spec
+        with pytest.raises(ValueError):
+            parse_dp("sideways")
+        with pytest.raises(ValueError):
+            parse_dp("server", clip=0.0, sigma=1.0)
+        with pytest.raises(ValueError):
+            parse_dp("client", sigma=0.0)
+        with pytest.raises(ValueError):
+            parse_dp("server", sigma=1.0, delta=1.5)
+        with pytest.raises(ValueError):
+            parse_dp("server", sigma=1.0, budget=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# noise mechanisms
+# ---------------------------------------------------------------------------
+
+AVG = {
+    "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+    "b": np.ones((5,), np.float32),
+    "n": np.array(7, np.int32),  # num_batches-style int passthrough
+}
+
+
+class TestNoiseMechanisms:
+    def test_host_oracle_deterministic_per_key(self):
+        v1 = host_noise_vector(64, 0.5, seed=3, index=2)
+        v2 = host_noise_vector(64, 0.5, seed=3, index=2)
+        np.testing.assert_array_equal(v1, v2)
+        assert v1.dtype == np.float32
+        # distinct index / seed / extra => distinct stream
+        assert np.any(v1 != host_noise_vector(64, 0.5, seed=3, index=3))
+        assert np.any(v1 != host_noise_vector(64, 0.5, seed=4, index=2))
+        assert np.any(
+            v1 != host_noise_vector(64, 0.5, seed=3, index=2, extra=(1,))
+        )
+
+    def test_server_noiser_requires_server_spec(self):
+        with pytest.raises(ValueError):
+            ServerNoiser(parse_dp("client", sigma=1.0))
+
+    def test_server_noise_std_scales_with_cohort(self):
+        noiser = ServerNoiser(parse_dp("server", clip=0.5, sigma=2.0))
+        assert noiser.noise_std(1) == pytest.approx(1.0)
+        assert noiser.noise_std(4) == pytest.approx(0.25)
+        assert noiser.noise_std(0) == pytest.approx(1.0)  # max(1, n)
+
+    def test_server_noiser_noises_f32_only_and_is_deterministic(self):
+        spec = parse_dp("server", clip=0.5, sigma=2.0, seed=11)
+        metrics = MetricsLogger(validate=True)
+        noiser = ServerNoiser(spec, metrics=metrics)
+        out = noiser.apply(dict(AVG), 4)
+        # int tensors pass through untouched; f32 tensors moved
+        np.testing.assert_array_equal(out["n"], AVG["n"])
+        assert np.any(out["a"] != AVG["a"])
+        assert np.any(out["b"] != AVG["b"])
+        assert noiser.applications == 1
+        # draw 0 is a pure function of (seed, 0): fresh noiser replays it
+        again = ServerNoiser(spec).apply(dict(AVG), 4)
+        np.testing.assert_array_equal(out["a"], again["a"])
+        np.testing.assert_array_equal(out["b"], again["b"])
+        # successive applications draw fresh noise
+        third = noiser.apply(dict(AVG), 4)
+        assert np.any(third["a"] != out["a"])
+        evs = metrics.events("dp_noise_applied")
+        assert [e["index"] for e in evs] == [0, 1]
+        ev = evs[0]
+        assert ev["mode"] == "server" and ev["backend"] == "host"
+        assert ev["n"] == 4 and ev["dim"] == 17
+        assert ev["std"] == pytest.approx(0.25)
+
+    def test_aggregator_without_noiser_is_bitwise_noop(self):
+        """--dp off constructs no mechanism at all: the mean stage's
+        output is bitwise the plain weighted mean."""
+        agg = make_aggregator("fedavg")
+        assert agg.noiser is None
+        snaps = [
+            (float(i + 1), {"a": np.full((3, 4), float(i), np.float32)})
+            for i in range(3)
+        ]
+        plain = agg._mean(snaps)
+        np.testing.assert_array_equal(
+            plain["a"], weighted_mean(snaps)["a"]
+        )
+        # a noiser moves the same input; clearing it restores bitwise
+        agg.noiser = ServerNoiser(
+            parse_dp("server", clip=0.5, sigma=2.0)
+        )
+        assert np.any(agg._mean(snaps)["a"] != plain["a"])
+        agg.noiser = None
+        np.testing.assert_array_equal(agg._mean(snaps)["a"], plain["a"])
+
+    def test_client_sanitizer_clips_to_ball(self):
+        """A delta far outside the clip ball comes back ON the ball
+        (plus bounded noise): ||sanitized - ref|| ~= clip."""
+        spec = parse_dp("client", clip=0.5, sigma=0.01, seed=2)
+        san = ClientSanitizer(spec, client_id=3)
+        ref = {"a": np.zeros((40,), np.float32)}
+        params = {"a": np.full((40,), 2.0, np.float32)}  # ||d|| ~ 12.6
+        out = san.apply(params, ref, 1)
+        norm = float(np.linalg.norm(
+            np.asarray(out["a"], np.float64)
+        ))
+        # noise std = sigma*clip = 0.005 per coord; 40 coords => the
+        # noise shifts the norm by << 0.1
+        assert norm == pytest.approx(0.5, abs=0.1)
+        assert out["a"].dtype == np.float32
+
+    def test_client_sanitizer_small_delta_unclipped(self):
+        spec = parse_dp("client", clip=10.0, sigma=0.001, seed=2)
+        san = ClientSanitizer(spec, client_id=0)
+        ref = {"a": np.zeros((8,), np.float32)}
+        params = {"a": np.full((8,), 0.25, np.float32)}
+        out = san.apply(params, ref, 1)
+        np.testing.assert_allclose(out["a"], params["a"], atol=0.1)
+
+    def test_client_sanitizer_deterministic_and_decorrelated(self):
+        spec = parse_dp("client", clip=1.0, sigma=0.5, seed=7)
+        ref = {"a": np.zeros((16,), np.float32)}
+        params = {"a": np.full((16,), 0.1, np.float32)}
+        a = ClientSanitizer(spec, client_id=1).apply(params, ref, 1)
+        b = ClientSanitizer(spec, client_id=1).apply(params, ref, 1)
+        np.testing.assert_array_equal(a["a"], b["a"])
+        # a different client draws an independent stream
+        c = ClientSanitizer(spec, client_id=2).apply(params, ref, 1)
+        assert np.any(a["a"] != c["a"])
+
+    def test_client_sanitizer_index_advances_per_uplink(self):
+        """Two uplinks at the SAME base round still draw distinct noise
+        (the draw is keyed by the application counter, not the round) —
+        reused noise across uplinks would correlate them."""
+        spec = parse_dp("client", clip=1.0, sigma=0.5, seed=7)
+        metrics = MetricsLogger(validate=True)
+        san = ClientSanitizer(spec, client_id=1, metrics=metrics)
+        ref = {"a": np.zeros((16,), np.float32)}
+        params = {"a": np.full((16,), 0.1, np.float32)}
+        first = san.apply(params, ref, 5)
+        second = san.apply(params, ref, 5)
+        assert np.any(first["a"] != second["a"])
+        evs = metrics.events("dp_noise_applied")
+        assert [e["index"] for e in evs] == [0, 1]
+        assert all(e["mode"] == "client" and e["round"] == 5
+                   for e in evs)
+
+    def test_client_sanitizer_requires_client_spec(self):
+        with pytest.raises(ValueError):
+            ClientSanitizer(parse_dp("server", sigma=1.0))
+
+    def test_client_dp_wiring(self):
+        def _client(**kw):
+            return Client(
+                client_id=2, corpus=RawCorpus(documents=["a b", "c d"]),
+                server_address="localhost:1", **kw,
+            )
+
+        c = _client(dp="client", dp_clip=0.5, dp_sigma=0.3, dp_seed=9)
+        assert c._dp_sanitizer is not None
+        assert c._dp_sanitizer.client_id == 2
+        assert c.dp.sigma == 0.3
+        assert _client()._dp_sanitizer is None
+        # a client handed the SERVER-side spec applies nothing locally
+        assert _client(dp="server", dp_sigma=0.3)._dp_sanitizer is None
+
+
+# ---------------------------------------------------------------------------
+# device/host parity
+# ---------------------------------------------------------------------------
+
+class TestDeviceHostParity:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return DeviceAggEngine()
+
+    def test_device_path_deterministic(self, engine):
+        plane = FlatPlane({"a": np.zeros((64, 64), np.float32)})
+        v1 = engine.noise_vector(plane, std=0.5, seed=3, index=2)
+        v2 = engine.noise_vector(plane, std=0.5, seed=3, index=2)
+        np.testing.assert_array_equal(v1, v2)
+        assert v1.shape == (plane.dim,)
+        assert np.any(
+            v1 != engine.noise_vector(plane, std=0.5, seed=3, index=3)
+        )
+
+    def test_distributional_parity_with_host_oracle(self, engine):
+        """The two PRNGs are deliberately bitwise-off; the parity
+        contract is distributional — zero mean, matching std — because
+        the accountant's guarantee depends only on the std."""
+        dim, std = 96 * 96, 0.5
+        plane = FlatPlane({"a": np.zeros((96, 96), np.float32)})
+        dev = engine.noise_vector(plane, std=std, seed=3, index=0)
+        host = host_noise_vector(dim, std, seed=3, index=0)
+        assert np.any(dev != host)  # documented: different algorithms
+        tol = 4 * std / math.sqrt(dim)  # 4-sigma band on the mean
+        for vec in (dev, host):
+            assert abs(float(vec.mean())) < tol
+            assert float(vec.std()) == pytest.approx(std, rel=0.05)
+
+    def test_server_noiser_device_backend(self, engine):
+        spec = parse_dp("server", clip=0.5, sigma=2.0, seed=11)
+        metrics = MetricsLogger(validate=True)
+        noiser = ServerNoiser(spec, device_engine=engine,
+                              metrics=metrics)
+        out = noiser.apply(dict(AVG), 2)
+        np.testing.assert_array_equal(out["n"], AVG["n"])
+        assert np.any(out["a"] != AVG["a"])
+        again = ServerNoiser(spec, device_engine=engine).apply(
+            dict(AVG), 2
+        )
+        np.testing.assert_array_equal(out["a"], again["a"])
+        (ev,) = metrics.events("dp_noise_applied")
+        assert ev["backend"] == "device"
+
+
+# ---------------------------------------------------------------------------
+# noise-aware collapse guard
+# ---------------------------------------------------------------------------
+
+BLOCKS = [[f"b{b}w{i:02d}" for i in range(8)] for b in range(3)]
+VOCAB = [w for block in BLOCKS for w in block]
+ID2TOKEN = dict(enumerate(VOCAB))
+
+
+def _ref_corpus(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        list(rng.choice(BLOCKS[i % 3], size=8)) for i in range(n)
+    ]
+
+
+def _block_beta():
+    beta = np.full((3, 24), -2.0)
+    for k in range(3):
+        beta[k, 8 * k:8 * (k + 1)] = 2.0
+    return beta
+
+
+def _one_corrupt_beta():
+    beta = _block_beta()
+    beta[1] = np.random.default_rng(3).normal(size=(3, 24))[1]
+    return beta
+
+
+def _mixed_beta():
+    return np.random.default_rng(0).normal(size=(3, 24))
+
+
+class TestNoiseAwareGuard:
+    def _monitor(self, **kw):
+        kw.setdefault("every", 1)
+        kw.setdefault("id2token", ID2TOKEN)
+        kw.setdefault("ref_tokens", _ref_corpus())
+        kw.setdefault("topn", 6)
+        kw.setdefault("guard_patience", 1)
+        kw.setdefault("guard_drop", 0.5)
+        kw.setdefault("guard_floor", 0.1)
+        return TopicQualityMonitor(**kw)
+
+    def _warm(self, mon):
+        for r in range(3):
+            mon.observe(r, {"params/beta": _block_beta()})
+        assert not mon.collapsed
+
+    def test_noise_floor_tolerates_dp_jitter(self):
+        """A moderate NPMI dip (~0.4, DP-jitter scale at the published
+        sigmas) fires the bare guard but NOT the noise-aware one."""
+        bare = self._monitor()
+        self._warm(bare)
+        bare.observe(3, {"params/beta": _one_corrupt_beta()})
+        assert bare.collapsed
+
+        tolerant = self._monitor(noise_floor=0.2)
+        self._warm(tolerant)
+        tolerant.observe(3, {"params/beta": _one_corrupt_beta()})
+        assert not tolerant.collapsed
+
+    def test_noise_floor_still_catches_real_collapse(self):
+        """The slack is additive, not a disable: a genuine collapse
+        (NPMI cliff ~1.0) fires straight through the noise floor."""
+        mon = self._monitor(noise_floor=0.2)
+        self._warm(mon)
+        mon.observe(3, {"params/beta": _mixed_beta()})
+        assert mon.collapsed
+
+    def test_negative_noise_floor_rejected(self):
+        with pytest.raises(ValueError):
+            self._monitor(noise_floor=-0.1)
+
+    def test_status_surfaces_noise_floor(self):
+        mon = self._monitor(noise_floor=0.2)
+        mon.observe(0, {"params/beta": _block_beta()})
+        assert mon.status()["noise_floor"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# server integration
+# ---------------------------------------------------------------------------
+
+class TestServerIntegration:
+    def test_dp_off_constructs_nothing(self, tmp_path):
+        s = _server(tmp_path)
+        assert s.dp.mode == "off"
+        assert s.privacy_accountant is None
+        assert s._dp_noiser is None
+        assert s.aggregator.noiser is None
+        assert s._status()["privacy"] is None
+        assert "privacy" not in s._state_extra()
+
+    def test_dp_server_wires_noiser_and_tightens_gate(self, tmp_path):
+        s = _server(
+            tmp_path, dp="server", dp_clip=0.5, dp_sigma=2.0,
+            dp_budget=3.0,
+        )
+        assert s.aggregator.noiser is s._dp_noiser
+        assert s._dp_noiser.spec.clip == 0.5
+        # PR 5 gate-clip reuse: every admitted update sits in the ball
+        # the sensitivity analysis assumes
+        assert s.update_gate.max_update_norm == pytest.approx(0.5)
+        assert s._status()["privacy"]["mode"] == "server"
+        assert s._state_extra()["privacy"]["steps"] == 0
+
+    def test_dp_client_mode_accounts_without_server_noise(self,
+                                                          tmp_path):
+        s = _server(tmp_path, dp="client", dp_sigma=1.0)
+        assert s.privacy_accountant is not None
+        assert s.privacy_accountant.mode == "client"
+        assert s._dp_noiser is None
+        assert s.aggregator.noiser is None
+
+    def test_privacy_tick_logs_ledger(self, tmp_path):
+        metrics = MetricsLogger(validate=True, node="server")
+        s = _server(
+            tmp_path, dp="server", dp_clip=0.5, dp_sigma=4.0,
+            metrics=metrics,
+        )
+        s._fleet_tick(0)
+        s._fleet_tick(1)
+        evs = metrics.events("privacy_budget")
+        assert [e["round"] for e in evs] == [0, 1]
+        assert evs[0]["eps"] > 0
+        assert evs[1]["eps"] > evs[0]["eps"]  # monotone
+        assert evs[0]["q"] == 1.0  # no engine: conservative q
+        assert metrics.registry.get("privacy_eps").value == (
+            pytest.approx(evs[1]["eps"])
+        )
+        assert s._status()["privacy"]["steps"] == 2
+
+    def test_budget_exceeded_transition_fires_once(self, tmp_path):
+        metrics = MetricsLogger(validate=True, node="server")
+        s = _server(
+            tmp_path, dp="server", dp_clip=0.5, dp_sigma=1.0,
+            dp_budget=0.5, metrics=metrics,
+        )
+        for r in range(4):
+            s._fleet_tick(r)
+        assert s.privacy_accountant.exceeded
+        exceeded = metrics.events("privacy_budget_exceeded")
+        assert len(exceeded) == 1  # edge-triggered, not level
+        assert metrics.registry.get(
+            "privacy_budget_exceeded"
+        ).value == 1
+
+    def test_restore_privacy_charges_catchup_step(self, tmp_path):
+        s1 = _server(
+            tmp_path / "a", dp="server", dp_clip=0.5, dp_sigma=2.0,
+        )
+        s1._fleet_tick(0)
+        s1._fleet_tick(1)
+        state = s1._state_extra()["privacy"]
+        eps_before = s1.privacy_accountant.epsilon()
+
+        s2 = _server(
+            tmp_path / "b", dp="server", dp_clip=0.5, dp_sigma=2.0,
+        )
+        s2._restore_privacy(json.loads(json.dumps(state)))
+        # the journal can lag the released noise by one round, so the
+        # restored ledger charges one conservative catch-up step...
+        assert s2.privacy_accountant.steps == 3
+        assert s2.privacy_accountant.epsilon() > eps_before
+        # ...and the noise stream index skips past any draw the dead
+        # process may have spent — recovery never reuses a draw.
+        assert s2._dp_noiser.applications == 3
+
+    def test_restore_privacy_without_dp_is_loud_not_fatal(
+        self, tmp_path, caplog
+    ):
+        s = _server(tmp_path)
+        with caplog.at_level("WARNING"):
+            s._restore_privacy({"steps": 3, "mode": "server"})
+        assert s.privacy_accountant is None
+        assert any("unaccounted" in r.message for r in caplog.records)
+
+    def test_restore_privacy_none_is_noop(self, tmp_path):
+        s = _server(tmp_path, dp="server", dp_sigma=2.0)
+        s._restore_privacy(None)
+        assert s.privacy_accountant.steps == 0
+
+    def test_quality_guard_gets_noise_floor_under_dp(self, tmp_path):
+        from gfedntm_tpu.data.vocab import Vocabulary
+
+        s = _server(
+            tmp_path, dp="server", dp_sigma=2.0, quality_every=1,
+        )
+        s.global_vocab = Vocabulary(tuple(VOCAB))
+        assert s._ensure_quality_monitor().noise_floor == (
+            pytest.approx(DP_GUARD_NOISE_FLOOR)
+        )
+        # operator override wins
+        s2 = _server(
+            tmp_path / "o", dp="server", dp_sigma=2.0, quality_every=1,
+            quality_monitor_kwargs={"noise_floor": 0.0},
+        )
+        s2.global_vocab = Vocabulary(tuple(VOCAB))
+        assert s2._ensure_quality_monitor().noise_floor == 0.0
+        # dp off: no slack injected
+        s3 = _server(tmp_path / "p", quality_every=1)
+        s3.global_vocab = Vocabulary(tuple(VOCAB))
+        assert s3._ensure_quality_monitor().noise_floor == 0.0
+
+    def test_cli_dp_flags_route_to_server(self):
+        args = build_parser().parse_args([
+            "--dp", "server", "--dp_clip", "0.5", "--dp_sigma", "2.0",
+            "--dp_budget", "3.0", "--dp_seed", "4",
+        ])
+        assert args.dp == "server"
+        assert args.dp_clip == 0.5
+        assert args.dp_sigma == 2.0
+        assert args.dp_budget == 3.0
+        assert args.dp_seed == 4
+        assert build_parser().parse_args([]).dp == "off"
+
+
+# ---------------------------------------------------------------------------
+# offline `privacy` CLI gate + summaries
+# ---------------------------------------------------------------------------
+
+def _write_ledger(path, rows, node="server"):
+    with open(path, "w") as fh:
+        for i, row in enumerate(rows):
+            rec = {
+                "event": "privacy_budget", "time": float(i),
+                "node": node, "round": i, "delta": 1e-5, "steps": i + 1,
+                "q": 1.0, "sigma": 2.0, "mode": "server", "budget": 0.0,
+            }
+            rec.update(row)
+            fh.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+class TestPrivacyCLI:
+    def test_clean_ledger_passes(self, tmp_path, capsys):
+        p = _write_ledger(
+            tmp_path / "m.jsonl",
+            [{"eps": 0.5}, {"eps": 1.0}, {"eps": 1.4}],
+        )
+        out_json = tmp_path / "state.json"
+        assert cli_main(
+            ["privacy", p, "--json", str(out_json)]
+        ) == 0
+        state = json.loads(out_json.read_text())
+        assert state["eps"] == pytest.approx(1.4)
+        assert state["rounds"] == 3
+        assert state["failures"] == []
+        assert "privacy check passed" in capsys.readouterr().out
+
+    def test_budget_override_gates(self, tmp_path, capsys):
+        p = _write_ledger(
+            tmp_path / "m.jsonl", [{"eps": 0.5}, {"eps": 1.4}],
+        )
+        assert cli_main(["privacy", p, "--budget", "2.0"]) == 0
+        assert cli_main(["privacy", p, "--budget", "1.0"]) == 1
+        assert "budget exceeded" in capsys.readouterr().err
+
+    def test_declared_budget_and_exceeded_events_gate(
+        self, tmp_path, capsys
+    ):
+        p = _write_ledger(
+            tmp_path / "m.jsonl",
+            [{"eps": 0.5, "budget": 1.0}, {"eps": 1.4, "budget": 1.0}],
+        )
+        assert cli_main(["privacy", p]) == 1
+        # an exceeded EVENT also fails even when the final row's
+        # declared budget is 0 (track-only runs that still logged one)
+        p2 = _write_ledger(tmp_path / "m2.jsonl", [{"eps": 0.5}])
+        with open(p2, "a") as fh:
+            fh.write(json.dumps({
+                "event": "privacy_budget_exceeded", "time": 9.0,
+                "node": "server", "round": 0, "eps": 0.5,
+                "budget": 0.4, "delta": 1e-5,
+            }) + "\n")
+        assert cli_main(["privacy", p2]) == 1
+
+    def test_non_monotone_ledger_fails(self, tmp_path, capsys):
+        p = _write_ledger(
+            tmp_path / "m.jsonl",
+            [{"eps": 0.5}, {"eps": 1.4}, {"eps": 0.9}],
+        )
+        assert cli_main(["privacy", p]) == 1
+        assert "not monotone" in capsys.readouterr().err
+
+    def test_empty_stream_semantics(self, tmp_path, capsys):
+        p = tmp_path / "m.jsonl"
+        p.write_text(json.dumps(
+            {"event": "round_averaged", "time": 0.0, "node": "server"}
+        ) + "\n")
+        assert cli_main(["privacy", str(p)]) == 0
+        # declaring a budget over a dp-less stream is the loud failure
+        assert cli_main(["privacy", str(p), "--budget", "1.0"]) == 1
+
+    def test_summarize_privacy_helper(self, tmp_path):
+        records = [
+            {"event": "privacy_budget", "round": r, "eps": 0.5 * (r + 1),
+             "delta": 1e-5, "steps": r + 1, "q": 1.0, "sigma": 2.0,
+             "mode": "server", "budget": 3.0}
+            for r in range(3)
+        ]
+        p = summarize_privacy(records)
+        assert p["eps"] == pytest.approx(1.5)
+        assert p["rounds"] == 3
+        assert p["mode"] == "server"
+        assert summarize_privacy(
+            [{"event": "round_averaged"}]
+        ) is None

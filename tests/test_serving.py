@@ -783,6 +783,36 @@ class TestServeCli:
         assert args.no_quality_gate is False
 
 
+def test_loadgen_min_rounds_extends_past_duration():
+    """With ``min_rounds`` set, the run extends past the ``duration_s``
+    floor until the load has observed that many distinct model rounds —
+    and stops there, not at ``max_duration_s``."""
+    t0 = time.perf_counter()
+    lock = threading.Lock()
+
+    def infer(x):
+        # The "model" advances a round every 0.3 s of wall clock, so a
+        # 0.2 s floor can only ever see round 0 — reaching 3 distinct
+        # rounds REQUIRES the condition-driven extension.
+        with lock:
+            rnd = int((time.perf_counter() - t0) / 0.3)
+        return np.full((x.shape[0], 3), 1 / 3, np.float32), rnd
+
+    gen = ClosedLoopLoadGen(
+        infer, lambda w, s: np.zeros((2, 5), np.float32),
+        concurrency=2, duration_s=0.2, min_rounds=3, max_duration_s=10.0,
+    )
+    summary = gen.run()
+    assert summary["swaps_observed"] >= 2, summary["model_rounds_seen"]
+    assert 0.2 < summary["duration_s"] < 5.0
+    assert summary["failures"] == 0
+
+    with pytest.raises(ValueError):
+        ClosedLoopLoadGen(
+            infer, lambda w, s: None, duration_s=0.1, min_rounds=0,
+        )
+
+
 # ---- end to end: live federation + hot-swapping serve + closed loop ---------
 
 def _run_clients(clients):
@@ -816,7 +846,10 @@ def test_e2e_hot_swap_under_live_load(tmp_path):
     ]
     port = _free_port()
     srv_dir = str(tmp_path / "fed")
-    kwargs = dict(MODEL_KWARGS, num_epochs=20)
+    # Enough epochs (~200 rounds) that the federation outlasts the load
+    # window even on a fast box — the plane must still be swapping while
+    # the load generator watches for its 2 swaps.
+    kwargs = dict(MODEL_KWARGS, num_epochs=40)
     ms = MetricsLogger(str(tmp_path / "server.jsonl"), validate=True)
     server = FederatedServer(
         min_clients=2, family="avitm", model_kwargs=kwargs, max_iters=300,
@@ -856,9 +889,14 @@ def test_e2e_hot_swap_under_live_load(tmp_path):
                 0, 3, size=(4, vocab_size)
             ).astype(np.float32)
 
+        # Condition-driven window: at least 6 s of load, extended until
+        # the responses have ridden through >= 3 distinct model rounds
+        # (>= 2 swaps) or the 45 s cap — a fixed window races the
+        # trainer's round rate against the swap cost, and both scale
+        # with machine load.
         gen = ClosedLoopLoadGen(
             infer, make_batch, concurrency=4, duration_s=6.0,
-            metrics=mserve,
+            metrics=mserve, min_rounds=3, max_duration_s=45.0,
         )
         summary = gen.run()
         infer.channel.close()
