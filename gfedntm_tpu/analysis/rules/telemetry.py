@@ -10,11 +10,12 @@ PRs 1/5/7 caught by hand:
   first time a test constructs ``MetricsLogger(validate=True)``;
 - reverse-lint: every DATA_PLANE_EVENTS + MODEL_QUALITY_EVENTS +
   SCALEOUT_EVENTS + SERVING_EVENTS + SCENARIO_EVENTS + FLEET_EVENTS +
-  SURVIVAL_EVENTS + PRIVACY_EVENTS entry keeps BOTH a schema
-  registration and at least
+  SURVIVAL_EVENTS + PRIVACY_EVENTS + INCIDENT_EVENTS entry keeps BOTH
+  a schema registration and at least
   one emission site — a refactor that disconnects the admission-gate/
   guardian/quality/scale-plane/serving/scenario/fleet-alerting/
-  crash-recovery/privacy telemetry must not pass silently;
+  crash-recovery/privacy/incident-forensics telemetry must not pass
+  silently;
 - every ``observability.TRACE_PLANE_SPANS`` name keeps a ``span(...)``
   call site — the ``trace`` CLI merges and parents by these names;
 - scanner self-checks: zero ``.log(``/``span(`` sites at all means the
@@ -86,6 +87,7 @@ class TelemetryContractRule(Rule):
             DATA_PLANE_EVENTS,
             EVENT_SCHEMAS,
             FLEET_EVENTS,
+            INCIDENT_EVENTS,
             MODEL_QUALITY_EVENTS,
             PRIVACY_EVENTS,
             SCALEOUT_EVENTS,
@@ -106,6 +108,7 @@ class TelemetryContractRule(Rule):
                 "FLEET_EVENTS": tuple(FLEET_EVENTS),
                 "SURVIVAL_EVENTS": tuple(SURVIVAL_EVENTS),
                 "PRIVACY_EVENTS": tuple(PRIVACY_EVENTS),
+                "INCIDENT_EVENTS": tuple(INCIDENT_EVENTS),
             },
             "spans": tuple(TRACE_PLANE_SPANS),
             "schema_module": SCHEMA_MODULE,

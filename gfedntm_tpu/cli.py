@@ -28,6 +28,7 @@ Hyperparameters come from a reference-format INI (``--config``,
 from __future__ import annotations
 
 import argparse
+import datetime as _dt
 import json
 import logging
 import os
@@ -287,6 +288,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="half-open round window for --profile_dir, "
                         "'start:stop' or a single round (default '1:2' — "
                         "skips the compile-dominated round 0)")
+    # Incident forensics (README "Incident forensics"): flight recorder +
+    # trigger-driven postmortem bundles. Unset = nothing is constructed
+    # and the telemetry stream stays bitwise identical.
+    p.add_argument("--dump_dir", type=str, default=None,
+                   help="arm the flight recorder: every alert, rollback, "
+                        "quarantine, autorecovery, privacy-budget breach, "
+                        "swap refusal, shed storm, or chaos injection "
+                        "snapshots the node's bounded event ring (+ "
+                        "/status, process self-metrics, thread stacks) "
+                        "into an atomic incident bundle under this "
+                        "directory; the server additionally solicits "
+                        "flight-record snapshots from implicated clients "
+                        "and relays on their next RPC exchange. Merge "
+                        "bundles with the `incident` subcommand "
+                        "(default: disabled — no recorder exists)")
+    p.add_argument("--flightrec_entries", type=int, default=2048,
+                   help="flight-ring entry cap (O(1) ring append; "
+                        "default 2048)")
+    p.add_argument("--flightrec_seconds", type=float, default=300.0,
+                   help="flight-ring time horizon in seconds — older "
+                        "records are pruned (default 300)")
     # Model-quality observability plane (README "Model-quality
     # observability"): live topic coherence / drift / per-client
     # contribution telemetry over the global model.
@@ -582,6 +604,9 @@ def run_server(args: argparse.Namespace, cfg: GfedConfig) -> int:
         dp_delta=getattr(args, "dp_delta", 1e-5),
         dp_budget=getattr(args, "dp_budget", 0.0),
         dp_seed=getattr(args, "dp_seed", 0),
+        dump_dir=getattr(args, "dump_dir", None),
+        flightrec_entries=getattr(args, "flightrec_entries", 2048),
+        flightrec_seconds=getattr(args, "flightrec_seconds", 300.0),
     )
     if getattr(args, "resume", False):
         from gfedntm_tpu.train.checkpoint import CheckpointIntegrityError
@@ -683,6 +708,9 @@ def run_client(args: argparse.Namespace, cfg: GfedConfig) -> int:
         dp_delta=getattr(args, "dp_delta", 1e-5),
         dp_budget=getattr(args, "dp_budget", 0.0),
         dp_seed=getattr(args, "dp_seed", 0),
+        dump_dir=getattr(args, "dump_dir", None),
+        flightrec_entries=getattr(args, "flightrec_entries", 2048),
+        flightrec_seconds=getattr(args, "flightrec_seconds", 300.0),
     )
     client.run()
     client.shutdown()
@@ -728,6 +756,9 @@ def run_relay(args: argparse.Namespace, cfg: GfedConfig) -> int:
         journal_every=getattr(args, "journal_every", 1),
         liveness_timeout=getattr(args, "liveness_timeout", 300.0),
         reconnect_window=getattr(args, "reconnect_window", 180.0),
+        dump_dir=getattr(args, "dump_dir", None),
+        flightrec_entries=getattr(args, "flightrec_entries", 2048),
+        flightrec_seconds=getattr(args, "flightrec_seconds", 300.0),
     )
     if not getattr(args, "no_autorecover", False):
         # Zero-flag shard recovery: a respawned relay with identical
@@ -781,6 +812,9 @@ def run_serve(args: argparse.Namespace, cfg: GfedConfig) -> int:
         metrics=metrics,
         ops_port=getattr(args, "ops_port", None),
         slo_specs=_slo_specs_from_args(args),
+        dump_dir=getattr(args, "dump_dir", None),
+        flightrec_entries=getattr(args, "flightrec_entries", 2048),
+        flightrec_seconds=getattr(args, "flightrec_seconds", 300.0),
     )
     # Distinct default base from the client (50051+id) and relay
     # (51051+id) schemes so a co-hosted serving plane never collides.
@@ -1410,6 +1444,285 @@ def run_privacy(argv: list[str]) -> int:
     return 0
 
 
+# ---- incident forensics (`incident` subcommand) -----------------------------
+
+def _collect_bundle_paths(paths: list[str]) -> list[str]:
+    """Expand the CLI's path arguments into bundle files: a directory
+    contributes every ``inc-*.json`` inside it, a file contributes
+    itself. Missing paths are loud — a postmortem run against a typo'd
+    dump dir must not silently report 'no incidents'."""
+    from gfedntm_tpu.utils.flightrec import BUNDLE_PREFIX
+
+    out: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(
+                os.path.join(path, n)
+                for n in sorted(os.listdir(path))
+                if n.startswith(BUNDLE_PREFIX) and n.endswith(".json")
+            )
+        elif os.path.exists(path):
+            out.append(path)
+        else:
+            raise SystemExit(f"no such bundle file or directory: {path}")
+    return out
+
+
+def _implicated_clients(records: list[dict]) -> dict[int, list[str]]:
+    """Client ids the incident's merged record set implicates, with why:
+    probation/quarantine transitions (logger events) and rejected/clipped
+    gate verdicts (flight-ring notes the JSONL stream never carried)."""
+    implicated: dict[int, set] = {}
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        client = r.get("client")
+        if client is None:
+            continue
+        event = r.get("event")
+        if event in ("client_suspect", "client_quarantined",
+                     "client_dropped"):
+            implicated.setdefault(int(client), set()).add(event)
+        elif r.get("kind") == "gate_verdict" and r.get("verdict") in (
+            "rejected", "clipped"
+        ):
+            why = r.get("reason") or r.get("verdict")
+            implicated.setdefault(int(client), set()).add(
+                f"gate:{why}"
+            )
+    return {cid: sorted(v) for cid, v in sorted(implicated.items())}
+
+
+def _format_ring_record(r: dict) -> str:
+    """One timeline line's payload: the event/kind label plus its fields,
+    long values truncated, trace plumbing and bulk payloads elided."""
+    label = r.get("event") or r.get("kind") or "?"
+    skip = {"time", "event", "kind", "node", "span_id", "parent_id",
+            "trace_id", "remote_parent_id", "metrics", "stacks"}
+    parts = []
+    for k, v in r.items():
+        if k in skip:
+            continue
+        s = f"{v:.6g}" if isinstance(v, float) else str(v)
+        if len(s) > 48:
+            s = s[:45] + "..."
+        parts.append(f"{k}={s}")
+    return f"{label} " + " ".join(parts) if parts else label
+
+
+def run_incident(argv: list[str]) -> int:
+    """``incident <bundle-or-dump-dir>...``: merge the incident bundles
+    the flight-recorder plane dumped (``--dump_dir``) into one causal,
+    clock-aligned postmortem per incident id — the trigger, the
+    implicated clients, each node's pre-trigger ring (gate verdicts,
+    retry decisions, pacing math), NTP-style clock offsets from the ring
+    spans' paired RPC stamps. ``--trace_out`` additionally renders the
+    rings' spans as one Chrome trace. ``--assert-no-incidents`` is the
+    CI-gate mode (the ``slo``/``privacy`` pattern): exit 1 the moment
+    ANY bundle exists under the given paths."""
+    p = argparse.ArgumentParser(
+        prog="gfedntm-tpu incident",
+        description="Merge flight-recorder incident bundles into "
+                    "clock-aligned postmortem timelines.",
+    )
+    p.add_argument("paths", nargs="+",
+                   help="incident bundle files and/or --dump_dir "
+                        "directories (every node's bundles for an "
+                        "incident — local + remotely captured — group "
+                        "by incident id)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the merged incident report as JSON")
+    p.add_argument("--trace_out", default=None,
+                   help="also write the bundles' ring spans as one "
+                        "merged Chrome trace-event JSON (Perfetto)")
+    p.add_argument("--limit", type=int, default=40,
+                   help="merged timeline records printed per incident "
+                        "(default 40; the JSON report is never truncated)")
+    p.add_argument("--assert-no-incidents", dest="assert_none",
+                   action="store_true",
+                   help="CI gate: exit 1 if any incident bundle exists "
+                        "under the given paths (exit 0 on a clean dir)")
+    args = p.parse_args(argv)
+
+    from gfedntm_tpu.utils.flightrec import BUNDLE_SCHEMA
+    from gfedntm_tpu.utils.observability import estimate_clock_offset
+
+    bundle_paths = _collect_bundle_paths(args.paths)
+    if args.assert_none:
+        if bundle_paths:
+            print(
+                f"incident check FAILED: {len(bundle_paths)} incident "
+                "bundle(s) present:", file=sys.stderr,
+            )
+            for path in bundle_paths:
+                print(f"  {path}", file=sys.stderr)
+            return 1
+        print("incident check passed (no bundles)")
+        return 0
+    if not bundle_paths:
+        print("no incident bundles found")
+        return 0
+
+    bundles: list[dict] = []
+    for path in bundle_paths:
+        try:
+            with open(path) as fh:
+                bundle = json.load(fh)
+        except (OSError, json.JSONDecodeError) as err:
+            raise SystemExit(f"unreadable bundle {path}: {err}")
+        if not isinstance(bundle, dict):
+            raise SystemExit(f"bundle {path} is not a JSON object")
+        if int(bundle.get("schema", 0)) != BUNDLE_SCHEMA:
+            print(
+                f"skipping {path}: unknown bundle schema "
+                f"{bundle.get('schema')!r} (this CLI knows "
+                f"{BUNDLE_SCHEMA})", file=sys.stderr,
+            )
+            continue
+        bundles.append(bundle)
+
+    incidents: dict[str, list[dict]] = {}
+    for b in bundles:
+        incidents.setdefault(str(b.get("incident_id")), []).append(b)
+
+    report: list[dict[str, Any]] = []
+    for iid in sorted(incidents):
+        group = incidents[iid]
+        # The reporter is the node whose trigger dumped locally (remote
+        # captures answer with reason="remote_capture"); its clock is
+        # the alignment reference.
+        reporter = next(
+            (b for b in group if b.get("reason") != "remote_capture"),
+            group[0],
+        )
+        ref = str(reporter.get("node"))
+        node_rings: dict[str, list[dict]] = {}
+        for b in group:
+            node_rings.setdefault(str(b.get("node")), []).extend(
+                r for r in (b.get("ring") or []) if isinstance(r, dict)
+            )
+        offsets = {
+            node: (
+                0.0 if node == ref
+                else estimate_clock_offset(
+                    recs, node_rings.get(ref, []), node, ref,
+                )
+            )
+            for node, recs in node_rings.items()
+        }
+        merged = []
+        for node, recs in node_rings.items():
+            off = offsets[node]
+            for r in recs:
+                t = r.get("time")
+                if t is None:
+                    continue
+                merged.append((float(t) - off, node, r))
+        merged.sort(key=lambda x: x[0])
+        trig_time = float(
+            reporter.get("time") or (merged[-1][0] if merged else 0.0)
+        )
+        implicated = _implicated_clients(
+            [r for _t, _n, r in merged]
+            + [reporter.get("trigger") or {}]
+        )
+        entry = {
+            "incident_id": iid,
+            "reason": reporter.get("reason"),
+            "node": ref,
+            "time": trig_time,
+            "trigger": reporter.get("trigger"),
+            "nodes": {n: len(rs) for n, rs in sorted(node_rings.items())},
+            "clock_offsets_s": offsets,
+            "implicated_clients": {
+                str(cid): why for cid, why in implicated.items()
+            },
+            "suppressed": reporter.get("suppressed") or {},
+            "bundles": len(group),
+        }
+        report.append(entry)
+
+        when = _dt.datetime.fromtimestamp(trig_time).isoformat(
+            timespec="seconds"
+        )
+        print(f"incident {iid}")
+        print(
+            f"  reason: {entry['reason']}  node: {ref}  at {when}  "
+            f"({len(group)} bundle(s), {len(merged)} merged records)"
+        )
+        trig = reporter.get("trigger")
+        if trig:
+            print(f"  trigger: {_format_ring_record(trig)}")
+        off_line = ", ".join(
+            f"{n}{o:+.4f}s" for n, o in sorted(offsets.items())
+            if n != ref
+        )
+        if off_line:
+            print(f"  clock offsets vs {ref}: {off_line}")
+        if implicated:
+            print("  implicated clients: " + ", ".join(
+                f"{cid} ({'; '.join(why)})"
+                for cid, why in implicated.items()
+            ))
+        shown = merged[-max(1, args.limit):]
+        if len(merged) > len(shown):
+            print(
+                f"  timeline (last {len(shown)} of {len(merged)} "
+                "records, seconds relative to the trigger):"
+            )
+        else:
+            print("  timeline (seconds relative to the trigger):")
+        for t, node, r in shown:
+            mark = "  <-- TRIGGER" if (
+                trig is not None and r is not trig
+                and r.get("event") == trig.get("event")
+                and r.get("time") == trig.get("time")
+            ) else ""
+            print(
+                f"    {t - trig_time:+10.3f}s  {node:<12s} "
+                f"{_format_ring_record(r)}{mark}"
+            )
+        print()
+
+    if args.trace_out:
+        from gfedntm_tpu.utils.observability import merge_chrome_trace
+
+        all_rings: dict[str, list[dict]] = {}
+        for group in incidents.values():
+            for b in group:
+                all_rings.setdefault(str(b.get("node")), []).extend(
+                    r for r in (b.get("ring") or [])
+                    if isinstance(r, dict)
+                )
+        try:
+            trace = merge_chrome_trace(
+                all_rings, reference=str(report[0]["node"]),
+            )
+        except ValueError as err:
+            raise SystemExit(f"--trace_out: trace merge failed: {err}")
+        out_dir = os.path.dirname(os.path.abspath(args.trace_out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.trace_out, "w") as fh:
+            json.dump(trace, fh, default=float)
+        n_spans = sum(
+            1 for e in trace["traceEvents"] if e.get("ph") == "X"
+        )
+        print(
+            f"wrote {args.trace_out}: {n_spans} ring spans from "
+            f"{len(all_rings)} nodes"
+        )
+    if args.json_out:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True
+        )
+        with open(args.json_out, "w") as fh:
+            json.dump({"incidents": report}, fh, indent=1, default=float)
+    print(
+        f"{len(report)} incident(s) from {len(bundles)} bundle(s)"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1423,6 +1736,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_scenarios(argv[1:])
     if argv and argv[0] == "slo":
         return run_slo(argv[1:])
+    if argv and argv[0] == "incident":
+        return run_incident(argv[1:])
     if argv and argv[0] == "privacy":
         return run_privacy(argv[1:])
     args = build_parser().parse_args(argv)

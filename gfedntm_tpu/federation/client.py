@@ -34,7 +34,7 @@ from gfedntm_tpu.federation.compression import (
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.server import build_template_model
 from gfedntm_tpu.federated.stepper import FederatedStepper
-from gfedntm_tpu.utils import observability
+from gfedntm_tpu.utils import flightrec, observability
 from gfedntm_tpu.utils.observability import span
 
 #: Adaptive liveness-window constants (README "Crash recovery &
@@ -131,6 +131,14 @@ class FederatedClientServicer:
             )
             if metrics is not None else None
         )
+        # Incident forensics (README "Incident forensics"): solicited
+        # flight-record capture. The last token answered dedupes re-rides
+        # (the server stamps the token on every exchange inside its
+        # solicitation window); a token arriving on a push-pacing
+        # Aggregate is held until the next client-initiated PushUpdate,
+        # whose request is a StepReply and so carries the same field.
+        self._last_capture_token = ""  # guarded-by: _lock
+        self._pending_capture_token = ""  # guarded-by: _lock
 
     def TrainStep(self, request: pb.StepRequest, context) -> pb.StepReply:
         """The round's local step(s); reply with the post-step shared
@@ -201,6 +209,15 @@ class FederatedClientServicer:
             nr_samples += self.stepper._last_batch_size
             if self.metrics is not None:
                 self.metrics.registry.counter("client_polls").inc()
+            # Flight-ring breadcrumb (README "Incident forensics"): the
+            # per-round loss/step series the JSONL stream drops — when a
+            # server trigger solicits this client's ring, the postmortem
+            # shows the local trajectory walking into the incident.
+            flightrec.note(
+                self.metrics, "train_step", client=self.client_id,
+                round=int(request.global_iter), seq=seq, steps=n_run,
+                loss=float(losses[-1]), samples=nr_samples,
+            )
             if self.sanitizer is not None:
                 # DP-SGD at the source: clip + noise the round delta
                 # before it is encoded — downstream of here (uplink codec,
@@ -227,6 +244,16 @@ class FederatedClientServicer:
             )
             if self.shipper is not None:
                 reply.telemetry = self.shipper.build()
+            tok = request.capture_token or self._pending_capture_token
+            if tok and tok != self._last_capture_token:
+                # Solicited flight-record snapshot: best-effort (a lost
+                # reply drops it and the token re-rides the next
+                # exchange), deduped so one incident costs one snapshot.
+                blob = flightrec.build_remote_snapshot(self.metrics, tok)
+                if blob is not None:
+                    reply.flightrec = blob
+                    self._last_capture_token = tok
+            self._pending_capture_token = ""
             if seq:
                 self._last_step_seq = seq
                 self._last_step_reply = reply
@@ -275,6 +302,11 @@ class FederatedClientServicer:
                     finished=self.stepper.finished,
                     current_epoch=self.stepper.current_epoch,
                 )
+            flightrec.note(
+                self.metrics, "aggregate_applied", client=self.client_id,
+                round=int(request.round),
+                reset_session=bool(request.reset_session),
+            )
             if request.reset_session:
                 # Divergence-rollback re-broadcast: the server discarded
                 # the trajectory our codec session state describes. Drop
@@ -376,6 +408,10 @@ class FederatedClientServicer:
         advance happens either way (the one-aggregate-per-exchanged-step
         stepper contract)."""
         with self._lock:
+            if agg is not None and agg.capture_token:
+                # Solicited capture under push pacing: answer rides the
+                # NEXT PushUpdate (its request is a StepReply).
+                self._pending_capture_token = agg.capture_token
             if agg is not None and not agg.stop and (
                 agg.reset_session or len(agg.shared.tensors)
             ):
@@ -421,6 +457,9 @@ class Client:
         dp_delta: float = 1e-5,
         dp_budget: float = 0.0,
         dp_seed: int = 0,
+        dump_dir: str | None = None,
+        flightrec_entries: int = 2048,
+        flightrec_seconds: float = 300.0,
     ):
         assert client_id > 0, "client ids start at 1 (0 is the server)"
         self.client_id = client_id
@@ -463,6 +502,25 @@ class Client:
         # Optional MetricsLogger: join-phase spans, RPC/codec registry
         # metrics, and the stepper's step-time histograms all flow into it.
         self.metrics = metrics
+        # Incident forensics (README "Incident forensics"): --dump_dir
+        # arms a flight recorder on the telemetry stream plus a local
+        # trigger (so e.g. a privacy_budget_exceeded fired by this
+        # client's own sanitizer dumps a bundle here), and enables
+        # answering server-solicited remote captures. Unset constructs
+        # NOTHING — the stream stays bitwise identical.
+        self.dump_dir = dump_dir
+        self._incident_trigger = None
+        if dump_dir is not None and metrics is not None:
+            recorder = flightrec.FlightRecorder(
+                max_entries=flightrec_entries,
+                max_seconds=flightrec_seconds,
+                registry=metrics.registry,
+            )
+            metrics.recorder = recorder
+            self._incident_trigger = flightrec.IncidentTrigger(
+                recorder, dump_dir, metrics=metrics,
+                node=metrics.node or f"client{client_id}",
+            )
         # Optional observability.RoundProfiler (--profile_dir): handed to
         # the servicer, which opens/closes the jax.profiler window as the
         # server's StepRequests reveal the round index.

@@ -80,6 +80,7 @@ import numpy as np
 
 from gfedntm_tpu.federation.protos import federated_pb2 as pb
 from gfedntm_tpu.federation.registry import SUSPECT
+from gfedntm_tpu.utils import flightrec
 from gfedntm_tpu.utils.observability import span, trace_pairs
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
@@ -407,16 +408,31 @@ class RoundEngine:
             # request, so a retry after a timed-out-but-delivered call is
             # answered from the client's replay cache instead of running
             # more local steps (README "Crash recovery & sessions").
+            deadline = self.poll_deadline(rec)
+            # Flight-ring context (README "Incident forensics"): the
+            # derived deadline never reaches the JSONL stream, but
+            # "which deadline did this poll run under" is the first
+            # question a straggler/suspect postmortem asks.
+            flightrec.note(
+                s.metrics, "poll_dispatch", client=rec.client_id,
+                round=iteration, deadline_s=deadline,
+                broadcast_round=int(s.global_iterations),
+            )
             reply = stub.TrainStep(
                 pb.StepRequest(
                     global_iter=iteration,
                     local_steps=s.local_steps,
                     broadcast_round=s.global_iterations,
                     seq=s._next_step_seq(),
+                    capture_token=s.flightrec_token(),
                 ),
-                timeout=self.poll_deadline(rec),
+                timeout=deadline,
                 **rpc_kwargs,
             )
+            if reply.flightrec and s._incident_trigger is not None:
+                # Solicited flight-record snapshot riding the poll reply
+                # (README "Incident forensics", remote capture).
+                s._incident_trigger.ingest_remote(reply.flightrec)
             return rec, reply, time.perf_counter() - t0
         except Exception as exc:
             s._note_client_failure(rec, addr, iteration, exc, "TrainStep")

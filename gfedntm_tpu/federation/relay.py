@@ -71,6 +71,7 @@ from gfedntm_tpu.federation.registry import (
 from gfedntm_tpu.federation.resilience import RetryPolicy
 from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.federation.server import build_template_model
+from gfedntm_tpu.utils import flightrec
 from gfedntm_tpu.utils.observability import (
     FleetRegistry,
     TelemetryShipper,
@@ -111,6 +112,9 @@ class RelayNode:
         liveness_timeout: float = 300.0,
         watchdog_poll_s: float = 2.0,
         reconnect_window: float = 180.0,
+        dump_dir: str | None = None,
+        flightrec_entries: int = 2048,
+        flightrec_seconds: float = 300.0,
     ):
         assert relay_id > 0, "relay ids are upstream client ids (>= 1)"
         self.relay_id = relay_id
@@ -168,6 +172,26 @@ class RelayNode:
         # fixed-bucket histograms compose losslessly).
         self.fleet = FleetRegistry(metrics=metrics)
         self._shipper = TelemetryShipper(nodes_fn=self._telemetry_nodes)
+
+        # Incident forensics (README "Incident forensics"): --dump_dir
+        # arms a flight recorder + local trigger (the relay's own
+        # relay_recovered / client_suspect / client_quarantined events
+        # dump bundles HERE, covering its shard) and enables answering
+        # root-solicited captures. Unset constructs nothing.
+        self.dump_dir = dump_dir
+        self._incident_trigger = None
+        self._last_capture_token = ""  # guarded-by: _lock
+        if dump_dir is not None and metrics is not None:
+            recorder = flightrec.FlightRecorder(
+                max_entries=flightrec_entries,
+                max_seconds=flightrec_seconds,
+                registry=metrics.registry,
+            )
+            metrics.recorder = recorder
+            self._incident_trigger = flightrec.IncidentTrigger(
+                recorder, dump_dir, metrics=metrics,
+                node=metrics.node or f"relay{relay_id}",
+            )
 
         # Serializes the whole train/apply data plane (the root never
         # overlaps calls to one client, but the lock makes it a fact).
@@ -589,6 +613,10 @@ class RelayNode:
             global_iter=request.global_iter,
             local_steps=request.local_steps,
             broadcast_round=self._applied_round + 1,
+            # Solicited flight-record pull fans out with the poll: each
+            # member answers in its own StepReply.flightrec and the relay
+            # pre-bundles the set upstream (O(relays) root-side cost).
+            capture_token=request.capture_token,
         )
 
         def poll(rec):
@@ -601,8 +629,11 @@ class RelayNode:
             except Exception as exc:  # noqa: BLE001 — probation accounting
                 return rec, None, exc
 
-        polled = list(self._pool.map(poll, members))
+        with span(self.metrics, "relay_fanout", relay=self.relay_id,
+                  round=round_idx, members=len(members)):
+            polled = list(self._pool.map(poll, members))
         answered = []
+        frec_bundles: list[dict] = []
         for rec, reply, exc in polled:
             if reply is None:
                 self._note_member_failure(rec, round_idx, exc, "TrainStep")
@@ -611,6 +642,17 @@ class RelayNode:
                 # Members' piggybacked reports land in the SHARD-local
                 # fleet view; the upstream reply carries their merge.
                 self.fleet.ingest_bytes(reply.telemetry)
+            if reply.flightrec:
+                try:
+                    frec_bundles.extend(
+                        flightrec.decode_bundles(reply.flightrec)
+                    )
+                except Exception:  # noqa: BLE001 — best-effort forensics
+                    self.logger.warning(
+                        "relay %d: member %d flight-record blob not "
+                        "decodable; dropping it", self.relay_id,
+                        rec.client_id,
+                    )
             answered.append((rec, reply))
 
         if self._uplink_down is not None:
@@ -678,7 +720,7 @@ class RelayNode:
         else:
             shared = codec.flatdict_to_bundle(pseudo, metrics=self.metrics)
         replies = [records[cid][1] for cid, _w, _s in result.accepted]
-        return pb.StepReply(
+        reply = pb.StepReply(
             client_id=self.relay_id,
             shared=shared,
             loss=mean_loss,
@@ -692,6 +734,21 @@ class RelayNode:
             seq=int(request.seq),
             telemetry=self._shipper.build(),
         )
+        tok = request.capture_token
+        with self._lock:
+            fresh_token = bool(tok) and tok != self._last_capture_token
+            if fresh_token:
+                self._last_capture_token = tok
+        if fresh_token:
+            # Pre-bundle: the members' solicited snapshots plus this
+            # relay's own ring, ONE upstream blob (token-deduped — the
+            # members dedupe themselves, so a re-ride costs nothing).
+            own = flightrec.build_remote_snapshot(self.metrics, tok)
+            if own is not None:
+                frec_bundles.extend(flightrec.decode_bundles(own))
+            if frec_bundles:
+                reply.flightrec = flightrec.encode_bundles(frec_bundles)
+        return reply
 
     def _telemetry_nodes(self) -> dict:
         """The relay's upstream report sources: its own registry plus the
@@ -807,9 +864,12 @@ class RelayNode:
                 )
                 return None
 
-        acked = {
-            cid for cid in self._pool.map(push, members) if cid is not None
-        }
+        with span(self.metrics, "relay_push", relay=self.relay_id,
+                  round=round_idx, members=len(members)):
+            acked = {
+                cid for cid in self._pool.map(push, members)
+                if cid is not None
+            }
         # Reentrant: ApplyAggregate already holds _lock; taking it here
         # keeps the guard local to the mutation.
         with self._lock:

@@ -35,6 +35,8 @@ from typing import Any, Callable, Iterator
 
 import grpc
 
+from gfedntm_tpu.utils import flightrec
+
 #: gRPC status codes that indicate the request (very likely) never executed
 #: and is safe to re-issue immediately: connection refused / channel reset
 #: (UNAVAILABLE), server admission pushback (RESOURCE_EXHAUSTED), and
@@ -133,13 +135,30 @@ class RetryPolicy:
                 if not self.retryable(exc) or attempt >= self.max_attempts:
                     if reg is not None and self.retryable(exc):
                         reg.counter("retry_giveups").inc()
+                    # Flight-ring context (README "Incident forensics"):
+                    # the JSONL stream only ever sees the aggregate
+                    # retry counters — the per-call giveup/backoff
+                    # decisions are exactly the lead-in a postmortem
+                    # needs.
+                    flightrec.note(
+                        self.metrics, "retry_giveup", attempt=attempt,
+                        retryable=self.retryable(exc), error=repr(exc),
+                    )
                     raise
                 if reg is not None:
                     reg.counter("retry_attempts").inc()
-                self.sleep(next(delays))
+                delay = next(delays)
+                flightrec.note(
+                    self.metrics, "retry_backoff", attempt=attempt,
+                    delay_s=delay, error=repr(exc),
+                )
+                self.sleep(delay)
             else:
                 if attempt > 1 and reg is not None:
                     reg.counter("retry_successes").inc()
+                    flightrec.note(
+                        self.metrics, "retry_success", attempt=attempt,
+                    )
                 return result
         raise AssertionError("unreachable")  # pragma: no cover
 
@@ -315,6 +334,10 @@ class FaultInjector:
         self.fired.append((method, peer, spec.kind))
         if self.metrics is not None:
             self.metrics.registry.counter("faults_injected").inc()
+        flightrec.note(
+            self.metrics, "fault_injected", method=method, peer=peer,
+            fault=spec.kind,
+        )
         return spec
 
     def _check_partition(self, method: str, peer: str) -> FaultSpec | None:

@@ -40,6 +40,8 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from gfedntm_tpu.utils import flightrec
+
 __all__ = ["Rejection", "GateResult", "UpdateGate", "update_norm"]
 
 # Reason codes (the `update_rejected` event's `reason` field vocabulary).
@@ -491,7 +493,19 @@ class UpdateGate:
         m = self.metrics
         for client_id, _w, _s in accepted:
             self._streak.pop(client_id, None)
+            # Flight-ring context (README "Incident forensics"): the
+            # JSONL stream records rejections only; a postmortem needs
+            # the full per-client verdict history leading into an
+            # incident — acceptances included.
+            flightrec.note(
+                m, "gate_verdict", client=client_id, round=round_idx,
+                verdict="accepted",
+            )
         for rej in rejected:
+            flightrec.note(
+                m, "gate_verdict", client=rej.client_id, round=round_idx,
+                verdict="rejected", reason=rej.reason, detail=rej.detail,
+            )
             self._streak[rej.client_id] = (
                 self._streak.get(rej.client_id, 0) + 1
             )
@@ -518,6 +532,10 @@ class UpdateGate:
                     event["norm"] = rej.norm
                 m.log("update_rejected", **event)
         for client_id, norm, max_norm in clipped:
+            flightrec.note(
+                m, "gate_verdict", client=client_id, round=round_idx,
+                verdict="clipped", norm=norm, max_norm=max_norm,
+            )
             self.logger.warning(
                 "round %d: clipping client %d update norm %.3e -> %.3e",
                 round_idx, client_id, norm, max_norm,

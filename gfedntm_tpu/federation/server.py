@@ -82,6 +82,7 @@ from gfedntm_tpu.federation.sanitize import UpdateGate, decode_and_admit
 from gfedntm_tpu.models.avitm import AVITM
 from gfedntm_tpu.train.guardian import DivergenceGuardian
 from gfedntm_tpu.models.ctm import CTM
+from gfedntm_tpu.utils import flightrec
 from gfedntm_tpu.utils.observability import (
     FleetRegistry,
     OpsServer,
@@ -188,6 +189,11 @@ class FederatedServer:
         dp_delta: float = 1e-5,
         dp_budget: float = 0.0,
         dp_seed: int = 0,
+        dump_dir: str | None = None,
+        flightrec_entries: int = 2048,
+        flightrec_seconds: float = 300.0,
+        flightrec_debounce_s: float = 30.0,
+        flightrec_max_bundles: int = 32,
     ):
         if local_steps < 1:
             raise ValueError(f"local_steps must be >= 1, got {local_steps}")
@@ -480,6 +486,33 @@ class FederatedServer:
             )
         else:
             self.slo = None
+
+        # Incident-forensics plane (README "Incident forensics"): with a
+        # --dump_dir, a FlightRecorder rings every logger record at full
+        # fidelity and the IncidentTrigger seam dumps atomic postmortem
+        # bundles when a detector fires — plus solicits flight-record
+        # snapshots from implicated members on the next RPC exchange
+        # (on_capture -> capture_token riding polls / push replies).
+        # Unset (the default) constructs NOTHING: no recorder on the
+        # logger, no trigger, bitwise-identical round loop.
+        self.dump_dir = dump_dir
+        self._incident_trigger: "flightrec.IncidentTrigger | None" = None
+        self._flightrec_solicit: "tuple[str, float] | None" = None
+        if dump_dir is not None and metrics is not None:
+            recorder = flightrec.FlightRecorder(
+                max_entries=flightrec_entries,
+                max_seconds=flightrec_seconds,
+                registry=metrics.registry,
+            )
+            metrics.recorder = recorder
+            self._incident_trigger = flightrec.IncidentTrigger(
+                recorder, dump_dir, metrics=metrics,
+                node=metrics.node or "server",
+                status_cb=lambda: self._status(full=False),
+                debounce_s=flightrec_debounce_s,
+                max_bundles=flightrec_max_bundles,
+                on_capture=self._solicit_flightrec,
+            )
 
         # Model-quality observability plane (README "Model-quality
         # observability"): with quality_every > 0, every quality round
@@ -1556,6 +1589,11 @@ class FederatedServer:
             # local epoch budget into the void.
             return pb.Aggregate(round=-1)
 
+        # Solicited flight-record pull (README "Incident forensics"):
+        # every reply in the solicitation window carries the token; the
+        # client dedupes by token so re-rides cost nothing.
+        tok = self.flightrec_token()
+
         # Broadcast-ack bookkeeping from the client's own claim, capped
         # by what this server actually sent it (a claim cannot fabricate
         # a reference we never delivered — the delta encoder would
@@ -1611,6 +1649,10 @@ class FederatedServer:
                 # the same bytes (replace-semantics would make re-ingest
                 # harmless, but skipping keeps report ages honest).
                 self.fleet.ingest_bytes(request.telemetry)
+            if request.flightrec and self._incident_trigger is not None:
+                # Solicited flight-record snapshot riding the push
+                # (README "Incident forensics", remote capture).
+                self._incident_trigger.ingest_remote(request.flightrec)
             self.federation.update_progress(
                 cid, int(request.current_mb), int(request.current_epoch),
                 float(request.loss), finished=bool(request.finished),
@@ -1639,6 +1681,7 @@ class FederatedServer:
                 # owed session reset still rides it (bare reset order).
                 return pb.Aggregate(
                     round=max(current, claimed, 0), reset_session=reset,
+                    capture_token=tok,
                 )
             # One encode per installed average, not one per push: up to
             # N concurrent replies between two aggregations would each
@@ -1656,6 +1699,7 @@ class FederatedServer:
                 self._push_identity_memo = memo
             agg = pb.Aggregate(
                 shared=memo[2], round=current, reset_session=reset,
+                capture_token=tok,
             )
         else:
             with self._codec_lock:
@@ -1677,12 +1721,14 @@ class FederatedServer:
                     # ReferenceMismatch — a deadlock).
                     return pb.Aggregate(
                         round=max(current, claimed, 0), reset_session=reset,
+                        capture_token=tok,
                     )
                 bundle = self._downlink_enc.bundle_for(
                     None if reset else (acked if acked >= 0 else None)
                 )
             agg = pb.Aggregate(
                 shared=bundle, round=current, reset_session=reset,
+                capture_token=tok,
             )
         with self._push_lock:
             self._push_sent[cid] = current
@@ -1894,6 +1940,33 @@ class FederatedServer:
                     eps=float(eps), budget=acct.budget,
                     delta=acct.delta,
                 )
+
+    # ---- incident forensics (README "Incident forensics") ------------------
+    def _solicit_flightrec(self, incident_id: str, reason: str,
+                           trigger_record: dict) -> None:
+        """Root-side post-capture hook: arm a capture token so the next
+        RPC exchange with every implicated member (polls under
+        sync/cohort/async, PushUpdate replies under push pacing) asks
+        for its flight-record snapshot. Best-effort and loss-tolerant —
+        the token simply re-rides exchanges until the window closes."""
+        self._flightrec_solicit = (incident_id, time.time() + 120.0)
+        if self.metrics is not None:
+            self.metrics.log(
+                "flightrec_requested", incident_id=incident_id,
+                reason=reason,
+            )
+
+    def flightrec_token(self) -> str:
+        """The live solicitation token ("" when none is armed or the
+        window expired) — stamped onto outgoing StepRequests/Aggregates."""
+        sol = self._flightrec_solicit
+        if sol is None:
+            return ""
+        token, expires = sol
+        if time.time() >= expires:
+            self._flightrec_solicit = None
+            return ""
+        return token
 
     def _awaiting_reconnect_grace(self) -> bool:
         """True while the post-recovery grace window is open AND some

@@ -37,6 +37,7 @@ from typing import Any, Callable
 import numpy as np
 
 from gfedntm_tpu.serving.engine import ModelSource, ServingEngine
+from gfedntm_tpu.utils.observability import span
 
 __all__ = ["Batcher", "InferenceServicer", "QueueFullError", "ServingPlane"]
 
@@ -229,7 +230,9 @@ class Batcher:
                     batch[0].x_bow if len(batch) == 1
                     else np.concatenate([p.x_bow for p in batch], axis=0)
                 )
-                theta, model_round = self.engine.infer(x)
+                with span(self.metrics, "serve_batch",
+                          requests=len(batch), docs=int(x.shape[0])):
+                    theta, model_round = self.engine.infer(x)
             except Exception as err:
                 self.logger.exception("micro-batch inference failed")
                 if self.metrics is not None:
@@ -285,9 +288,11 @@ class InferenceServicer:
     :func:`gfedntm_tpu.federation.rpc.add_service` like every other
     service — fault injection and serve-span tracing compose unchanged."""
 
-    def __init__(self, batcher: Batcher, timeout_s: float = 30.0):
+    def __init__(self, batcher: Batcher, timeout_s: float = 30.0,
+                 metrics=None):
         self.batcher = batcher
         self.timeout_s = float(timeout_s)
+        self.metrics = metrics
 
     def Infer(self, request, context):
         import grpc
@@ -302,9 +307,11 @@ class InferenceServicer:
                     "InferRequest.bow must carry a 'bow' tensor record"
                 )
             x = codec.record_to_array(records["bow"])
-            theta, model_round = self.batcher.submit(x).result(
-                timeout=self.timeout_s
-            )
+            with span(self.metrics, "infer",
+                      request_id=int(request.request_id)):
+                theta, model_round = self.batcher.submit(x).result(
+                    timeout=self.timeout_s
+                )
         except QueueFullError as err:
             # Load shed: the queue is at its --serve_max_queue bound.
             # RESOURCE_EXHAUSTED is the standard gRPC pushback code —
@@ -355,10 +362,34 @@ class ServingPlane:
         ops_host: str = "127.0.0.1",
         grpc_workers: int = 16,
         slo_specs=None,
+        dump_dir: str | None = None,
+        flightrec_entries: int = 2048,
+        flightrec_seconds: float = 300.0,
     ):
         self.logger = logger or logging.getLogger("ServingPlane")
         self.metrics = metrics
         self.poll_s = float(poll_s)
+        # Incident forensics (README "Incident forensics"): --dump_dir
+        # arms a flight recorder on the serving stream plus a trigger —
+        # a swap refusal or a shed storm dumps the ring (recent infer /
+        # serve_batch spans, queue depth history) with /status attached.
+        # Unset constructs nothing.
+        self.dump_dir = dump_dir
+        self._incident_trigger = None
+        if dump_dir is not None and metrics is not None:
+            from gfedntm_tpu.utils import flightrec
+
+            recorder = flightrec.FlightRecorder(
+                max_entries=flightrec_entries,
+                max_seconds=flightrec_seconds,
+                registry=metrics.registry,
+            )
+            metrics.recorder = recorder
+            self._incident_trigger = flightrec.IncidentTrigger(
+                recorder, dump_dir, metrics=metrics,
+                node=metrics.node or "serve",
+                status_cb=lambda: self._status(full=False),
+            )
         if slo_specs:
             from gfedntm_tpu.utils.slo import SLOEngine
 
@@ -410,7 +441,8 @@ class ServingPlane:
         self._grpc_server = rpc.make_server(max_workers=self.grpc_workers)
         rpc.add_service(
             self._grpc_server, "gfedntm.Inference",
-            InferenceServicer(self.batcher), metrics=self.metrics,
+            InferenceServicer(self.batcher, metrics=self.metrics),
+            metrics=self.metrics,
         )
         self.bound_port = self._grpc_server.add_insecure_port(listen_address)
         self._grpc_server.start()
@@ -508,7 +540,8 @@ class ServingPlane:
         self._last_considered = max(
             pub.round, self._last_considered or pub.round
         )
-        return self.engine.publish(pub)
+        with span(self.metrics, "serve_swap", round=int(pub.round)):
+            return self.engine.publish(pub)
 
     # ---- HTTP front door ----------------------------------------------------
     def _vocabulary(self):

@@ -213,6 +213,17 @@ EVENT_SCHEMAS: dict[str, frozenset[str]] = {
     "privacy_budget_exceeded": frozenset(
         {"round", "eps", "budget", "delta"}
     ),
+    # incident-forensics plane (README "Incident forensics"): one
+    # incident_captured per atomic bundle a node's IncidentTrigger
+    # writes, one flightrec_requested when the root solicits remote
+    # flight-record snapshots from implicated nodes, and one
+    # flightrec_received per remote node bundle that lands in the
+    # root's incident dir off a piggybacked RPC reply.
+    "incident_captured": frozenset(
+        {"reason", "incident_id", "records", "path"}
+    ),
+    "flightrec_requested": frozenset({"incident_id", "reason"}),
+    "flightrec_received": frozenset({"incident_id"}),
 }
 
 
@@ -457,6 +468,12 @@ class MetricsLogger:
         self.validate = validate
         self.node = node
         self.trace_id = trace_id
+        # Flight-recorder tap (README "Incident forensics"): when a
+        # FlightRecorder is attached, every record is ALSO ringed at
+        # full fidelity and checked against the incident-trigger seam.
+        # None (the default, and the only state when --dump_dir is
+        # unset) costs one attribute load per log() call.
+        self.recorder = None
         # In-memory retention is for in-process consumers (.events(), tests,
         # bench phase accounting). Default: retain only when there is no
         # file — a long path-backed server run would otherwise accumulate
@@ -492,7 +509,24 @@ class MetricsLogger:
             if self._fh is not None and line is not None:
                 self._fh.write(line + "\n")
                 self._fh.flush()
+        # Outside the lock: the recorder has its own lock, and a capture
+        # it triggers logs incident_captured back through this method —
+        # re-entry must find the stream lock free.
+        recorder = self.recorder
+        if recorder is not None:
+            recorder.observe(record)
         return record
+
+    def sync(self) -> None:
+        """Flush AND fsync the JSONL stream (README "Incident
+        forensics"): the per-line flush() already survives a SIGKILL of
+        this process, but only fsync pushes the tail past the OS cache —
+        the incident dump path calls this so the stream on disk is
+        consistent with every captured bundle."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
 
     def events(self, event: str) -> list[dict[str, Any]]:
         if not self.keep_records:
@@ -641,8 +675,17 @@ NODE_KEY = "x-gfedntm-node"
 #: side child every instrumented RPC dispatch logs, carrying the extracted
 #: trace context + the paired send/recv clock stamps). graftlint's
 #: telemetry-contract rule (GL001; scripts/lint_telemetry.py is a shim
-#: over it) verifies both names still exist as span() call sites.
-TRACE_PLANE_SPANS: tuple[str, ...] = ("round", "serve")
+#: over it) verifies every name still exists as a span() call site.
+#: ``relay_fanout``/``relay_push`` time the relay tier's downstream
+#: fan-out + pre-reduce and its aggregate re-broadcast; ``infer``,
+#: ``serve_batch``, and ``serve_swap`` time the serving path (Infer RPC
+#: dispatch, batcher micro-batch drain, hot-swap install) — without
+#: them hierarchical and serving incidents merged into timelines with
+#: no tier-local spans (README "Incident forensics").
+TRACE_PLANE_SPANS: tuple[str, ...] = (
+    "round", "serve", "relay_fanout", "relay_push", "infer",
+    "serve_batch", "serve_swap",
+)
 
 #: Data-plane defense events (update admission gate, divergence guardian,
 #: checkpoint integrity — README "Robust aggregation & divergence
@@ -740,6 +783,17 @@ PRIVACY_EVENTS: tuple[str, ...] = (
     "dp_noise_applied",
     "privacy_budget",
     "privacy_budget_exceeded",
+)
+
+#: Incident-forensics events (flight-recorder bundles + server-
+#: solicited remote capture — README "Incident forensics"). Same
+#: reverse-lint contract: graftlint verifies each keeps an emission
+#: call site, so the postmortem plane (which the `incident` CLI gate
+#: replays bundles against) can never be silently disconnected.
+INCIDENT_EVENTS: tuple[str, ...] = (
+    "incident_captured",
+    "flightrec_requested",
+    "flightrec_received",
 )
 
 

@@ -37,6 +37,7 @@ import os
 from collections import deque
 from typing import Any
 
+from gfedntm_tpu.utils import flightrec
 from gfedntm_tpu.utils.observability import (
     FleetRegistry,
     MetricsLogger,
@@ -285,6 +286,15 @@ class SLOEngine:
                 if isinstance(snap, dict) else None
             )
             st.value = value
+            # Flight-ring breadcrumb (README "Incident forensics"): every
+            # evaluated sample, not just transitions — when alert_firing
+            # triggers a bundle, the ring shows the measured series
+            # walking toward the threshold. No-op without a recorder.
+            flightrec.note(
+                self.metrics, "slo_eval", alert=spec.name,
+                metric=spec.metric, value=value,
+                threshold=spec.threshold, state=st.state,
+            )
             met = (
                 _OPS[spec.op](value, spec.threshold)
                 if value is not None else None

@@ -69,6 +69,14 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "bench", "rev", "backend", "rounds", "noiseless_round_ms",
         "noised_round_ms", "overhead_pct", "noise_gen", "acceptance",
     ),
+    # scripts/forensics_bench.py's BENCH_FORENSICS artifact object
+    # (README "Incident forensics"): round wall-clock with the flight
+    # recorder armed vs absent, plus the capture path's latency and
+    # bundle size at full ring depth.
+    "forensics_bench": (
+        "bench", "rev", "backend", "clients", "rounds", "bound", "off",
+        "on", "overhead_round_s", "capture", "acceptance",
+    ),
 }
 
 #: Fields a bench summary must ALSO carry when the named condition key is

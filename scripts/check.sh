@@ -56,6 +56,7 @@ env JAX_PLATFORMS=cpu python -m pytest --collect-only -q \
     tests/test_multichip.py tests/test_serving.py \
     tests/test_scenarios.py tests/test_privacy.py \
     tests/test_fleet_telemetry.py tests/test_slo.py \
+    tests/test_forensics.py \
     tests/chaos/test_process_chaos.py \
     >/dev/null || exit 1
 
@@ -122,6 +123,40 @@ if env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli privacy \
     exit 1
 fi
 rm -rf "$DP_TMP"
+
+# Incident CLI gate (README "Incident forensics"): `incident
+# --assert-no-incidents` must pass an empty bundle directory (exit 0)
+# and fail once a bundle exists (exit 1). The seeded bundle is produced
+# by the REAL capture path — a trigger event through a recorder-armed
+# MetricsLogger — so the gate also proves trigger -> atomic bundle
+# end-to-end, same inline-fixture pattern as the slo gate above.
+echo "== incident CLI gate =="
+INC_TMP=$(mktemp -d)
+mkdir -p "$INC_TMP/incidents"
+env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli incident \
+    "$INC_TMP/incidents" --assert-no-incidents || exit 1
+env JAX_PLATFORMS=cpu python - "$INC_TMP" <<'PY' || exit 1
+import sys
+from gfedntm_tpu.utils import flightrec
+from gfedntm_tpu.utils.observability import MetricsLogger
+
+tmp = sys.argv[1]
+m = MetricsLogger(keep_records=True, node="server")
+rec = flightrec.FlightRecorder()
+m.recorder = rec
+flightrec.IncidentTrigger(rec, f"{tmp}/incidents", metrics=m, node="server")
+m.log("checkpoint", round=1)
+m.log("divergence_rollback", round=2, reason="seeded-gate-fixture")
+m.close()
+PY
+if env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli incident \
+    "$INC_TMP/incidents" --assert-no-incidents >/dev/null 2>&1; then
+    echo "incident CLI failed to flag a seeded postmortem bundle" >&2
+    exit 1
+fi
+env JAX_PLATFORMS=cpu python -m gfedntm_tpu.cli incident \
+    "$INC_TMP/incidents" >/dev/null || exit 1
+rm -rf "$INC_TMP"
 
 if [ "${SCENARIO:-0}" = "1" ]; then
     # Scenario-matrix smoke (README "Scenario matrix"): two fast cells

@@ -85,9 +85,16 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # wire-codec session state (delta references AND error-feedback
     # residuals) before applying, so no mass from the discarded diverged
     # trajectory leaks into post-rollback rounds.
+    # `capture_token` (README "Incident forensics"): a root-side incident
+    # id soliciting a flight-record snapshot from the receiving node —
+    # the client answers on its next StepReply (poll reply or
+    # client-initiated PushUpdate, which reuses the message), deduped by
+    # token so a re-broadcast token costs nothing. Rides the replies the
+    # push path already sends, same best-effort discipline as telemetry.
     "Aggregate": [
         ("round", 3, F.TYPE_INT64),
         ("reset_session", 4, F.TYPE_BOOL),
+        ("capture_token", 5, F.TYPE_STRING),
     ],
     # Pacing / staleness tags (README "Federation pacing"): the server
     # stamps each poll with its aggregation counter at dispatch
@@ -103,9 +110,15 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # cached snapshot instead of running more local steps; the reply
     # echoes the seq so the server can drop duplicate StepReplies before
     # they double-count in the average.
+    # `capture_token` (README "Incident forensics"): same solicited
+    # flight-record pull riding the polls sync/cohort/async pacing
+    # already sends; a relay forwards the token on its downstream
+    # fan-out and pre-bundles its members' snapshots with its own, so
+    # the upstream cost stays O(relays).
     "StepRequest": [
         ("broadcast_round", 3, F.TYPE_INT64),
         ("seq", 4, F.TYPE_INT64),
+        ("capture_token", 5, F.TYPE_STRING),
     ],
     # `session_token` authenticates client-initiated PushUpdate rounds
     # (push pacing): the server only buffers an update whose token matches
@@ -117,11 +130,18 @@ WANTED_FIELDS: dict[str, list[tuple[str, int, int]]] = {
     # path (README "Fleet telemetry & SLOs"). Loss-tolerant: a dropped
     # reply drops its deltas, and the shipper's periodic full report
     # heals the receiver.
+    # `flightrec` (README "Incident forensics") answers a solicited
+    # capture_token: a zlib-compressed JSON list of node flight-record
+    # bundles (a list so a relay can pre-bundle its members' snapshots
+    # with its own into ONE upstream blob). Best-effort and
+    # loss-tolerant like `telemetry`: a dropped reply drops its
+    # snapshot, and the token re-rides the next exchange.
     "StepReply": [
         ("base_round", 8, F.TYPE_INT64),
         ("seq", 9, F.TYPE_INT64),
         ("session_token", 10, F.TYPE_STRING),
         ("telemetry", 11, F.TYPE_BYTES),
+        ("flightrec", 12, F.TYPE_BYTES),
     ],
 }
 

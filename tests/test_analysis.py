@@ -295,6 +295,75 @@ class TestTelemetryContract:
         for name in SURVIVAL_EVENTS:
             assert name in EVENT_SCHEMAS
 
+    def test_incident_events_reverse_lint_catches_disconnect(
+            self, tmp_path):
+        """ISSUE 19: the INCIDENT_EVENTS group is reverse-linted like
+        SURVIVAL_EVENTS — the forensics plane announces itself through
+        `incident_captured` / `flightrec_requested` / `flightrec_received`,
+        and a refactor that silently disconnects one of those emissions
+        (or drops its schema) must fail GL001, not pass silently."""
+        incident = {
+            "incident_captured": frozenset(
+                {"reason", "incident_id", "records", "path"}
+            ),
+            "flightrec_requested": frozenset({"incident_id", "reason"}),
+            "flightrec_received": frozenset({"incident_id"}),
+        }
+        src = (
+            'metrics.log("incident_captured", reason="r", '
+            'incident_id="i", records=1, path="p")\n'
+            'metrics.log("flightrec_requested", incident_id="i", '
+            'reason="r")\n'
+        )  # flightrec_received emission seeded out
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE), src,
+            options=telemetry_contract(
+                events=incident,
+                required={"INCIDENT_EVENTS": tuple(incident)},
+            ),
+        )
+        assert len(found) == 1
+        assert "INCIDENT_EVENTS" in found[0].message
+        assert "'flightrec_received'" in found[0].message
+        assert "no .log() emission site" in found[0].message
+        # schema seeded out too: both halves of the disconnect flag
+        found = lint_src(
+            tmp_path, TelemetryContractRule(paths=EVERYWHERE),
+            src + 'metrics.log("flightrec_received", incident_id="i")\n',
+            options=telemetry_contract(
+                events={k: v for k, v in incident.items()
+                        if k != "flightrec_received"},
+                required={"INCIDENT_EVENTS": tuple(incident)},
+            ),
+        )
+        msgs = " | ".join(f.message for f in found)
+        assert "missing from EVENT_SCHEMAS" in msgs
+
+    def test_incident_events_group_wired_to_real_registry(self):
+        """The production lint options carry the INCIDENT_EVENTS group,
+        each member schema-registered, and the forensics spans
+        (relay_fanout/relay_push/infer/serve_batch/serve_swap) are in
+        the TRACE_PLANE_SPANS contract the rule enforces."""
+        from gfedntm_tpu.analysis.core import LintContext
+        from gfedntm_tpu.utils.observability import (
+            EVENT_SCHEMAS,
+            INCIDENT_EVENTS,
+            TRACE_PLANE_SPANS,
+        )
+
+        contract = TelemetryContractRule()._contract(
+            LintContext(root=".")
+        )
+        assert tuple(contract["required"]["INCIDENT_EVENTS"]) == tuple(
+            INCIDENT_EVENTS
+        )
+        for name in INCIDENT_EVENTS:
+            assert name in EVENT_SCHEMAS
+        for name in ("relay_fanout", "relay_push", "infer",
+                     "serve_batch", "serve_swap"):
+            assert name in TRACE_PLANE_SPANS
+            assert name in contract["spans"]
+
     def test_scanner_selfcheck_fires_on_zero_sites(self, tmp_path):
         found = lint_src(
             tmp_path, TelemetryContractRule(paths=EVERYWHERE),

@@ -1,0 +1,699 @@
+"""Flight-recorder & incident-forensics tests (ISSUE 19).
+
+Unit layer: ring bounds (entry cap, time prune, dropped accounting),
+registry snapshot folding, trigger debounce + suppressed counts, bundle
+atomicity/eviction, the remote-capture wire format, and the
+bitwise-inert contract (`--dump_dir` unset constructs nothing and the
+JSONL stream is byte-identical).
+
+Durability layer: MetricsLogger.sync() + a killed-writer subprocess —
+SIGKILL right after a capture must leave a parseable stream and a
+consistent bundle.
+
+E2E layer (seeded chaos, in-process): the PR 5 poisoned-client collapse
+drives divergence rollback + quarantine; bundles land on the server AND
+(via solicited remote capture) for every honest client, and the
+`incident` CLI merges them into one clock-aligned postmortem naming the
+trigger and the implicated client from the bundles alone. A relay kill
+surfaces at the root as a client_suspect bundle while the respawned
+relay's recorder starts clean.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gfedntm_tpu.cli import main as cli_main
+from gfedntm_tpu.data.loaders import RawCorpus
+from gfedntm_tpu.federation.client import Client
+from gfedntm_tpu.federation.relay import RelayNode
+from gfedntm_tpu.federation.resilience import FaultInjector
+from gfedntm_tpu.federation.server import FederatedServer
+from gfedntm_tpu.utils import flightrec
+from gfedntm_tpu.utils.flightrec import (
+    BUNDLE_PREFIX,
+    BUNDLE_SCHEMA,
+    FlightRecorder,
+    IncidentTrigger,
+    TRIGGER_EVENTS,
+    build_remote_snapshot,
+    bundle_filename,
+    decode_bundles,
+    encode_bundles,
+)
+from gfedntm_tpu.utils.observability import MetricsLogger, read_metrics
+from gfedntm_tpu.utils.slo import SLOEngine
+
+MODEL_KWARGS = dict(
+    n_components=3, hidden_sizes=(8,), batch_size=8, num_epochs=2, seed=0,
+)
+
+
+def _bundles_in(dump_dir):
+    """Load every bundle file in a dump dir, newest last."""
+    names = sorted(
+        n for n in os.listdir(dump_dir)
+        if n.startswith(BUNDLE_PREFIX) and n.endswith(".json")
+    )
+    out = []
+    for n in names:
+        with open(os.path.join(dump_dir, n)) as fh:
+            out.append(json.load(fh))
+    return out
+
+
+# ---- ring bounds -------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_entry_cap_and_dropped_accounting(self):
+        rec = FlightRecorder(max_entries=8, max_seconds=3600.0)
+        for i in range(20):
+            rec.note("tick", i=i)
+        assert len(rec) == 8
+        assert rec.dropped == 12
+        ring = rec.snapshot()
+        # oldest-first, and the survivors are the 8 newest
+        assert [r["i"] for r in ring] == list(range(12, 20))
+
+    def test_time_prune_drops_stale_head(self):
+        rec = FlightRecorder(max_entries=100, max_seconds=60.0)
+        now = time.time()
+        rec.observe({"event": "old", "time": now - 3600.0})
+        rec.observe({"event": "older", "time": now - 120.0})
+        rec.note("fresh")
+        ring = rec.snapshot()
+        assert [r.get("event") or r.get("kind") for r in ring] == ["fresh"]
+        assert rec.dropped == 2
+
+    def test_registry_snapshot_folded_into_ring(self):
+        class Reg:
+            def snapshot(self):
+                return {"counter_x": {"type": "counter", "value": 3.0}}
+
+        rec = FlightRecorder(registry=Reg(), snapshot_every_s=0.0)
+        rec.note("a")
+        snaps = [r for r in rec.snapshot()
+                 if r.get("kind") == "registry_snapshot"]
+        assert snaps and snaps[0]["metrics"]["counter_x"]["value"] == 3.0
+
+    def test_invalid_cap_rejected(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(max_entries=0)
+
+    def test_note_helper_is_noop_without_recorder(self):
+        # no recorder attribute at all (None target) and a logger with
+        # recorder=None: both single-branch no-ops, never raising
+        flightrec.note(None, "anything", x=1)
+        m = MetricsLogger(validate=True)
+        flightrec.note(m, "anything", x=1)
+
+
+# ---- trigger seam ------------------------------------------------------------
+
+class TestIncidentTrigger:
+    def _wire(self, tmp_path, **kw):
+        m = MetricsLogger(validate=True, keep_records=True, node="server")
+        rec = FlightRecorder(max_entries=64)
+        m.recorder = rec
+        trig = IncidentTrigger(
+            rec, str(tmp_path / "incidents"), metrics=m, node="server",
+            **kw,
+        )
+        return m, rec, trig
+
+    def test_trigger_event_dumps_atomic_bundle(self, tmp_path):
+        m, rec, trig = self._wire(tmp_path)
+        for i in range(10):
+            m.log("checkpoint", round=i)
+        m.log("divergence_rollback", round=10, reason="nonfinite_global",
+              restored_round=8)
+        bundles = _bundles_in(trig.dump_dir)
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b["schema"] == BUNDLE_SCHEMA
+        assert b["node"] == "server"
+        assert b["reason"] == "divergence_rollback"
+        assert b["trigger"]["event"] == "divergence_rollback"
+        # the ring rode along, pre-trigger history included
+        ring_events = [r.get("event") for r in b["ring"]]
+        assert ring_events.count("checkpoint") == 10
+        # process self-metrics + thread stacks are present
+        assert b["process"]["pid"] == os.getpid()
+        assert "Thread" in b["stacks"] or "File" in b["stacks"]
+        # the capture announced itself on the stream (and did NOT
+        # recurse into a second capture)
+        captured = m.events("incident_captured")
+        assert len(captured) == 1
+        assert captured[0]["reason"] == "divergence_rollback"
+        assert os.path.exists(captured[0]["path"])
+        assert "incident_captured" not in TRIGGER_EVENTS
+
+    def test_debounce_suppresses_storm_and_counts_it(self, tmp_path):
+        m, rec, trig = self._wire(tmp_path, debounce_s=3600.0)
+        for _ in range(5):
+            m.log("alert_firing", alert="shed", metric="m",
+                  value=1.0, threshold=0.5)
+        assert len(_bundles_in(trig.dump_dir)) == 1
+        assert trig._suppressed["slo_alert"] == 4
+        # a DIFFERENT reason is not debounced by the first
+        m.log("divergence_rollback", round=1, reason="loss_explosion",
+              restored_round=0)
+        assert len(_bundles_in(trig.dump_dir)) == 2
+        # the next bundle reports what the window swallowed
+        trig._last_by_reason.clear()
+        m.log("alert_firing", alert="shed", metric="m",
+              value=1.0, threshold=0.5)
+        last = _bundles_in(trig.dump_dir)[-1]
+        by_reason = {b["reason"]: b for b in _bundles_in(trig.dump_dir)}
+        assert by_reason["slo_alert"]["suppressed"]["slo_alert"] >= 4
+        assert last["schema"] == BUNDLE_SCHEMA
+
+    def test_eviction_bounds_incident_dir(self, tmp_path):
+        m, rec, trig = self._wire(tmp_path, debounce_s=0.0,
+                                  max_bundles=3)
+        for i in range(7):
+            trig.capture("slo_alert", incident_id=f"i{i}")
+        names = sorted(os.listdir(trig.dump_dir))
+        assert len(names) == 3
+        # oldest evicted first: the newest ids survive
+        assert any("i6" in n for n in names)
+        assert not any("i0" in n for n in names)
+
+    def test_status_callback_failure_does_not_kill_capture(self, tmp_path):
+        m = MetricsLogger(validate=True, keep_records=True, node="n")
+        rec = FlightRecorder()
+        m.recorder = rec
+        trig = IncidentTrigger(
+            rec, str(tmp_path / "inc"), metrics=m, node="n",
+            status_cb=lambda: 1 / 0,
+        )
+        path = trig.capture("chaos")
+        with open(path) as fh:
+            assert json.load(fh)["status"] is None
+
+    def test_bundle_filename_sanitized(self):
+        name = bundle_filename("a/b c", "rel ay/1")
+        assert name.startswith(BUNDLE_PREFIX) and name.endswith(".json")
+        assert "/" not in name and " " not in name
+        assert "__" in name  # the (incident, node) separator
+
+
+# ---- remote-capture wire format ---------------------------------------------
+
+class TestRemoteCapture:
+    def test_encode_decode_roundtrip_and_list_contract(self):
+        bundles = [{"incident_id": "x", "node": "client1", "ring": []}]
+        blob = encode_bundles(bundles)
+        assert decode_bundles(blob) == bundles
+        import zlib
+        with pytest.raises(ValueError):
+            decode_bundles(zlib.compress(json.dumps({"no": 1}).encode()))
+
+    def test_build_remote_snapshot_requires_recorder(self):
+        m = MetricsLogger(validate=True, node="client1")
+        assert build_remote_snapshot(m, "iid") is None
+        m.recorder = FlightRecorder()
+        m.recorder.note("train_step", loss=1.0)
+        blob = build_remote_snapshot(m, "iid")
+        (bundle,) = decode_bundles(blob)
+        assert bundle["incident_id"] == "iid"
+        assert bundle["reason"] == "remote_capture"
+        assert bundle["node"] == "client1"
+        assert bundle["ring"][0]["kind"] == "train_step"
+
+    def test_ingest_remote_dedupes_by_filename(self, tmp_path):
+        m = MetricsLogger(validate=True, keep_records=True, node="server")
+        rec = FlightRecorder()
+        m.recorder = rec
+        trig = IncidentTrigger(rec, str(tmp_path / "inc"), metrics=m)
+        blob = encode_bundles([
+            {"schema": BUNDLE_SCHEMA, "incident_id": "abc",
+             "node": "client2", "reason": "remote_capture",
+             "time": time.time(), "ring": []},
+        ])
+        assert len(trig.ingest_remote(blob)) == 1
+        assert trig.ingest_remote(blob) == []  # re-shipped blob is free
+        assert len(m.events("flightrec_received")) == 1
+        assert trig.ingest_remote(b"not a zlib blob") == []  # loss-tolerant
+
+
+# ---- bitwise-inert contract --------------------------------------------------
+
+class TestInertWithoutDumpDir:
+    def test_stream_bytes_identical_with_and_without_recorder(
+            self, tmp_path, monkeypatch):
+        """The acceptance bar: a recorder attached to the logger must
+        not change ONE byte of the JSONL stream (timestamps pinned so
+        the runs are comparable)."""
+        monkeypatch.setattr(time, "time", lambda: 1234567890.0)
+
+        def run(path, with_recorder):
+            m = MetricsLogger(str(path), validate=True, node="server")
+            if with_recorder:
+                rec = FlightRecorder(registry=None)
+                m.recorder = rec
+                IncidentTrigger(rec, str(tmp_path / "inc"), metrics=m,
+                                node="server")
+            for i in range(50):
+                m.log("checkpoint", round=i)
+                flightrec.note(m, "poll_dispatch", client=1, round=i)
+            m.close()
+            return path.read_bytes()
+
+        off = run(tmp_path / "off.jsonl", with_recorder=False)
+        on = run(tmp_path / "on.jsonl", with_recorder=True)
+        assert off == on
+
+    def test_server_without_dump_dir_constructs_nothing(self):
+        m = MetricsLogger(validate=True, node="server")
+        server = FederatedServer(
+            min_clients=1, family="avitm", model_kwargs=MODEL_KWARGS,
+            metrics=m,
+        )
+        assert m.recorder is None
+        assert server._incident_trigger is None
+        assert server.flightrec_token() == ""
+
+
+# ---- crash durability --------------------------------------------------------
+
+class TestCrashDurability:
+    def test_sync_fsyncs_the_stream(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        m = MetricsLogger(str(path), validate=True, node="n")
+        m.log("checkpoint", round=1)
+        m.sync()  # must not raise, stream readable without close()
+        assert [r["event"] for r in read_metrics(str(path))] == [
+            "checkpoint"
+        ]
+        m.close()
+        m.sync()  # after close: a no-op, not an error
+
+    def test_killed_writer_leaves_parseable_stream_and_bundle(
+            self, tmp_path):
+        """SIGKILL the writer right after a capture: the JSONL stream
+        parses cleanly (read_metrics raises on torn lines) and the
+        bundle on disk is consistent with it."""
+        stream = tmp_path / "victim.jsonl"
+        dump = tmp_path / "incidents"
+        code = f"""
+import sys, time
+from gfedntm_tpu.utils import flightrec
+from gfedntm_tpu.utils.observability import MetricsLogger
+m = MetricsLogger({str(stream)!r}, validate=False, node="victim")
+rec = flightrec.FlightRecorder(max_entries=256)
+m.recorder = rec
+trig = flightrec.IncidentTrigger(rec, {str(dump)!r}, metrics=m,
+                                 node="victim", debounce_s=0.0)
+for i in range(120):
+    m.log("tick", i=i)
+m.log("alert_firing", alert="a", metric="m", value=2.0, threshold=1.0)
+print("READY", flush=True)
+time.sleep(120)
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.strip() == "READY"
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        records = read_metrics(str(stream))  # raises on a torn stream
+        events = [r["event"] for r in records]
+        assert events.count("tick") == 120
+        assert "incident_captured" in events
+        bundles = _bundles_in(str(dump))
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b["reason"] == "slo_alert"
+        # everything the bundle's ring references is on the synced
+        # stream too — the stream-before-bundle ordering held
+        assert sum(1 for r in b["ring"] if r.get("event") == "tick") > 0
+
+
+# ---- SLO alert -> bundle -----------------------------------------------------
+
+class TestSLOAlertForensics:
+    def test_alert_firing_dumps_bundle_with_slo_eval_series(
+            self, tmp_path):
+        m = MetricsLogger(validate=True, keep_records=True, node="server")
+        rec = FlightRecorder()
+        m.recorder = rec
+        trig = IncidentTrigger(rec, str(tmp_path / "inc"), metrics=m,
+                               node="server")
+        snap = {"serving_errors": {"type": "counter", "value": 0.0}}
+        engine = SLOEngine(
+            [dict(name="errs", metric="serving_errors", agg="value",
+                  op="<=", threshold=0.0, for_s=1.0)],
+            snapshot_fn=lambda: snap, metrics=m,
+        )
+        engine.evaluate(now=100.0)
+        snap["serving_errors"]["value"] = 3.0
+        engine.evaluate(now=101.0)   # pending
+        engine.evaluate(now=102.5)   # firing -> capture
+        bundles = _bundles_in(trig.dump_dir)
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b["reason"] == "slo_alert"
+        assert b["trigger"]["alert"] == "errs"
+        # the ring holds the measured series walking into the threshold
+        # — the slo_eval breadcrumbs the JSONL stream never carried
+        evals = [r for r in b["ring"] if r.get("kind") == "slo_eval"]
+        assert len(evals) >= 3
+        assert any(r["value"] == 3.0 for r in evals)
+
+
+# ---- `incident` CLI ----------------------------------------------------------
+
+def _write_bundle(dump, incident_id, node, reason, t, trigger=None,
+                  ring=(), schema=BUNDLE_SCHEMA):
+    bundle = {
+        "schema": schema, "incident_id": incident_id, "node": node,
+        "reason": reason, "time": t, "trigger": trigger,
+        "ring": list(ring), "ring_dropped": 0, "suppressed": {},
+        "status": None, "process": {"pid": 1}, "stacks": "",
+    }
+    path = os.path.join(dump, bundle_filename(incident_id, node))
+    with open(path, "w") as fh:
+        json.dump(bundle, fh)
+    return path
+
+
+class TestIncidentCLI:
+    def _seed_incident(self, dump, t=1000.0):
+        os.makedirs(dump, exist_ok=True)
+        server_ring = [
+            {"kind": "gate_verdict", "time": t - 30 + i, "client": 3,
+             "verdict": "rejected", "reason": "norm_outlier"}
+            for i in range(5)
+        ] + [
+            {"event": "client_suspect", "time": t - 1, "client": 3,
+             "failures": 1, "status": "suspect", "round": 7,
+             "reason": "poisoned", "node": "server"},
+        ]
+        trigger = {"event": "client_quarantined", "time": t, "client": 3,
+                   "round": 7, "reason": "loss_divergence",
+                   "node": "server"}
+        _write_bundle(dump, "abc1", "server", "quarantine", t,
+                      trigger=trigger, ring=server_ring + [trigger])
+        client_ring = [
+            {"kind": "train_step", "time": t - 20 + i, "client": 1,
+             "round": i, "loss": 1.0 - 0.01 * i}
+            for i in range(6)
+        ]
+        _write_bundle(dump, "abc1", "client1", "remote_capture", t + 1,
+                      ring=client_ring)
+
+    def test_merge_names_trigger_and_implicated_clients(
+            self, tmp_path, capsys):
+        dump = str(tmp_path / "inc")
+        self._seed_incident(dump)
+        assert cli_main(["incident", dump]) == 0
+        out = capsys.readouterr().out
+        assert "incident abc1" in out
+        assert "reason: quarantine" in out
+        assert "client_quarantined" in out
+        assert "implicated clients: 3" in out
+        assert "gate:norm_outlier" in out
+        assert "client_suspect" in out
+        assert "train_step" in out          # the remote node's ring merged
+        assert "2 bundle(s)" in out
+
+    def test_json_report_and_limit(self, tmp_path, capsys):
+        dump = str(tmp_path / "inc")
+        self._seed_incident(dump)
+        out_json = str(tmp_path / "report.json")
+        assert cli_main(
+            ["incident", dump, "--json", out_json, "--limit", "3"]
+        ) == 0
+        with open(out_json) as fh:
+            report = json.load(fh)
+        (inc,) = report["incidents"]
+        assert inc["incident_id"] == "abc1"
+        assert inc["reason"] == "quarantine"
+        assert set(inc["nodes"]) == {"server", "client1"}
+        assert "client_quarantined" in inc["implicated_clients"]["3"]
+        assert any(w.startswith("gate:")
+                   for w in inc["implicated_clients"]["3"])
+        out = capsys.readouterr().out
+        assert "last 3 of" in out
+
+    def test_assert_no_incidents_gate(self, tmp_path, capsys):
+        clean = tmp_path / "clean"
+        clean.mkdir()
+        assert cli_main(
+            ["incident", str(clean), "--assert-no-incidents"]
+        ) == 0
+        assert "incident check passed" in capsys.readouterr().out
+        dump = str(tmp_path / "inc")
+        self._seed_incident(dump)
+        assert cli_main(
+            ["incident", dump, "--assert-no-incidents"]
+        ) == 1
+        assert "FAILED" in capsys.readouterr().err
+
+    def test_unknown_schema_skipped_loudly(self, tmp_path, capsys):
+        dump = str(tmp_path / "inc")
+        os.makedirs(dump)
+        _write_bundle(dump, "zzz", "server", "chaos", 5.0, schema=99)
+        assert cli_main(["incident", dump]) == 0
+        captured = capsys.readouterr()
+        assert "unknown bundle schema" in captured.err
+        assert "0 incident(s)" in captured.out
+
+    def test_missing_path_is_loud(self, tmp_path):
+        with pytest.raises(SystemExit, match="no such bundle"):
+            cli_main(["incident", str(tmp_path / "nope")])
+
+    def test_corrupt_bundle_is_loud(self, tmp_path):
+        dump = tmp_path / "inc"
+        dump.mkdir()
+        (dump / f"{BUNDLE_PREFIX}bad__x.json").write_text("{torn")
+        with pytest.raises(SystemExit, match="unreadable bundle"):
+            cli_main(["incident", str(dump)])
+
+
+# ---- e2e: poisoned-client collapse -> multi-node postmortem ------------------
+
+def _corpora(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    words = [f"tok{i:02d}" for i in range(45)]
+    return [
+        RawCorpus(documents=[
+            " ".join(rng.choice(words, size=12)) for _ in range(docs)
+        ])
+        for docs in sizes
+    ]
+
+
+@pytest.mark.chaos
+def test_poisoned_collapse_yields_multinode_postmortem(tmp_path, capsys):
+    """ISSUE 19 acceptance: a seeded poisoned-client divergence collapse
+    produces atomic incident bundles for the server (local trigger) and
+    every honest client (solicited remote capture), and the `incident`
+    CLI merges them into one clock-aligned timeline that names the
+    trigger and the implicated client — from the bundles alone, with
+    >= 50 pre-trigger ring records per node."""
+    dump = str(tmp_path / "incidents")
+    server_metrics = MetricsLogger(validate=True, keep_records=True,
+                                   node="server")
+    injector = FaultInjector(seed=0, metrics=server_metrics)
+    injector.script("TrainStep", kind="corrupt", payload="scale:50",
+                    times=64, peer="client3", skip=55)
+    server = FederatedServer(
+        min_clients=3, family="avitm",
+        model_kwargs=dict(MODEL_KWARGS, num_epochs=90),
+        max_iters=400, save_dir=str(tmp_path / "server"),
+        metrics=server_metrics, fault_injector=injector,
+        checkpoint_every=4, round_backoff_s=0.02,
+        sanitize=False, divergence_patience=2,
+        dump_dir=dump,
+    )
+    # FedAvg weights are per-round sample counts: the honest clients'
+    # 4-doc corpora contribute partial batches (4 samples/round) against
+    # the poisoner's full 8, so its admitted weight dominates the
+    # unhealthy streak; the factor is tightened because 8/16 sits under
+    # the default 2x-equal-share bar.
+    server.guardian.dominance_factor = 1.2
+    addr = server.start("[::]:0")
+    client_metrics = [
+        MetricsLogger(validate=True, node=f"client{c + 1}")
+        for c in range(3)
+    ]
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=addr,
+               max_features=45, save_dir=str(tmp_path / f"c{c + 1}"),
+               metrics=client_metrics[c],
+               dump_dir=str(tmp_path / f"c{c + 1}-incidents"))
+        for c, corpus in enumerate(_corpora([4, 4, 24]))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True)
+               for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        assert server.wait_done(timeout=600), "federation did not finish"
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        server.stop()
+        for c in clients:
+            c.shutdown()
+
+    # the collapse really happened, through the PR 5 machinery
+    rollbacks = server_metrics.events("divergence_rollback")
+    assert rollbacks
+    quarantined = server_metrics.events("client_quarantined")
+    assert quarantined and quarantined[0]["client"] == 3
+    assert server_metrics.events("flightrec_requested")
+    assert server_metrics.events("flightrec_received")
+
+    # bundles: server-local triggers AND solicited remote captures for
+    # the honest clients, all in the server's incident dir
+    bundles = _bundles_in(dump)
+    reasons = {b["reason"] for b in bundles}
+    assert "divergence_rollback" in reasons
+    assert "quarantine" in reasons
+    remote_nodes = {b["node"] for b in bundles
+                    if b["reason"] == "remote_capture"}
+    assert {"client1", "client2"} <= remote_nodes
+
+    # the incident every node reported into: its bundles carry >= 50
+    # pre-trigger ring records per node (same host, so no skew window)
+    by_incident = {}
+    for b in bundles:
+        by_incident.setdefault(b["incident_id"], []).append(b)
+    multi = {iid: grp for iid, grp in by_incident.items()
+             if len({b["node"] for b in grp}) >= 3}
+    assert multi, f"no multi-node incident in {sorted(by_incident)}"
+    iid, group = sorted(multi.items())[0]
+    reporter = next(b for b in group if b["reason"] != "remote_capture")
+    for b in group:
+        pre = [r for r in b["ring"]
+               if float(r.get("time", 0)) <= reporter["time"] + 1.0]
+        assert len(pre) >= 50, (
+            f"{b['node']}: only {len(pre)} pre-trigger ring records"
+        )
+
+    # the CLI reconstructs the postmortem from the bundles alone
+    trace_out = str(tmp_path / "incident_trace.json")
+    json_out = str(tmp_path / "incident.json")
+    assert cli_main(["incident", dump, "--json", json_out,
+                     "--trace_out", trace_out]) == 0
+    out = capsys.readouterr().out
+    assert "reason: divergence_rollback" in out
+    assert "client_quarantined" in out
+    assert "implicated clients: 3" in out
+    with open(json_out) as fh:
+        report = json.load(fh)
+    merged = {i["incident_id"]: i for i in report["incidents"]}
+    assert len(merged[iid]["nodes"]) >= 3
+    assert "3" in merged[iid]["implicated_clients"]
+    assert all(abs(o) < 5.0
+               for o in merged[iid]["clock_offsets_s"].values())
+    with open(trace_out) as fh:
+        trace = json.load(fh)
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+    # the CI gate flips: a dir with bundles fails, a clean one passes
+    assert cli_main(["incident", dump, "--assert-no-incidents"]) == 1
+    capsys.readouterr()
+
+
+# ---- e2e: relay kill -> root bundle, clean respawn ---------------------------
+
+@pytest.mark.chaos
+def test_relay_kill_root_bundle_and_clean_respawn(tmp_path):
+    """A relay SIGKILL-equivalent abort surfaces at the root as its
+    member record entering probation (client_suspect trigger): the
+    root's bundle captured the death. The respawned relay's recorder
+    starts clean — its autorecovery bundle holds only post-respawn
+    records."""
+    root_dump = str(tmp_path / "root-incidents")
+    root_metrics = MetricsLogger(validate=True, keep_records=True,
+                                 node="server")
+    root = FederatedServer(
+        min_clients=1, family="avitm",
+        model_kwargs=dict(MODEL_KWARGS, num_epochs=30),
+        max_iters=500, save_dir=str(tmp_path / "root"),
+        metrics=root_metrics, checkpoint_every=0, round_backoff_s=0.05,
+        dump_dir=root_dump,
+    )
+    addr = root.start("[::]:0")
+    relay_metrics = MetricsLogger(validate=True, node="relay1")
+    relay_save = str(tmp_path / "relay")
+    relay = RelayNode(
+        relay_id=1, upstream_address=addr, min_members=2,
+        metrics=relay_metrics, save_dir=relay_save,
+        dump_dir=str(tmp_path / "relay-incidents"),
+    )
+    raddr = relay.start()
+    clients = [
+        Client(client_id=c + 1, corpus=corpus, server_address=raddr,
+               max_features=45, save_dir=str(tmp_path / f"hc{c + 1}"))
+        for c, corpus in enumerate(_corpora([24, 24], seed=3))
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True)
+               for c in clients]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 120
+        while root.global_iterations < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert root.global_iterations >= 3, "hierarchy never got going"
+        death_time = time.time()
+        relay.abort()  # SIGKILL-equivalent: no stop, no journal stamp
+        while time.time() < deadline:
+            if any(b["reason"] == "client_suspect"
+                   for b in _bundles_in(root_dump)):
+                break
+            time.sleep(0.1)
+        root_bundles = _bundles_in(root_dump)
+        suspect = [b for b in root_bundles
+                   if b["reason"] == "client_suspect"]
+        assert suspect, f"no suspect bundle, got {root_bundles}"
+        # the root's ring walked into the death: pre-death records exist
+        assert any(float(r.get("time", 0)) < death_time
+                   for r in suspect[0]["ring"])
+        assert suspect[0]["trigger"]["event"] == "client_suspect"
+    finally:
+        root.stop()
+        for c in clients:
+            c.shutdown()
+
+    # respawn: a fresh process adopting the journaled shard
+    relay2_metrics = MetricsLogger(validate=True, node="relay1")
+    relay2_dump = str(tmp_path / "relay2-incidents")
+    relay2 = RelayNode(
+        relay_id=1, upstream_address=addr, min_members=2,
+        metrics=relay2_metrics, save_dir=relay_save,
+        dump_dir=relay2_dump,
+    )
+    respawn_time = time.time()
+    assert relay2.maybe_autorecover() is not None
+    bundles = _bundles_in(relay2_dump)
+    auto = [b for b in bundles if b["reason"] == "autorecovery"]
+    assert auto, f"no autorecovery bundle, got {bundles}"
+    # the respawned recorder started clean: nothing from before the
+    # respawn leaked into the new ring
+    assert all(
+        float(r.get("time", respawn_time)) >= respawn_time - 1.0
+        for r in auto[0]["ring"]
+    )
+    assert relay2_metrics.recorder is not None
+    assert len(relay2_metrics.recorder) > 0

@@ -95,7 +95,10 @@ class TestTraceContextHelpers:
         assert ambient_trace_pairs(MetricsLogger()) == []
 
     def test_trace_plane_span_names_are_the_documented_set(self):
-        assert set(TRACE_PLANE_SPANS) == {"round", "serve"}
+        assert set(TRACE_PLANE_SPANS) == {
+            "round", "serve", "relay_fanout", "relay_push", "infer",
+            "serve_batch", "serve_swap",
+        }
 
 
 # ---- stub -> servicer roundtrip over a real channel -------------------------
